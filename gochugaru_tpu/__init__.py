@@ -1,0 +1,37 @@
+"""gochugaru_tpu — a TPU-native authorization framework.
+
+A brand-new framework with the client-visible capabilities of
+``authzed/gochugaru`` (the ergonomic SpiceDB Go client,
+``/root/reference/gochugaru.go:1-9``): the same Check/Write/Read/Delete/
+Watch/Schema/Import/Export/Lookup surface and consistency strategies —
+but instead of RPC-ing to a SpiceDB server, permission evaluation runs
+locally on TPU.  SpiceDB-style schemas are compiled into JAX reachability
+programs; relationships are interned to integer columns held as sorted
+columnar snapshots on device; bulk checks are a vmap batch axis; multi-hop
+userset-rewrite expansion lowers to capped frontier BFS plus dense boolean
+fixpoint iteration, shardable over a ``jax.sharding.Mesh`` with
+all-reduce(OR) collectives.
+
+Package layout (mirrors the reference's layer map, SURVEY.md §1):
+
+- ``rel``          — the data model (reference ``rel/``)
+- ``consistency``  — consistency strategies (reference ``consistency/``)
+- ``schema``       — SpiceDB schema-language parser + IR compiler
+- ``caveats``      — CEL-subset caveat expression compiler
+- ``store``        — interners, MVCC tuple log, columnar snapshots
+- ``engine``       — the evaluators: host oracle + JAX device engine
+- ``parallel``     — mesh/sharding helpers, multi-chip bulk check
+- ``client``       — the ergonomic Client facade (reference ``client/``)
+- ``utils``        — context, retry/backoff, errors, metrics
+"""
+
+__version__ = "0.1.0"
+
+from . import consistency, rel  # noqa: F401  (re-exported subpackages)
+
+import importlib.util as _ilu
+
+if _ilu.find_spec(".client", __package__) is not None:
+    # The client facade pulls in jax; the data model above stays importable
+    # without it.  Import errors inside the client itself must surface.
+    from .client import Client, new_tpu_evaluator, new_with_opts  # noqa: F401

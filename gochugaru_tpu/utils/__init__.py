@@ -1,0 +1,25 @@
+"""Cross-cutting utilities: Context, error taxonomy, retry, metrics."""
+
+from .context import Context, background, todo
+from .errors import (
+    DeadlineExceededError,
+    PermanentError,
+    PreconditionFailedError,
+    AlreadyExistsError,
+    RevisionUnavailableError,
+    UnavailableError,
+)
+from .retry import retry_retriable_errors
+
+__all__ = [
+    "Context",
+    "background",
+    "todo",
+    "UnavailableError",
+    "DeadlineExceededError",
+    "PermanentError",
+    "PreconditionFailedError",
+    "AlreadyExistsError",
+    "RevisionUnavailableError",
+    "retry_retriable_errors",
+]

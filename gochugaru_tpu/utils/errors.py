@@ -1,0 +1,80 @@
+"""Error taxonomy.
+
+The reference classifies errors for its retry policy into retriable (gRPC
+Unavailable / DeadlineExceeded, "retryable error", "try restarting
+transaction", context deadline) and permanent (client/client.go:193-211).
+Device-local evaluation maps the same classes: transient device conditions
+(OOM-retryable dispatch, snapshot being swapped) → Unavailable; everything
+else is permanent.
+"""
+
+from __future__ import annotations
+
+
+class AuthzError(Exception):
+    """Base class for framework errors."""
+
+
+class UnavailableError(AuthzError):
+    """Transient: the evaluator/snapshot is temporarily unavailable
+    (the local analogue of gRPC ``codes.Unavailable``)."""
+
+
+class DeadlineExceededError(AuthzError):
+    """The context deadline passed (gRPC ``codes.DeadlineExceeded``)."""
+
+
+class CancelledError(AuthzError):
+    """The context was cancelled."""
+
+
+class PermanentError(AuthzError):
+    """Wrapper marking an error as not retriable (backoff.Permanent,
+    client/client.go:202)."""
+
+
+class PreconditionFailedError(AuthzError):
+    """A write/delete precondition (MustMatch/MustNotMatch) failed
+    (rel/txn.go:15-29 semantics)."""
+
+    def __init__(self, message: str = "precondition failed") -> None:
+        super().__init__(message)
+
+
+class AlreadyExistsError(AuthzError):
+    """CREATE of a relationship that already exists (the local analogue of
+    gRPC ``codes.AlreadyExists``, client/client.go:450)."""
+
+
+class RevisionUnavailableError(AuthzError):
+    """A Snapshot()/AtLeast() revision that is unknown or has been garbage
+    collected."""
+
+
+class SchemaError(AuthzError):
+    """Schema parse/validation failure, including writes that would leave
+    relationships unreferenced (client/client.go:426-427 doc contract)."""
+
+
+class PartialDeletionError(AuthzError):
+    """DeleteAtomic did not complete (client/client.go:331-333)."""
+
+
+class OverlapKeyMissingError(RuntimeError):
+    """Raised (the reference panics) when WithOverlapRequired is set and a
+    request carries no overlap key (client/client.go:182-191)."""
+
+    def __init__(self) -> None:
+        super().__init__("failed to configure required overlap key for request")
+
+
+def is_retriable(err: BaseException) -> bool:
+    """The retry classifier (client/client.go:193-203): Unavailable /
+    DeadlineExceeded classes, the two SpiceDB compat strings, or a context
+    deadline error; everything else is permanent."""
+    if isinstance(err, PermanentError):
+        return False
+    if isinstance(err, (UnavailableError, DeadlineExceededError)):
+        return True
+    msg = str(err)
+    return "retryable error" in msg or "try restarting transaction" in msg

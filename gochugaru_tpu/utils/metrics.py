@@ -1,0 +1,64 @@
+"""First-class counters and timers.
+
+The reference has no observability at all (SURVEY.md §5); the north-star
+metric here demands measurement, so the client and engine publish counters
+(checks dispatched, batch occupancy, closure/BFS overflow fallbacks, device
+dispatch time) through this registry.  ``jax.profiler`` remains the deep
+tool; these are the cheap always-on numbers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = defaultdict(float)
+        self._timings: Dict[str, list] = defaultdict(lambda: [0, 0.0])  # [n, total_s]
+
+    def inc(self, name: str, delta: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += delta
+
+    def observe(self, name: str, seconds: float) -> None:
+        with self._lock:
+            t = self._timings[name]
+            t[0] += 1
+            t[1] += seconds
+
+    @contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, time.perf_counter() - t0)
+
+    def counter(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            out = dict(self._counters)
+            for k, (n, total) in self._timings.items():
+                out[f"{k}.count"] = n
+                out[f"{k}.total_s"] = total
+                if n:
+                    out[f"{k}.mean_s"] = total / n
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._timings.clear()
+
+
+#: Process-global default registry.
+default = Metrics()

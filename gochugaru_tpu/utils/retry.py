@@ -1,0 +1,60 @@
+"""Exponential-backoff retry for retriable errors.
+
+Mirrors the reference's envelope exactly: initial 50 ms, max interval 2 s,
+multiplier 1.5, randomization factor 0.5 (client/client.go:205-210 with
+cenkalti/backoff defaults), bounded by the context deadline.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, TypeVar
+
+from .context import Context
+from .errors import DeadlineExceededError, PermanentError, is_retriable
+
+T = TypeVar("T")
+
+INITIAL_INTERVAL = 0.050
+MAX_INTERVAL = 2.0
+MULTIPLIER = 1.5  # backoff.DefaultMultiplier
+RANDOMIZATION_FACTOR = 0.5  # backoff.DefaultRandomizationFactor
+
+
+def retry_retriable_errors(
+    ctx: Context,
+    fn: Callable[[], T],
+    *,
+    sleep: Callable[[float], None] = time.sleep,
+    max_tries: Optional[int] = None,
+) -> T:
+    """Run ``fn`` until it succeeds or fails permanently
+    (client/client.go:193-211).  ``max_tries`` is an escape hatch for tests;
+    the reference bounds retries only by the context."""
+    interval = INITIAL_INTERVAL
+    tries = 0
+    while True:
+        err = ctx.err()
+        if err is not None:
+            raise err
+        try:
+            return fn()
+        except BaseException as e:  # noqa: BLE001 — classify every error
+            tries += 1
+            if isinstance(e, PermanentError) and e.__cause__ is not None:
+                raise e.__cause__
+            if not is_retriable(e):
+                raise
+            if max_tries is not None and tries >= max_tries:
+                raise
+            dl = ctx.deadline()
+            if dl is not None and time.monotonic() >= dl:
+                raise DeadlineExceededError("context deadline exceeded") from e
+            delta = RANDOMIZATION_FACTOR * interval
+            pause = random.uniform(interval - delta, interval + delta)
+            if dl is not None:
+                # Never sleep past the deadline (backoff.WithContext behavior).
+                pause = min(pause, max(dl - time.monotonic(), 0.0))
+            sleep(pause)
+            interval = min(interval * MULTIPLIER, MAX_INTERVAL)

@@ -1,0 +1,13 @@
+"""Test bootstrap: force JAX onto CPU with 8 virtual devices so mesh/sharding
+logic is exercised without TPU hardware — the moral equivalent of the
+reference's `spicedb serve-testing` in-memory server (SURVEY.md §4)."""
+
+import os
+
+# Must run before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

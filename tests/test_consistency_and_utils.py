@@ -1,0 +1,79 @@
+"""Tests for consistency strategies, Context, and the retry envelope."""
+
+import pytest
+
+from gochugaru_tpu import consistency
+from gochugaru_tpu.utils import (
+    Context,
+    DeadlineExceededError,
+    UnavailableError,
+    background,
+    retry_retriable_errors,
+)
+from gochugaru_tpu.utils.errors import PermanentError, is_retriable
+
+
+def test_strategies():
+    assert consistency.full().requirement == consistency.Requirement.FULL
+    assert consistency.min_latency().requirement == consistency.Requirement.MIN_LATENCY
+    s = consistency.at_least("r42")
+    assert (s.requirement, s.revision) == (consistency.Requirement.AT_LEAST, "r42")
+    s = consistency.snapshot("r42")
+    assert (s.requirement, s.revision) == (consistency.Requirement.SNAPSHOT, "r42")
+
+
+def test_overlap_key_in_context():
+    ctx = background()
+    assert ctx.value(consistency.OVERLAP_KEY) is None
+    ctx2 = consistency.with_overlap_key(ctx, "tenant-7")
+    assert ctx2.value(consistency.OVERLAP_KEY) == "tenant-7"
+    # parent untouched
+    assert ctx.value(consistency.OVERLAP_KEY) is None
+
+
+def test_context_cancel_propagates():
+    parent = background().with_cancel()
+    child = parent.with_value("k", "v")
+    assert not child.done()
+    parent.cancel()
+    assert child.done()
+    assert child.err() is not None
+
+
+def test_retry_succeeds_after_transient():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise UnavailableError("try later")
+        return "ok"
+
+    assert retry_retriable_errors(background(), fn, sleep=lambda s: None) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_permanent_raises_immediately():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise ValueError("bad input")
+
+    with pytest.raises(ValueError):
+        retry_retriable_errors(background(), fn, sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+def test_retry_compat_strings_are_retriable():
+    # SpiceDB < v1.30 compat strings (client/client.go:197)
+    assert is_retriable(RuntimeError("a retryable error happened"))
+    assert is_retriable(RuntimeError("try restarting transaction"))
+    assert not is_retriable(RuntimeError("boom"))
+    assert not is_retriable(PermanentError("nope"))
+
+
+def test_retry_respects_deadline():
+    ctx = background().with_timeout(-1)  # already expired
+    with pytest.raises(DeadlineExceededError):
+        retry_retriable_errors(ctx, lambda: "never", sleep=lambda s: None)

@@ -1,0 +1,193 @@
+"""Golden tests for the rel data model, mirroring the reference's tier-1
+tests (rel/relationship_test.go) plus filter/txn behavior."""
+
+import datetime as dt
+
+import pytest
+
+from gochugaru_tpu import rel
+
+
+# -- parser table tests (rel/relationship_test.go:11-29) -------------------
+
+@pytest.mark.parametrize(
+    "resource,relation,subject,err",
+    [
+        ("document:example", "viewer", "user:jzelinskie", None),
+        ("", "viewer", "user:jzelinskie", rel.InvalidResourceError),
+        ("document:example", "", "user:jzelinskie", rel.InvalidRelationError),
+        ("document:example", "viewer", "", rel.InvalidSubjectError),
+    ],
+)
+def test_from_triple_parsing(resource, relation, subject, err):
+    if err is None:
+        rel.from_triple(resource, relation, subject)
+    else:
+        with pytest.raises(err):
+            rel.from_triple(resource, relation, subject)
+
+
+def test_subject_relation_optional():
+    r = rel.must_from_tuple("document:example#viewer", "team:admin#member")
+    assert r.subject_relation == "member"
+    r2 = rel.must_from_tuple("document:example#viewer", "user:jake")
+    assert r2.subject_relation == ""
+
+
+# -- canonical string goldens (rel/relationship_test.go:31-55) -------------
+
+def test_string_plain():
+    r = rel.must_from_triple("document:example", "viewer", "user:jzelinskie")
+    assert str(r) == "document:example#viewer@user:jzelinskie"
+
+
+def test_string_with_caveat():
+    r = rel.must_from_triple("document:example", "viewer", "user:jzelinskie")
+    r = r.with_caveat("only_on_tuesday", {"day_of_the_week": "wednesday"})
+    assert (
+        str(r)
+        == 'document:example#viewer@user:jzelinskie[only_on_tuesday:{"day_of_the_week":"wednesday"}]'
+    )
+
+
+def test_string_with_expiration():
+    expiry = dt.datetime(2024, 12, 25, 15, 30, 0, tzinfo=dt.timezone.utc)
+    r = rel.must_from_triple("document:example", "viewer", "user:jzelinskie")
+    r = r.with_expiration(expiry)
+    assert (
+        str(r)
+        == "document:example#viewer@user:jzelinskie[expiration:2024-12-25T15:30:00Z]"
+    )
+
+
+def test_string_with_subject_relation():
+    r = rel.must_from_tuple("document:example#viewer", "team:admin#member")
+    assert str(r) == "document:example#viewer@team:admin#member"
+
+
+# -- expiration edge cases (rel/relationship_test.go:57-100) ---------------
+
+@pytest.mark.parametrize(
+    "expiration,has_exp,formatted",
+    [
+        (None, False, "document:example#viewer@user:jzelinskie"),
+        (dt.datetime(1, 1, 1), False, "document:example#viewer@user:jzelinskie"),
+        (
+            dt.datetime(2024, 12, 25, 15, 30, 0, tzinfo=dt.timezone.utc),
+            True,
+            "document:example#viewer@user:jzelinskie[expiration:2024-12-25T15:30:00Z]",
+        ),
+    ],
+)
+def test_expiration_cases(expiration, has_exp, formatted):
+    r = rel.must_from_triple("document:example", "viewer", "user:jzelinskie")
+    if expiration is not None:
+        r = r.with_expiration(expiration)
+    assert r.has_expiration() == has_exp
+    assert str(r) == formatted
+
+
+def test_rfc3339_nano_trims_trailing_zeros():
+    t = dt.datetime(2024, 1, 2, 3, 4, 5, 120000, tzinfo=dt.timezone.utc)
+    from gochugaru_tpu.rel.relationship import format_rfc3339_nano
+
+    assert format_rfc3339_nano(t) == "2024-01-02T03:04:05.12Z"
+
+
+# -- builders are immutable copies (rel/relationship.go:93-120) ------------
+
+def test_with_caveat_is_copy():
+    r = rel.must_from_triple("document:example", "viewer", "user:jzelinskie")
+    r2 = r.with_caveat("c", {"x": 1})
+    assert not r.has_caveat()
+    assert r2.has_caveat()
+    name, ctx, ok = r2.caveat()
+    assert (name, ok) == ("c", True)
+    assert ctx["x"] == 1
+
+
+# -- interface acceptance (rel.Interface, rel/relationship.go:26) ----------
+
+def test_interface_duck_typing():
+    class MyGrant:
+        def relationship(self):
+            return rel.must_from_triple("document:d", "viewer", "user:u")
+
+    from gochugaru_tpu.rel.relationship import as_relationship
+
+    assert as_relationship(MyGrant()).resource_id == "d"
+    with pytest.raises(TypeError):
+        as_relationship(42)
+
+
+# -- objects (rel/relationship.go:198-218) ---------------------------------
+
+def test_from_objects():
+    r = rel.from_objects(
+        rel.Object("document", "readme", "viewer"), rel.Object("user", "jake")
+    )
+    assert str(r) == "document:readme#viewer@user:jake"
+
+
+# -- filters ---------------------------------------------------------------
+
+def test_relationship_filter_roundtrip():
+    r = rel.must_from_triple("document:readme", "viewer", "user:jake")
+    f = r.filter()
+    assert f.matches(r)
+    assert not f.matches(rel.must_from_triple("document:readme", "viewer", "user:amy"))
+
+
+def test_filter_wildcards():
+    f = rel.new_filter("document", "", "")
+    assert f.matches(rel.must_from_triple("document:a", "viewer", "user:x"))
+    assert not f.matches(rel.must_from_triple("folder:a", "viewer", "user:x"))
+    f2 = rel.new_filter("document", "", "viewer")
+    assert not f2.matches(rel.must_from_triple("document:a", "editor", "user:x"))
+
+
+def test_subject_filter_relation_semantics():
+    f = rel.new_filter("document", "", "")
+    f.with_subject_filter("team", "", "member")
+    assert f.matches(rel.must_from_tuple("document:a#viewer", "team:eng#member"))
+    assert not f.matches(rel.must_from_tuple("document:a#viewer", "team:eng"))
+    # empty optional_relation = any subject relation
+    g = rel.new_filter("document", "", "")
+    g.with_subject_filter("team", "")
+    assert g.matches(rel.must_from_tuple("document:a#viewer", "team:eng#member"))
+    assert g.matches(rel.must_from_tuple("document:a#viewer", "team:eng"))
+
+
+# -- txn builder (rel/txn.go) ----------------------------------------------
+
+def test_txn_builder():
+    txn = rel.Txn()
+    txn.must_not_match(rel.must_from_triple("m:g", "creator", "user:rival").filter())
+    txn.touch(rel.must_from_triple("m:g", "creator", "user:jimmy"))
+    txn.create(rel.must_from_triple("m:g", "maintainer", "user:sam"))
+    txn.delete(rel.must_from_triple("m:g", "maintainer", "user:old"))
+    assert [u.update_type for u in txn.updates] == [
+        rel.UpdateType.TOUCH,
+        rel.UpdateType.CREATE,
+        rel.UpdateType.DELETE,
+    ]
+    assert len(txn.preconditions) == 1 and not txn.preconditions[0].must_match
+
+
+# -- string parsers (rel/strings.go) ---------------------------------------
+
+def test_parse_object_set():
+    assert rel.parse_object_set("document:README") == ("document", "README", "")
+    assert rel.parse_object_set("document:README#reader") == (
+        "document",
+        "README",
+        "reader",
+    )
+    with pytest.raises(rel.InvalidObjectStringError):
+        rel.parse_object_set("document")
+
+
+def test_parse_typed_relation():
+    assert rel.parse_typed_relation("document#reader") == ("document", "reader")
+    with pytest.raises(rel.InvalidTypedRelationStringError):
+        rel.parse_typed_relation("document")
