@@ -1,0 +1,19 @@
+"""Permission evaluators.
+
+Two implementations of the same semantics:
+
+- ``oracle`` — a pure-Python recursive userset-rewrite walker with exact
+  SpiceDB check semantics (tri-state permissionship, caveats, expiration,
+  wildcards, userset subjects, arrows).  It is the differential-testing
+  reference (SURVEY.md §4's replacement for the dockerized
+  `spicedb serve-testing`), the LookupResources/LookupSubjects engine, and
+  the fallback for queries that overflow the device engine's static caps.
+
+- ``device`` — the JAX/TPU engine: schemas compile to batched reachability
+  programs; checks run as vmapped two-phase evaluation (subject closure +
+  resource-subgraph fixpoint) over the snapshot's sorted columnar arrays.
+"""
+
+from .oracle import Oracle, PermTri
+
+__all__ = ["Oracle", "PermTri"]

@@ -1,0 +1,302 @@
+"""The host oracle: exact SpiceDB check semantics in plain Python.
+
+Permissionship is three-valued, exactly as SpiceDB's
+HAS_PERMISSION / NO_PERMISSION / CONDITIONAL (SURVEY.md §7 "hard parts"):
+``T`` definite grant, ``F`` definite no, ``U`` conditional on caveat
+context that wasn't provided.  Kleene logic combines them (OR = max,
+AND = min, NOT = flip), and the engine collapses U → False only at the
+client API boundary, mirroring where the reference collapses
+Permissionship to bool (client/client.go:277).
+
+Semantics implemented (spec: SURVEY.md §2.6):
+- direct, wildcard (``user:*``), and userset (``group#member``) subjects,
+  with self-identity (``X#r`` is always a member of itself);
+- permissions as rewrite trees: union/intersection/exclusion, ``nil``,
+  arrows (tupleset traversal over direct subjects);
+- caveats: stored context merged over query context (stored wins),
+  missing parameters → conditional;
+- expiration: expired edges grant nothing (rel/relationship.go:43-45);
+- recursion (nested groups, recursive folders) via in-progress cycle
+  detection → least fixpoint;
+- checks on nonexistent resources/relations return F, never an error
+  (client/client_test.go:209-215).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, Iterator, List, Mapping, Optional, Set, Tuple
+
+from ..caveats import UNKNOWN, CelProgram
+from ..rel.relationship import Relationship, WILDCARD_ID, expiration_micros
+from ..schema.ast import (
+    Arrow,
+    Exclusion,
+    Expr,
+    Intersection,
+    Nil,
+    RelationRef,
+    Union,
+)
+from ..schema.compiler import CompiledSchema
+
+# Tri-state permissionship encoding.
+F, U, T = 0, 1, 2
+
+
+class PermTri:
+    FALSE = F
+    CONDITIONAL = U
+    TRUE = T
+
+
+@dataclass(frozen=True)
+class _Edge:
+    subject_type: str
+    subject_id: str
+    subject_relation: str
+    caveat_name: str
+    caveat_context: Mapping[str, Any]
+    expires_us: int  # 0 = none
+
+
+def _to_micros(r: Relationship) -> int:
+    return expiration_micros(r.expiration) if r.has_expiration() else 0
+
+
+class Oracle:
+    """Reference evaluator over a fixed set of relationships."""
+
+    def __init__(
+        self,
+        compiled: CompiledSchema,
+        relationships: Iterable[Relationship],
+        caveat_programs: Optional[Mapping[str, CelProgram]] = None,
+        *,
+        now_us: Optional[int] = None,
+    ) -> None:
+        self.compiled = compiled
+        self.schema = compiled.schema
+        self.caveat_programs = dict(caveat_programs or {})
+        self.now_us = now_us if now_us is not None else int(time.time() * 1_000_000)
+        # (rtype, rid, relation) → edges
+        self._by_onr: Dict[Tuple[str, str, str], List[_Edge]] = {}
+        # candidate object ids per type (resources with any tuple)
+        self._objects_of_type: Dict[str, Set[str]] = {}
+        self._subjects_of_type: Dict[str, Set[str]] = {}
+        for r in relationships:
+            self._by_onr.setdefault(
+                (r.resource_type, r.resource_id, r.resource_relation), []
+            ).append(
+                _Edge(
+                    r.subject_type,
+                    r.subject_id,
+                    r.subject_relation,
+                    r.caveat_name,
+                    r.caveat_context,
+                    _to_micros(r),
+                )
+            )
+            self._objects_of_type.setdefault(r.resource_type, set()).add(r.resource_id)
+            self._subjects_of_type.setdefault(r.subject_type, set()).add(r.subject_id)
+
+    # ------------------------------------------------------------------
+    def _edge_gate(self, e: _Edge, query_ctx: Mapping[str, Any]) -> int:
+        """Tri-state admissibility of one edge: expiry mask and caveat."""
+        if e.expires_us and e.expires_us <= self.now_us:
+            return F
+        if not e.caveat_name:
+            return T
+        prog = self.caveat_programs.get(e.caveat_name)
+        if prog is None:
+            # declared but uncompiled caveat — treat as conditional
+            return U
+        merged = dict(query_ctx)
+        merged.update(e.caveat_context)  # stored context takes precedence
+        result = prog.evaluate(merged)
+        if result is UNKNOWN:
+            return U
+        return T if result else F
+
+    # ------------------------------------------------------------------
+    def check(
+        self,
+        resource_type: str,
+        resource_id: str,
+        permission: str,
+        subject_type: str,
+        subject_id: str,
+        subject_relation: str = "",
+        context: Optional[Mapping[str, Any]] = None,
+    ) -> int:
+        """Tri-state check of one (resource, permission, subject)."""
+        memo: Dict[Tuple[str, str, str], int] = {}
+        in_progress: Set[Tuple[str, str, str]] = set()
+        # Keys that were returned as F because they were in progress (cycle
+        # cuts).  A value computed while its subtree hit a cut on a node
+        # still being evaluated is provisional and must NOT be memoized —
+        # caching it would freeze the cycle's least-fixpoint seed as the
+        # final answer for siblings outside the cycle.
+        cut_hits: Set[Tuple[str, str, str]] = set()
+        ctx = context or {}
+        subject = (subject_type, subject_id, subject_relation)
+
+        def eval_item(rtype: str, rid: str, item: str) -> int:
+            if (rtype, rid, item) == subject:
+                return T  # a userset is always a member of itself
+            d = self.schema.definitions.get(rtype)
+            if d is None:
+                return F
+            key = (rtype, rid, item)
+            if key in memo:
+                return memo[key]
+            if key in in_progress:
+                cut_hits.add(key)
+                return F  # least fixpoint on recursion
+            in_progress.add(key)
+            try:
+                if item in d.relations:
+                    out = eval_relation(rtype, rid, item)
+                elif item in d.permissions:
+                    out = eval_expr(rtype, rid, d.permissions[item].expr)
+                else:
+                    out = F
+            finally:
+                in_progress.discard(key)
+            cut_hits.discard(key)  # cuts to this node are resolved by `out`
+            if not (cut_hits & in_progress):
+                memo[key] = out
+            return out
+
+        def eval_relation(rtype: str, rid: str, relation: str) -> int:
+            out = F
+            for e in self._by_onr.get((rtype, rid, relation), ()):  # noqa: B905
+                gate = self._edge_gate(e, ctx)
+                if gate == F:
+                    continue
+                if e.subject_relation == "":
+                    if e.subject_id == WILDCARD_ID:
+                        # wildcard grants any direct subject of the type
+                        if subject_relation == "" and e.subject_type == subject_type \
+                                and subject_id != WILDCARD_ID:
+                            out = max(out, gate)
+                        elif (e.subject_type, e.subject_id, "") == subject:
+                            out = max(out, gate)  # checking the wildcard itself
+                    elif (e.subject_type, e.subject_id, "") == subject:
+                        out = max(out, gate)
+                else:
+                    sub = eval_item(e.subject_type, e.subject_id, e.subject_relation)
+                    out = max(out, min(gate, sub))
+                if out == T:
+                    return T
+            return out
+
+        def eval_expr(rtype: str, rid: str, expr: Expr) -> int:
+            if isinstance(expr, RelationRef):
+                return eval_item(rtype, rid, expr.name)
+            if isinstance(expr, Nil):
+                return F
+            if isinstance(expr, Arrow):
+                out = F
+                for e in self._by_onr.get((rtype, rid, expr.left), ()):
+                    if e.subject_relation != "" or e.subject_id == WILDCARD_ID:
+                        continue  # arrows traverse direct (ellipsis) subjects
+                    gate = self._edge_gate(e, ctx)
+                    if gate == F:
+                        continue
+                    sub_def = self.schema.definitions.get(e.subject_type)
+                    if sub_def is None or sub_def.item(expr.right) is None:
+                        continue
+                    sub = eval_item(e.subject_type, e.subject_id, expr.right)
+                    out = max(out, min(gate, sub))
+                    if out == T:
+                        return T
+                return out
+            if isinstance(expr, Union):
+                out = F
+                for c in expr.children:
+                    out = max(out, eval_expr(rtype, rid, c))
+                    if out == T:
+                        return T
+                return out
+            if isinstance(expr, Intersection):
+                out = T
+                for c in expr.children:
+                    out = min(out, eval_expr(rtype, rid, c))
+                    if out == F:
+                        return F
+                return out
+            if isinstance(expr, Exclusion):
+                base = eval_expr(rtype, rid, expr.base)
+                if base == F:
+                    return F
+                sub = eval_expr(rtype, rid, expr.subtracted)
+                return min(base, 2 - sub)
+            raise TypeError(f"unknown expression node {expr!r}")
+
+        return eval_item(resource_type, resource_id, permission)
+
+    def check_relationship(
+        self, r: Relationship, context: Optional[Mapping[str, Any]] = None
+    ) -> int:
+        """Check where the query is phrased as a relationship, as the whole
+        Check family does (client/client.go:238-259): resource_relation is
+        the permission, caveat_context is the request context."""
+        ctx = dict(context or {})
+        if r.caveat_context:
+            ctx.update(r.caveat_context)
+        return self.check(
+            r.resource_type,
+            r.resource_id,
+            r.resource_relation,
+            r.subject_type,
+            r.subject_id,
+            r.subject_relation,
+            ctx,
+        )
+
+    # ------------------------------------------------------------------
+    def lookup_resources(
+        self,
+        resource_type: str,
+        permission: str,
+        subject_type: str,
+        subject_id: str,
+        subject_relation: str = "",
+        context: Optional[Mapping[str, Any]] = None,
+    ) -> Iterator[str]:
+        """Stream ids of resources of ``resource_type`` on which the subject
+        has the permission definitively (client/client.go:501-552).
+        Conditional results are omitted, matching the bool collapse at the
+        client layer."""
+        for rid in sorted(self._objects_of_type.get(resource_type, ())):
+            if (
+                self.check(
+                    resource_type, rid, permission,
+                    subject_type, subject_id, subject_relation, context,
+                )
+                == T
+            ):
+                yield rid
+
+    def lookup_subjects(
+        self,
+        resource_type: str,
+        resource_id: str,
+        permission: str,
+        subject_type: str,
+        subject_relation: str = "",
+        context: Optional[Mapping[str, Any]] = None,
+    ) -> Iterator[str]:
+        """Stream ids of subjects of ``subject_type`` holding the permission
+        on the resource (client/client.go:554-599)."""
+        for sid in sorted(self._subjects_of_type.get(subject_type, ())):
+            if (
+                self.check(
+                    resource_type, resource_id, permission,
+                    subject_type, sid, subject_relation, context,
+                )
+                == T
+            ):
+                yield sid

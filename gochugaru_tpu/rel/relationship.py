@@ -55,6 +55,17 @@ def _canonical_caveat_json(context: Mapping[str, Any]) -> str:
     return json.dumps(norm(dict(context)), separators=(",", ":"), sort_keys=True)
 
 
+def expiration_micros(t: Optional[_dt.datetime]) -> int:
+    """Expiration as epoch microseconds; 0 = none.  Naive datetimes are
+    interpreted as UTC — the single definition every evaluator and the
+    store share, so liveness never diverges between paths."""
+    if t is None:
+        return 0
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=_dt.timezone.utc)
+    return int(t.timestamp() * 1_000_000)
+
+
 def format_rfc3339_nano(t: _dt.datetime) -> str:
     """Format a datetime like Go's ``time.RFC3339Nano``: fractional seconds
     with trailing zeros (and a bare dot) trimmed, ``Z`` for UTC

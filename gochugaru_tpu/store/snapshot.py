@@ -1,0 +1,317 @@
+"""Columnar snapshot materialization.
+
+A Snapshot is the device-facing form of the tuple graph at one revision:
+sorted int64-keyed columnar arrays built once on the host, then shipped to
+TPU.  Four views cover every access pattern the evaluator needs, each a
+sorted array family binary-searchable on device:
+
+- **primary** (``e_*``): every live edge sorted by (forward key, subject
+  key) — O(log E) exact-match direct/wildcard leaf tests.
+- **usersets** (``us_*``): edges with userset subjects sorted by forward
+  key — leaf tests gather the userset grants under (relation, resource).
+- **membership** (``ms_*``/``mp_*``): the group-nesting subgraph — direct
+  seeds by subject node, userset propagation edges by subject userset key —
+  the Phase-A subject-closure BFS frontier arrays.  Restricted to usersets
+  that actually appear as tuple subjects, which keeps the closure the size
+  of the *group* structure rather than the whole grant set.
+- **arrows** (``ar_*``): edges of tupleset (arrow-LHS) relations by forward
+  key — the Phase-B resource-subgraph BFS.
+
+Key packing: ``fwd = rel_slot * num_nodes + res_node`` and
+``userset = node * num_slots + rel_slot`` (both < 2^40 for int64 safety at
+2^31 nodes × 2^8 slots).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..rel.filter import Filter
+from ..rel.relationship import Relationship, WILDCARD_ID
+from ..schema.compiler import CompiledSchema
+from .interner import Interner
+
+
+from ..rel.relationship import expiration_micros as _to_micros
+
+
+def _from_micros(us: int) -> Optional[_dt.datetime]:
+    if us == 0:
+        return None
+    return _dt.datetime.fromtimestamp(us / 1_000_000, tz=_dt.timezone.utc)
+
+
+@dataclass
+class Snapshot:
+    """Immutable columnar view of the graph at one revision."""
+
+    revision: int
+    compiled: CompiledSchema
+    interner: Interner
+    num_nodes: int
+    num_slots: int
+    node_type: np.ndarray  # int32[num_nodes]
+    wildcard_node_of_type: np.ndarray  # int32[num_types]; -1 = none
+
+    # primary: all edges sorted by (e_k1, e_k2)
+    e_k1: np.ndarray  # int64[E]  rel_slot * num_nodes + res_node
+    e_k2: np.ndarray  # int64[E]  subj_node * (num_slots+1) + subj_rel_slot + 1
+    e_caveat: np.ndarray  # int32[E]  0 = none
+    e_ctx: np.ndarray  # int32[E]  index into contexts, -1 = none
+    e_exp: np.ndarray  # int64[E]  expiry micros, 0 = none
+
+    # userset edges sorted by us_k1
+    us_k1: np.ndarray
+    us_key: np.ndarray  # int64  subj_node * num_slots + subj_rel_slot
+    us_caveat: np.ndarray
+    us_ctx: np.ndarray
+    us_exp: np.ndarray
+
+    # membership seeds (direct edges into used usersets) sorted by ms_subj
+    ms_subj: np.ndarray  # int32
+    ms_key: np.ndarray  # int64  res_node * num_slots + rel_slot
+    ms_caveat: np.ndarray
+    ms_ctx: np.ndarray
+    ms_exp: np.ndarray
+
+    # membership propagation (userset edges into used usersets) by mp_skey
+    mp_skey: np.ndarray  # int64  subj_node * num_slots + subj_rel_slot
+    mp_key: np.ndarray  # int64  res_node * num_slots + rel_slot
+    mp_caveat: np.ndarray
+    mp_ctx: np.ndarray
+    mp_exp: np.ndarray
+
+    # arrow (tupleset) edges sorted by ar_k1
+    ar_k1: np.ndarray
+    ar_child: np.ndarray  # int32 subject node
+    ar_caveat: np.ndarray
+    ar_ctx: np.ndarray
+    ar_exp: np.ndarray
+
+    contexts: List[Mapping[str, Any]] = field(default_factory=list)
+
+    # ------------------------------------------------------------------
+    @property
+    def num_edges(self) -> int:
+        return int(self.e_k1.shape[0])
+
+    def fwd_key(self, rel_slot: int, res_node: int) -> int:
+        return rel_slot * self.num_nodes + res_node
+
+    def userset_key(self, node: int, rel_slot: int) -> int:
+        return node * self.num_slots + rel_slot
+
+    # -- host-side reads ------------------------------------------------
+    def decode_edge(self, i: int) -> Relationship:
+        k1 = int(self.e_k1[i])
+        k2 = int(self.e_k2[i])
+        rel_slot, res_node = divmod(k1, self.num_nodes)
+        subj_node, srel1 = divmod(k2, self.num_slots + 1)
+        rtype, rid = self.interner.key_of(res_node)
+        stype, sid = self.interner.key_of(subj_node)
+        slot_names = self._slot_names()
+        caveat_id = int(self.e_caveat[i])
+        caveat_name = ""
+        caveat_ctx: Mapping[str, Any] = {}
+        if caveat_id:
+            caveat_name = self._caveat_names()[caveat_id]
+            ctx_i = int(self.e_ctx[i])
+            if ctx_i >= 0:
+                caveat_ctx = self.contexts[ctx_i]
+        return Relationship(
+            resource_type=rtype,
+            resource_id=rid,
+            resource_relation=slot_names[rel_slot],
+            subject_type=stype,
+            subject_id=sid,
+            subject_relation=slot_names[srel1 - 1] if srel1 > 0 else "",
+            caveat_name=caveat_name,
+            caveat_context=caveat_ctx,
+            expiration=_from_micros(int(self.e_exp[i])),
+        )
+
+    def _slot_names(self) -> Dict[int, str]:
+        if not hasattr(self, "_slot_name_cache"):
+            self._slot_name_cache = {v: k for k, v in self.compiled.slot_of_name.items()}
+        return self._slot_name_cache
+
+    def _caveat_names(self) -> Dict[int, str]:
+        if not hasattr(self, "_caveat_name_cache"):
+            self._caveat_name_cache = {v: k for k, v in self.compiled.caveat_ids.items()}
+        return self._caveat_name_cache
+
+    def iter_relationships(
+        self, f: Optional[Filter] = None, now_us: Optional[int] = None
+    ) -> Iterator[Relationship]:
+        """Filtered scan, vectorized on the interned columns; expired edges
+        are excluded (they no longer grant, rel/relationship.go:43-45)."""
+        mask = np.ones(self.num_edges, dtype=bool)
+        if now_us is not None:
+            mask &= (self.e_exp == 0) | (self.e_exp > now_us)
+        if f is not None and self.num_edges:
+            rel_slot = self.e_k1 // self.num_nodes
+            res_node = self.e_k1 % self.num_nodes
+            subj_node = self.e_k2 // (self.num_slots + 1)
+            srel1 = self.e_k2 % (self.num_slots + 1)
+            if f.resource_type != "":
+                # node_type holds INTERNER type ids, not schema type ids
+                tid = self.interner.type_lookup(f.resource_type)
+                if tid < 0:
+                    return
+                mask &= self.node_type[res_node] == tid
+            if f.optional_resource_id != "":
+                if f.resource_type == "":
+                    return  # resource type is required by construction
+                n = self.interner.lookup(f.resource_type, f.optional_resource_id)
+                if n < 0:
+                    return
+                mask &= res_node == n
+            if f.optional_relation != "":
+                s = self.compiled.slot_of_name.get(f.optional_relation)
+                if s is None:
+                    return
+                mask &= rel_slot == s
+            sf = f.optional_subject_filter
+            if sf is not None:
+                if sf.subject_type != "":
+                    tid = self.interner.type_lookup(sf.subject_type)
+                    if tid < 0:
+                        return
+                    mask &= self.node_type[subj_node] == tid
+                if sf.optional_subject_id != "":
+                    if sf.subject_type == "":
+                        return
+                    n = self.interner.lookup(sf.subject_type, sf.optional_subject_id)
+                    if n < 0:
+                        return
+                    mask &= subj_node == n
+                if sf.optional_relation is not None:
+                    if sf.optional_relation == "":
+                        mask &= srel1 == 0
+                    else:
+                        s = self.compiled.slot_of_name.get(sf.optional_relation)
+                        if s is None:
+                            return
+                        mask &= srel1 == s + 1
+        for i in np.nonzero(mask)[0]:
+            yield self.decode_edge(int(i))
+
+
+def build_snapshot(
+    revision: int,
+    compiled: CompiledSchema,
+    interner: Interner,
+    relationships: Sequence[Relationship],
+) -> Snapshot:
+    """Materialize sorted columnar arrays from live relationships."""
+    num_nodes = max(len(interner), 1)
+    num_slots = max(compiled.num_slots, 1)
+    E = len(relationships)
+
+    res = np.empty(E, dtype=np.int64)
+    rel_s = np.empty(E, dtype=np.int64)
+    subj = np.empty(E, dtype=np.int64)
+    srel = np.empty(E, dtype=np.int64)  # -1 = direct
+    cav = np.zeros(E, dtype=np.int32)
+    ctx = np.full(E, -1, dtype=np.int32)
+    exp = np.zeros(E, dtype=np.int64)
+    contexts: List[Mapping[str, Any]] = []
+
+    slot_of = compiled.slot_of_name
+    caveat_ids = compiled.caveat_ids
+    for i, r in enumerate(relationships):
+        res[i] = interner.node(r.resource_type, r.resource_id)
+        rel_s[i] = slot_of[r.resource_relation]
+        subj[i] = interner.node(r.subject_type, r.subject_id)
+        srel[i] = slot_of[r.subject_relation] if r.subject_relation else -1
+        if r.caveat_name:
+            cav[i] = caveat_ids[r.caveat_name]
+            if r.caveat_context:
+                ctx[i] = len(contexts)
+                contexts.append(r.caveat_context)
+        exp[i] = _to_micros(r.expiration)
+
+    node_type = interner.node_type_array()
+    num_nodes = max(len(interner), 1)  # interning above may have grown it
+
+    wc = np.full(interner.num_types, -1, dtype=np.int32)
+    for tname, tid_schema in compiled.type_ids.items():
+        n = interner.lookup(tname, WILDCARD_ID)
+        if n >= 0:
+            itid = interner.type_id(tname)
+            if itid < wc.shape[0]:
+                wc[itid] = n
+
+    k1 = rel_s * num_nodes + res
+    k2 = subj * (num_slots + 1) + (srel + 1)
+
+    order = np.lexsort((k2, k1))
+    e_k1, e_k2 = k1[order], k2[order]
+    e_cav, e_ctx, e_exp = cav[order], ctx[order], exp[order]
+
+    res_o, rel_o, subj_o, srel_o = res[order], rel_s[order], subj[order], srel[order]
+
+    # userset view
+    is_us = srel_o >= 0
+    us_sort = np.argsort(e_k1[is_us], kind="stable")
+    us_k1 = e_k1[is_us][us_sort]
+    us_key = (subj_o[is_us] * num_slots + srel_o[is_us])[us_sort]
+    us_cav = e_cav[is_us][us_sort]
+    us_ctx = e_ctx[is_us][us_sort]
+    us_exp = e_exp[is_us][us_sort]
+
+    # usersets used as subjects anywhere
+    used = np.unique(us_key)
+
+    edge_key = res_o * num_slots + rel_o  # the userset each edge grants
+
+    feeds = np.isin(edge_key, used)
+    # seeds: direct edges into used usersets, by subject node
+    seed_mask = feeds & (srel_o < 0)
+    seed_sort = np.argsort(subj_o[seed_mask], kind="stable")
+    ms_subj = subj_o[seed_mask][seed_sort].astype(np.int32)
+    ms_key = edge_key[seed_mask][seed_sort]
+    ms_cav = e_cav[seed_mask][seed_sort]
+    ms_ctx = e_ctx[seed_mask][seed_sort]
+    ms_exp = e_exp[seed_mask][seed_sort]
+
+    # propagation: userset edges into used usersets, by subject userset key
+    prop_mask = feeds & (srel_o >= 0)
+    prop_skey = subj_o[prop_mask] * num_slots + srel_o[prop_mask]
+    prop_sort = np.argsort(prop_skey, kind="stable")
+    mp_skey = prop_skey[prop_sort]
+    mp_key = edge_key[prop_mask][prop_sort]
+    mp_cav = e_cav[prop_mask][prop_sort]
+    mp_ctx = e_ctx[prop_mask][prop_sort]
+    mp_exp = e_exp[prop_mask][prop_sort]
+
+    # arrow view: tupleset relations, direct subjects only (SpiceDB arrows
+    # traverse ellipsis subjects)
+    ts_slots = np.asarray(sorted(compiled.tupleset_slots), dtype=np.int64)
+    ar_mask = np.isin(rel_o, ts_slots) & (srel_o < 0)
+    ar_sort = np.argsort(e_k1[ar_mask], kind="stable")
+    ar_k1 = e_k1[ar_mask][ar_sort]
+    ar_child = subj_o[ar_mask][ar_sort].astype(np.int32)
+    ar_cav = e_cav[ar_mask][ar_sort]
+    ar_ctx = e_ctx[ar_mask][ar_sort]
+    ar_exp = e_exp[ar_mask][ar_sort]
+
+    return Snapshot(
+        revision=revision,
+        compiled=compiled,
+        interner=interner,
+        num_nodes=num_nodes,
+        num_slots=num_slots,
+        node_type=node_type,
+        wildcard_node_of_type=wc,
+        e_k1=e_k1, e_k2=e_k2, e_caveat=e_cav, e_ctx=e_ctx, e_exp=e_exp,
+        us_k1=us_k1, us_key=us_key, us_caveat=us_cav, us_ctx=us_ctx, us_exp=us_exp,
+        ms_subj=ms_subj, ms_key=ms_key, ms_caveat=ms_cav, ms_ctx=ms_ctx, ms_exp=ms_exp,
+        mp_skey=mp_skey, mp_key=mp_key, mp_caveat=mp_cav, mp_ctx=mp_ctx, mp_exp=mp_exp,
+        ar_k1=ar_k1, ar_child=ar_child, ar_caveat=ar_cav, ar_ctx=ar_ctx, ar_exp=ar_exp,
+        contexts=contexts,
+    )

@@ -1,0 +1,386 @@
+"""The MVCC tuple store: schema + tuple log + snapshot generations.
+
+Single-writer append-only design (SURVEY.md §5 "Race detection": the
+engine stays functionally pure; the only mutable state is here, guarded by
+one lock with RCU-style snapshot swaps).  Semantics enforced:
+
+- **Write** (rel/txn.go): CREATE fails on existing key, TOUCH upserts,
+  DELETE removes; MustMatch/MustNotMatch preconditions checked atomically
+  with the append; every write mints a revision token.
+- **Delete by filter** with preconditions and per-call limits
+  (client/client.go:319-358).
+- **Schema write** validates that no live relationship becomes
+  unreferenced (client/client.go:426-427).
+- **Watch**: ordered, resumable, filtered replay of the update log
+  (client/client.go:364-413).
+- **Revisions**: ZedToken-analogue strings naming snapshot generations;
+  consistency strategies pick the generation (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+from ..caveats import CelProgram, compile_cel
+from ..consistency import Requirement, Strategy
+from ..rel.filter import Filter, Precondition, PreconditionedFilter
+from ..rel.relationship import Relationship
+from ..rel.txn import Txn
+from ..rel.update import Update, UpdateType
+from ..schema import CompiledSchema, compile_schema, parse_schema
+from ..schema.compiler import SchemaValidationError
+from ..utils.errors import (
+    AlreadyExistsError,
+    PreconditionFailedError,
+    RevisionUnavailableError,
+)
+from .interner import Interner
+from .snapshot import Snapshot, build_snapshot
+
+_TOKEN_PREFIX = "gtz1."
+
+
+def RevisionToken(rev: int) -> str:
+    """Mint the opaque revision string for a generation (the ZedToken
+    analogue returned by every write, client/client.go:125)."""
+    return f"{_TOKEN_PREFIX}{rev}"
+
+
+def parse_revision(token: str) -> int:
+    if not token.startswith(_TOKEN_PREFIX):
+        raise RevisionUnavailableError(f"malformed revision token {token!r}")
+    try:
+        return int(token[len(_TOKEN_PREFIX):])
+    except ValueError as e:
+        raise RevisionUnavailableError(f"malformed revision token {token!r}") from e
+
+
+_Key = Tuple[str, str, str, str, str, str]
+
+
+@dataclass
+class _LogEntry:
+    revision: int
+    updates: List[Update]
+
+
+class Store:
+    """In-process authorization datastore with MVCC snapshot generations."""
+
+    def __init__(self, *, keep_generations: int = 4) -> None:
+        self._lock = threading.RLock()
+        self._new_data = threading.Condition(self._lock)
+        self._live: Dict[_Key, Relationship] = {}
+        self._log: List[_LogEntry] = []
+        self._head_rev = 0
+        self._schema_text = ""
+        self._compiled: Optional[CompiledSchema] = None
+        self._caveat_programs: Dict[str, CelProgram] = {}
+        self.interner = Interner()
+        self._snapshots: Dict[int, Snapshot] = {}
+        self._keep_generations = keep_generations
+
+    # -- schema ----------------------------------------------------------
+    def write_schema(self, text: str) -> str:
+        """Parse, compile, and install a schema.  Any live relationship the
+        new schema leaves unreferenced/invalid aborts the write
+        (client/client.go:426-427)."""
+        schema = parse_schema(text)
+        compiled = compile_schema(schema)
+        programs = {
+            name: compile_cel(name, decl.params, decl.expression)
+            for name, decl in schema.caveats.items()
+        }
+        with self._lock:
+            for r in self._live.values():
+                try:
+                    compiled.validate_relationship(r)
+                except SchemaValidationError as e:
+                    raise SchemaValidationError(
+                        f"schema change would leave relationship `{r}` invalid: {e}"
+                    ) from e
+            self._schema_text = text
+            self._compiled = compiled
+            self._caveat_programs = programs
+            self._snapshots.clear()  # slot numbering may have changed
+            self._head_rev += 1
+            self._new_data.notify_all()
+            return RevisionToken(self._head_rev)
+
+    def read_schema(self) -> Tuple[str, str]:
+        with self._lock:
+            return self._schema_text, RevisionToken(self._head_rev)
+
+    @property
+    def compiled_schema(self) -> Optional[CompiledSchema]:
+        with self._lock:
+            return self._compiled
+
+    def caveat_program(self, name: str) -> Optional[CelProgram]:
+        return self._caveat_programs.get(name)
+
+    # -- helpers ----------------------------------------------------------
+    def _require_schema(self) -> CompiledSchema:
+        if self._compiled is None:
+            raise SchemaValidationError("no schema has been written")
+        return self._compiled
+
+    def _now_us(self) -> int:
+        return int(time.time() * 1_000_000)
+
+    def _is_live(self, r: Relationship, now_us: int) -> bool:
+        from ..rel.relationship import expiration_micros
+
+        return not r.has_expiration() or expiration_micros(r.expiration) > now_us
+
+    def _filter_matches_any(self, f: Filter, now_us: int) -> bool:
+        return any(
+            f.matches(r) and self._is_live(r, now_us) for r in self._live.values()
+        )
+
+    def _check_preconditions(self, pcs: List[Precondition], now_us: int) -> None:
+        for pc in pcs:
+            matched = self._filter_matches_any(pc.filter, now_us)
+            if pc.must_match and not matched:
+                raise PreconditionFailedError(
+                    f"precondition MUST_MATCH failed for filter on "
+                    f"`{pc.filter.resource_type}`"
+                )
+            if not pc.must_match and matched:
+                raise PreconditionFailedError(
+                    f"precondition MUST_NOT_MATCH failed for filter on "
+                    f"`{pc.filter.resource_type}`"
+                )
+
+    def _intern(self, r: Relationship) -> None:
+        self.interner.node(r.resource_type, r.resource_id)
+        self.interner.node(r.subject_type, r.subject_id)
+
+    # -- writes ------------------------------------------------------------
+    def write(self, txn: Txn) -> str:
+        """Atomically apply a transaction (rel/txn.go semantics); returns
+        the new revision token (client/client.go:117-126)."""
+        with self._lock:
+            compiled = self._require_schema()
+            now_us = self._now_us()
+            for u in txn.updates:
+                compiled.validate_relationship(u.relationship)
+                self._validate_caveat_context(u.relationship)
+            self._check_preconditions(txn.preconditions, now_us)
+
+            # Pre-validate the whole transaction against a shadow overlay so
+            # a CREATE conflict aborts with nothing applied (atomicity,
+            # rel/txn.go semantics).  The overlay also sequences in-txn ops:
+            # DELETE x then CREATE x in one txn is legal.
+            shadow: Dict[_Key, Optional[Relationship]] = {}
+            for u in txn.updates:
+                key = u.relationship.key()
+                if u.update_type == UpdateType.CREATE:
+                    existing = (
+                        shadow[key] if key in shadow else self._live.get(key)
+                    )
+                    if existing is not None and self._is_live(existing, now_us):
+                        raise AlreadyExistsError(
+                            f"relationship already exists: {u.relationship}"
+                        )
+                    shadow[key] = u.relationship
+                elif u.update_type == UpdateType.TOUCH:
+                    shadow[key] = u.relationship
+                elif u.update_type == UpdateType.DELETE:
+                    shadow[key] = None
+                else:
+                    raise ValueError(f"unknown update type {u.update_type}")
+
+            applied: List[Update] = []
+            for u in txn.updates:
+                key = u.relationship.key()
+                if u.update_type in (UpdateType.CREATE, UpdateType.TOUCH):
+                    self._live[key] = u.relationship
+                    self._intern(u.relationship)
+                    applied.append(u)
+                else:  # DELETE
+                    if key in self._live:
+                        del self._live[key]
+                        applied.append(u)
+
+            self._head_rev += 1
+            self._log.append(_LogEntry(self._head_rev, applied))
+            self._new_data.notify_all()
+            return RevisionToken(self._head_rev)
+
+    def _validate_caveat_context(self, r: Relationship) -> None:
+        if not r.caveat_name or not r.caveat_context:
+            return
+        prog = self._caveat_programs.get(r.caveat_name)
+        if prog is None:
+            return
+        unknown = set(r.caveat_context) - set(prog.params)
+        if unknown:
+            raise SchemaValidationError(
+                f"caveat `{r.caveat_name}` context has undeclared parameters: "
+                f"{sorted(unknown)}"
+            )
+
+    def delete_by_filter(
+        self,
+        pf: PreconditionedFilter,
+        *,
+        limit: int = 0,
+        allow_partial: bool = False,
+    ) -> Tuple[str, bool]:
+        """Delete relationships matching the filter.  Returns (revision,
+        complete).  With a limit, at most ``limit`` are removed and
+        ``complete`` reports whether the filter is now empty — the engine
+        behind both DeleteAtomic (no limit; one transaction,
+        client/client.go:319-336) and batched Delete
+        (client/client.go:340-358)."""
+        with self._lock:
+            self._require_schema()
+            now_us = self._now_us()
+            self._check_preconditions(pf.preconditions, now_us)
+            keys = [k for k, r in self._live.items() if pf.filter.matches(r)]
+            victims = keys if limit <= 0 else keys[:limit]
+            applied = []
+            for k in victims:
+                applied.append(Update(UpdateType.DELETE, self._live.pop(k)))
+            complete = limit <= 0 or len(keys) <= limit
+            self._head_rev += 1
+            self._log.append(_LogEntry(self._head_rev, applied))
+            self._new_data.notify_all()
+            return RevisionToken(self._head_rev), complete
+
+    def import_relationships(self, rs: Iterable[Relationship]) -> str:
+        """Bulk-create a batch; raises AlreadyExistsError (with nothing
+        applied) if any key exists or repeats within the batch — the
+        BulkImport contract the client's TOUCH fallback depends on
+        (client/client.go:449-459).  Returns the minted revision token."""
+        with self._lock:
+            compiled = self._require_schema()
+            now_us = self._now_us()
+            batch = list(rs)
+            seen: set = set()
+            for r in batch:
+                compiled.validate_relationship(r)
+                key = r.key()
+                existing = self._live.get(key)
+                if key in seen or (
+                    existing is not None and self._is_live(existing, now_us)
+                ):
+                    raise AlreadyExistsError(f"relationship already exists: {r}")
+                seen.add(key)
+            applied = []
+            for r in batch:
+                self._live[r.key()] = r
+                self._intern(r)
+                applied.append(Update(UpdateType.CREATE, r))
+            self._head_rev += 1
+            self._log.append(_LogEntry(self._head_rev, applied))
+            self._new_data.notify_all()
+            return RevisionToken(self._head_rev)
+
+    # -- snapshots / consistency ------------------------------------------
+    @property
+    def head_revision(self) -> int:
+        with self._lock:
+            return self._head_rev
+
+    def _materialize_locked(self, rev: int) -> Snapshot:
+        snap = build_snapshot(
+            rev, self._require_schema(), self.interner, list(self._live.values())
+        )
+        self._snapshots[rev] = snap
+        if len(self._snapshots) > self._keep_generations:
+            for old in sorted(self._snapshots)[: len(self._snapshots) - self._keep_generations]:
+                del self._snapshots[old]
+        return snap
+
+    def snapshot_for(self, strategy: Strategy) -> Snapshot:
+        """Select (materializing if needed) the snapshot generation a
+        request evaluates at (consistency/consistency.go:29-77)."""
+        with self._lock:
+            self._require_schema()
+            req = strategy.requirement
+            latest = max(self._snapshots) if self._snapshots else None
+            if req == Requirement.FULL:
+                if latest == self._head_rev:
+                    return self._snapshots[latest]
+                return self._materialize_locked(self._head_rev)
+            if req == Requirement.MIN_LATENCY:
+                if latest is not None:
+                    return self._snapshots[latest]
+                return self._materialize_locked(self._head_rev)
+            if req == Requirement.AT_LEAST:
+                want = parse_revision(strategy.revision or "")
+                if want > self._head_rev:
+                    raise RevisionUnavailableError(
+                        f"revision {strategy.revision} is in the future"
+                    )
+                if latest is not None and latest >= want:
+                    return self._snapshots[latest]
+                return self._materialize_locked(self._head_rev)
+            if req == Requirement.SNAPSHOT:
+                want = parse_revision(strategy.revision or "")
+                if want in self._snapshots:
+                    return self._snapshots[want]
+                if want == self._head_rev:
+                    return self._materialize_locked(self._head_rev)
+                raise RevisionUnavailableError(
+                    f"revision {strategy.revision} is not materialized"
+                    " (written snapshots are kept for a bounded number of"
+                    " generations)"
+                )
+            raise ValueError(f"unknown consistency requirement {req}")
+
+    # -- reads -------------------------------------------------------------
+    def read(self, strategy: Strategy, f: Filter) -> Iterator[Relationship]:
+        snap = self.snapshot_for(strategy)
+        return snap.iter_relationships(f, now_us=self._now_us())
+
+    def export_at(self, revision: str) -> Iterator[Relationship]:
+        snap = self.snapshot_for(Strategy(Requirement.SNAPSHOT, revision))
+        return snap.iter_relationships(None, now_us=self._now_us())
+
+    # -- watch -------------------------------------------------------------
+    def updates_since(
+        self, since_rev: int, *, stop: Optional[threading.Event] = None,
+        poll_interval: float = 0.1,
+    ) -> Iterator[Tuple[int, Update]]:
+        """Yield (revision, update) in log order, blocking for new writes.
+        Resumable: pass the revision of the last seen entry
+        (client/client.go:370-382).  Ends when ``stop`` is set."""
+        import bisect
+
+        next_rev = since_rev
+        while True:
+            batch: List[_LogEntry] = []
+            with self._lock:
+                while True:
+                    # _log is append-only and revision-ordered: bisect for
+                    # the first entry newer than the cursor.
+                    i = bisect.bisect_right(
+                        self._log, next_rev, key=lambda e: e.revision
+                    )
+                    batch = self._log[i:]
+                    if batch:
+                        break
+                    if stop is not None and stop.is_set():
+                        return
+                    self._new_data.wait(timeout=poll_interval)
+            for entry in batch:
+                for u in entry.updates:
+                    if stop is not None and stop.is_set():
+                        return
+                    yield entry.revision, u
+                next_rev = entry.revision
+
+    # -- introspection -----------------------------------------------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._live)
+
+    def live_relationships(self) -> List[Relationship]:
+        with self._lock:
+            return list(self._live.values())

@@ -1,0 +1,60 @@
+"""CEL-subset caveat compiler/evaluator tests."""
+
+import pytest
+
+from gochugaru_tpu.caveats import UNKNOWN, CelCompileError, compile_cel
+
+
+def ev(src, params, ctx):
+    return compile_cel("t", params, src).evaluate(ctx)
+
+
+def test_comparisons_and_logic():
+    p = {"day": "string", "n": "int"}
+    assert ev('day == "tuesday"', p, {"day": "tuesday"}) is True
+    assert ev('day == "tuesday"', p, {"day": "monday"}) is False
+    assert ev('day == "tuesday" || n > 3', p, {"day": "monday", "n": 5}) is True
+    assert ev('day == "tuesday" && n > 3', p, {"day": "tuesday", "n": 1}) is False
+    assert ev("!(n >= 10)", p, {"n": 3}) is True
+
+
+def test_unknown_propagation():
+    p = {"a": "int", "b": "int"}
+    assert ev("a > 1", p, {}) is UNKNOWN
+    # Kleene: T || U = T, F && U = F
+    assert ev("a > 1 || b > 1", p, {"a": 5}) is True
+    assert ev("a > 1 && b > 1", p, {"a": 0}) is False
+    assert ev("a > 1 && b > 1", p, {"a": 5}) is UNKNOWN
+
+
+def test_arithmetic_and_ternary():
+    p = {"x": "int", "y": "int"}
+    assert ev("x + y * 2 == 7", p, {"x": 1, "y": 3}) is True
+    assert ev("x % 2 == 0 ? y > 0 : y < 0", p, {"x": 4, "y": 1}) is True
+    assert ev("-x < 0", p, {"x": 3}) is True
+    # CEL int division truncates toward zero
+    assert ev("x / y == -1", p, {"x": -3, "y": 2}) is True
+
+
+def test_in_and_lists():
+    p = {"region": "string", "allowed": "list"}
+    assert ev('region in ["us", "eu"]', p, {"region": "eu"}) is True
+    assert ev('region in ["us", "eu"]', p, {"region": "ap"}) is False
+    assert ev("region in allowed", p, {"region": "us", "allowed": ["us"]}) is True
+
+
+def test_member_access():
+    p = {"req": "map"}
+    assert ev('req.ip == "10.0.0.1"', p, {"req": {"ip": "10.0.0.1"}}) is True
+    assert ev('req.ip == "10.0.0.1"', p, {"req": {}}) is UNKNOWN
+
+
+def test_compile_errors():
+    with pytest.raises(CelCompileError):
+        compile_cel("t", {"a": "int"}, "a ==")  # truncated
+    with pytest.raises(CelCompileError):
+        compile_cel("t", {"a": "int"}, "b > 1")  # undeclared ident
+    with pytest.raises(CelCompileError):
+        compile_cel("t", {"a": "wat"}, "a > 1")  # unknown type
+    with pytest.raises(CelCompileError):
+        compile_cel("t", {"a": "int"}, "a @ 1")  # bad char

@@ -1,0 +1,298 @@
+"""Oracle evaluator tests: the full check-semantics matrix the device
+engine will be differentially tested against."""
+
+import datetime as dt
+
+from gochugaru_tpu import rel
+from gochugaru_tpu.caveats import compile_cel
+from gochugaru_tpu.engine.oracle import F, T, U, Oracle
+from gochugaru_tpu.schema import compile_schema, parse_schema
+
+
+def make_oracle(schema_text, triples, caveats=None, now_us=None):
+    cs = compile_schema(parse_schema(schema_text))
+    programs = {
+        name: compile_cel(name, decl.params, decl.expression)
+        for name, decl in cs.schema.caveats.items()
+    }
+    rels = [t if isinstance(t, rel.Relationship) else rel.must_from_tuple(*t) for t in triples]
+    return Oracle(cs, rels, programs, now_us=now_us)
+
+
+EXAMPLE = """
+definition user {}
+definition document {
+    relation writer: user
+    relation reader: user
+    permission edit = writer
+    permission view = reader + edit
+}
+"""
+
+
+def test_reference_check_matrix():
+    # Mirrors TestClient_Check fixtures (client/client_test.go:141-216)
+    o = make_oracle(
+        EXAMPLE,
+        [
+            ("document:check_test1#writer", "user:alice"),
+            ("document:check_test1#reader", "user:bob"),
+            ("document:check_test2#writer", "user:charlie"),
+        ],
+    )
+    assert o.check("document", "check_test1", "edit", "user", "alice") == T
+    assert o.check("document", "check_test1", "edit", "user", "bob") == F
+    assert o.check("document", "check_test1", "view", "user", "bob") == T
+    assert o.check("document", "check_test2", "edit", "user", "charlie") == T
+    assert o.check("document", "check_test2", "view", "user", "alice") == F
+    # transitive: writer ⇒ edit ⇒ view
+    assert o.check("document", "check_test1", "view", "user", "alice") == T
+    # nonexistent resource → F, not an error
+    assert o.check("document", "nonexistent", "edit", "user", "alice") == F
+    # nonexistent permission → F
+    assert o.check("document", "check_test1", "ghost", "user", "alice") == F
+
+
+NESTED_GROUPS = """
+definition user {}
+definition group {
+    relation member: user | group#member
+}
+definition document {
+    relation viewer: group#member
+    permission view = viewer
+}
+"""
+
+
+def test_nested_groups_recursion():
+    o = make_oracle(
+        NESTED_GROUPS,
+        [
+            ("group:leaf#member", "user:amy"),
+            ("group:mid#member", "group:leaf#member"),
+            ("group:top#member", "group:mid#member"),
+            ("document:d#viewer", "group:top#member"),
+        ],
+    )
+    assert o.check("document", "d", "view", "user", "amy") == T
+    assert o.check("document", "d", "view", "user", "bob") == F
+    # membership at each level
+    assert o.check("group", "top", "member", "user", "amy") == T
+    assert o.check("group", "leaf", "member", "user", "amy") == T
+
+
+def test_group_cycle_terminates():
+    o = make_oracle(
+        NESTED_GROUPS,
+        [
+            ("group:a#member", "group:b#member"),
+            ("group:b#member", "group:a#member"),
+            ("document:d#viewer", "group:a#member"),
+        ],
+    )
+    assert o.check("document", "d", "view", "user", "amy") == F
+
+
+def test_userset_self_identity():
+    o = make_oracle(NESTED_GROUPS, [("document:d#viewer", "group:g#member")])
+    # a userset is a member of itself
+    assert o.check("group", "g", "member", "group", "g", "member") == T
+    assert o.check("document", "d", "view", "group", "g", "member") == T
+
+
+FOLDERS = """
+definition user {}
+definition folder {
+    relation parent: folder
+    relation owner: user
+    permission view = owner + parent->view
+}
+definition document {
+    relation folder: folder
+    relation viewer: user
+    relation banned: user
+    permission view = (viewer + folder->view) - banned
+}
+"""
+
+
+def test_arrow_recursion_deep_chain():
+    triples = [("folder:f0#owner", "user:root")]
+    for i in range(1, 6):
+        triples.append((f"folder:f{i}#parent", f"folder:f{i-1}"))
+    triples.append(("document:d#folder", "folder:f5"))
+    o = make_oracle(FOLDERS, triples)
+    # 5-hop recursive arrow chain (BASELINE config 3 shape)
+    assert o.check("document", "d", "view", "user", "root") == T
+    assert o.check("folder", "f5", "view", "user", "root") == T
+    assert o.check("document", "d", "view", "user", "other") == F
+
+
+def test_exclusion():
+    o = make_oracle(
+        FOLDERS,
+        [
+            ("document:d#viewer", "user:amy"),
+            ("document:d#viewer", "user:bob"),
+            ("document:d#banned", "user:bob"),
+        ],
+    )
+    assert o.check("document", "d", "view", "user", "amy") == T
+    assert o.check("document", "d", "view", "user", "bob") == F
+
+
+def test_intersection():
+    o = make_oracle(
+        """
+        definition user {}
+        definition vault {
+            relation manager: user
+            relation auditor: user
+            permission open = manager & auditor
+        }
+        """,
+        [
+            ("vault:v#manager", "user:amy"),
+            ("vault:v#auditor", "user:amy"),
+            ("vault:v#manager", "user:bob"),
+        ],
+    )
+    assert o.check("vault", "v", "open", "user", "amy") == T
+    assert o.check("vault", "v", "open", "user", "bob") == F
+
+
+def test_wildcard():
+    o = make_oracle(
+        """
+        definition user {}
+        definition doc {
+            relation viewer: user | user:*
+            permission view = viewer
+        }
+        """,
+        [("doc:public#viewer", "user:*"), ("doc:private#viewer", "user:amy")],
+    )
+    assert o.check("doc", "public", "view", "user", "anyone") == T
+    assert o.check("doc", "private", "view", "user", "anyone") == F
+    # wildcard does not satisfy userset-subject queries
+    assert o.check("doc", "public", "view", "group", "g", "member") == F
+
+
+CAVEATED = """
+caveat on_weekday(day string) {
+    day != "saturday" && day != "sunday"
+}
+definition user {}
+definition doc {
+    relation viewer: user with on_weekday
+    permission view = viewer
+}
+"""
+
+
+def test_caveats_tri_state():
+    r = rel.must_from_triple("doc:d", "viewer", "user:amy").with_caveat("on_weekday", {})
+    o = make_oracle(CAVEATED, [r])
+    assert o.check("doc", "d", "view", "user", "amy", context={"day": "monday"}) == T
+    assert o.check("doc", "d", "view", "user", "amy", context={"day": "sunday"}) == F
+    # missing context → conditional
+    assert o.check("doc", "d", "view", "user", "amy") == U
+
+
+def test_caveat_stored_context_wins():
+    r = rel.must_from_triple("doc:d", "viewer", "user:amy").with_caveat(
+        "on_weekday", {"day": "monday"}
+    )
+    o = make_oracle(CAVEATED, [r])
+    # stored day=monday beats query day=sunday
+    assert o.check("doc", "d", "view", "user", "amy", context={"day": "sunday"}) == T
+
+
+def test_conditional_exclusion_stays_conditional():
+    # banned-with-caveat: if the ban is conditional, the grant is conditional
+    o = make_oracle(
+        """
+        caveat c(flag bool) { flag }
+        definition user {}
+        definition doc {
+            relation viewer: user
+            relation banned: user with c
+            permission view = viewer - banned
+        }
+        """,
+        [
+            rel.must_from_triple("doc:d", "viewer", "user:amy"),
+            rel.must_from_triple("doc:d", "banned", "user:amy").with_caveat("c", {}),
+        ],
+    )
+    assert o.check("doc", "d", "view", "user", "amy") == U
+    assert o.check("doc", "d", "view", "user", "amy", context={"flag": True}) == F
+    assert o.check("doc", "d", "view", "user", "amy", context={"flag": False}) == T
+
+
+def test_expiration():
+    now = dt.datetime.now(dt.timezone.utc)
+    now_us = int(now.timestamp() * 1_000_000)
+    o = make_oracle(
+        """
+        use expiration
+        definition user {}
+        definition door { relation opener: user with expiration
+                          permission open = opener }
+        """,
+        [
+            rel.must_from_triple("door:front", "opener", "user:old").with_expiration(
+                now - dt.timedelta(hours=1)
+            ),
+            rel.must_from_triple("door:front", "opener", "user:new").with_expiration(
+                now + dt.timedelta(hours=1)
+            ),
+        ],
+        now_us=now_us,
+    )
+    assert o.check("door", "front", "open", "user", "old") == F
+    assert o.check("door", "front", "open", "user", "new") == T
+
+
+def test_lookup_resources_and_subjects():
+    o = make_oracle(
+        EXAMPLE,
+        [
+            ("document:check_test1#writer", "user:alice"),
+            ("document:check_test1#reader", "user:bob"),
+            ("document:check_test1#writer", "user:charlie"),
+            ("document:check_test2#writer", "user:charlie"),
+        ],
+    )
+    # mirrors TestClient_LookupResources (client/client_test.go:107-139)
+    assert list(o.lookup_resources("document", "writer", "user", "alice")) == ["check_test1"]
+    assert list(o.lookup_resources("document", "writer", "user", "charlie")) == [
+        "check_test1",
+        "check_test2",
+    ]
+    assert list(o.lookup_subjects("document", "check_test1", "view", "user")) == [
+        "alice",
+        "bob",
+        "charlie",
+    ]
+
+
+def test_arrow_ignores_userset_and_wildcard_subjects():
+    o = make_oracle(
+        """
+        definition user {}
+        definition team { relation member: user }
+        definition folder { relation owner: user permission view = owner }
+        definition doc {
+            relation parent: folder | team#member
+            permission view = parent->view
+        }
+        """,
+        [
+            ("doc:d#parent", "team:t#member"),
+            ("folder:f#owner", "user:amy"),
+        ],
+    )
+    # the userset parent edge is skipped by the arrow; no folder edge exists
+    assert o.check("doc", "d", "view", "user", "amy") == F

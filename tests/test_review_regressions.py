@@ -1,0 +1,126 @@
+"""Regression tests for code-review findings (round 1, batch 2)."""
+
+import pytest
+
+from gochugaru_tpu import consistency, rel
+from gochugaru_tpu.caveats import compile_cel
+from gochugaru_tpu.engine.oracle import F, T, Oracle
+from gochugaru_tpu.schema import compile_schema, parse_schema
+from gochugaru_tpu.store.store import Store
+from gochugaru_tpu.utils.errors import AlreadyExistsError
+
+
+def test_write_is_atomic_on_create_conflict():
+    s = Store()
+    s.write_schema("definition user {}\ndefinition doc { relation viewer: user }")
+    t1 = rel.Txn()
+    t1.create(rel.must_from_triple("doc:a", "viewer", "user:alice"))
+    s.write(t1)
+    head = s.head_revision
+
+    t2 = rel.Txn()
+    t2.touch(rel.must_from_triple("doc:b", "viewer", "user:bob"))
+    t2.create(rel.must_from_triple("doc:a", "viewer", "user:alice"))  # conflict
+    with pytest.raises(AlreadyExistsError):
+        s.write(t2)
+    # nothing applied, no revision minted
+    assert len(s) == 1
+    assert s.head_revision == head
+
+
+def test_delete_then_create_same_key_in_one_txn():
+    s = Store()
+    s.write_schema("definition user {}\ndefinition doc { relation viewer: user }")
+    r = rel.must_from_triple("doc:a", "viewer", "user:alice")
+    t1 = rel.Txn()
+    t1.create(r)
+    s.write(t1)
+    t2 = rel.Txn()
+    t2.delete(r)
+    t2.create(r)
+    s.write(t2)  # legal: in-txn sequencing
+    assert len(s) == 1
+
+
+def test_read_filter_uses_interner_type_ids():
+    # Interner assigns type ids in first-seen order, schema sorts them —
+    # filters must translate through the interner's table.
+    s = Store()
+    s.write_schema(
+        "definition user {}\ndefinition zz_doc { relation viewer: user }\n"
+        "definition aa_doc { relation viewer: user }"
+    )
+    txn = rel.Txn()
+    txn.create(rel.must_from_triple("zz_doc:z", "viewer", "user:u"))
+    txn.create(rel.must_from_triple("aa_doc:a", "viewer", "user:u"))
+    s.write(txn)
+    got = list(s.read(consistency.full(), rel.new_filter("zz_doc", "", "")))
+    assert [r.resource_type for r in got] == ["zz_doc"]
+    f = rel.new_filter("zz_doc", "", "")
+    f.with_subject_filter("user", "u")
+    assert len(list(s.read(consistency.full(), f))) == 1
+
+
+def test_oracle_does_not_memoize_cycle_cut_values():
+    # grp1#member = {grp2#member, user:u}; grp2#member = {grp1#member};
+    # view = ra & rc where ra → grp1#member, rc → grp2#member.
+    # Both memberships are T; a stale cycle-cut memo made the & return F.
+    schema = """
+    definition user {}
+    definition grp { relation member: user | grp#member }
+    definition doc {
+        relation ra: grp#member
+        relation rc: grp#member
+        permission view = ra & rc
+    }
+    """
+    o = Oracle(
+        compile_schema(parse_schema(schema)),
+        [
+            rel.must_from_tuple("grp:1#member", "grp:2#member"),
+            rel.must_from_tuple("grp:1#member", "user:u"),
+            rel.must_from_tuple("grp:2#member", "grp:1#member"),
+            rel.must_from_tuple("doc:d#ra", "grp:1#member"),
+            rel.must_from_tuple("doc:d#rc", "grp:2#member"),
+        ],
+    )
+    assert o.check("grp", "1", "member", "user", "u") == T
+    assert o.check("grp", "2", "member", "user", "u") == T
+    assert o.check("doc", "d", "view", "user", "u") == T
+
+
+def test_import_rejects_intra_batch_duplicates_and_returns_token():
+    s = Store()
+    s.write_schema("definition user {}\ndefinition doc { relation viewer: user }")
+    r = rel.must_from_triple("doc:a", "viewer", "user:alice")
+    with pytest.raises(AlreadyExistsError):
+        s.import_relationships([r, r.with_caveat("", {})])
+    assert len(s) == 0
+    token = s.import_relationships([r])
+    assert token.startswith("gtz1.")
+
+
+def test_caveat_body_with_brace_in_string():
+    s = parse_schema(
+        'caveat c(s string) { s == "}" }\ndefinition user {}'
+    )
+    assert s.caveats["c"].expression == 's == "}"'
+    assert "user" in s.definitions
+
+
+def test_cel_string_escapes():
+    prog = compile_cel("c", {"s": "string"}, r's == "a\nb"')
+    assert prog.evaluate({"s": "a\nb"}) is True
+    assert prog.evaluate({"s": "anb"}) is False
+    prog2 = compile_cel("c", {"s": "string"}, r's == "A"')
+    assert prog2.evaluate({"s": "A"}) is True
+
+
+def test_naive_expiration_consistent_between_paths():
+    import datetime as dt
+
+    from gochugaru_tpu.rel.relationship import expiration_micros
+
+    naive = dt.datetime(2030, 1, 1, 12, 0, 0)
+    aware = naive.replace(tzinfo=dt.timezone.utc)
+    assert expiration_micros(naive) == expiration_micros(aware)

@@ -1,0 +1,282 @@
+"""Schema parser + compiler tests.
+
+The anchor spec is the reference integration-test schema
+(client/client_test.go:23-32); wider-language cases cover the operators,
+userset/wildcard subjects, caveats, and validation errors."""
+
+import pytest
+
+from gochugaru_tpu import rel
+from gochugaru_tpu.schema import (
+    Arrow,
+    Exclusion,
+    Intersection,
+    Nil,
+    RelationRef,
+    SchemaParseError,
+    SchemaValidationError,
+    Union,
+    compile_schema,
+    parse_schema,
+)
+
+EXAMPLE = """
+definition user {}
+definition document {
+    relation writer: user
+    relation reader: user
+
+    permission edit = writer
+    permission view = reader + edit
+}
+"""
+
+FOLDERS = """
+definition user {}
+definition group {
+    relation member: user | group#member
+}
+definition folder {
+    relation parent: folder
+    relation owner: user
+    permission view = owner + parent->view
+}
+definition document {
+    relation folder: folder
+    relation viewer: user | user:* | group#member
+    relation banned: user
+    permission view = (viewer + folder->view) - banned
+}
+"""
+
+
+def test_parse_example_schema():
+    s = parse_schema(EXAMPLE)
+    assert set(s.definitions) == {"user", "document"}
+    doc = s.definitions["document"]
+    assert set(doc.relations) == {"writer", "reader"}
+    assert set(doc.permissions) == {"edit", "view"}
+    assert doc.permissions["edit"].expr == RelationRef("writer")
+    assert doc.permissions["view"].expr == Union((RelationRef("reader"), RelationRef("edit")))
+
+
+def test_parse_operators_and_arrow():
+    s = parse_schema(FOLDERS)
+    doc = s.definitions["document"]
+    e = doc.permissions["view"].expr
+    assert isinstance(e, Exclusion)
+    assert e.base == Union((RelationRef("viewer"), Arrow("folder", "view")))
+    assert e.subtracted == RelationRef("banned")
+    grp = s.definitions["group"]
+    allowed = grp.relations["member"].allowed
+    assert [(a.type, a.relation, a.wildcard) for a in allowed] == [
+        ("user", "", False),
+        ("group", "member", False),
+    ]
+    viewer = doc.relations["viewer"].allowed
+    assert any(a.wildcard and a.type == "user" for a in viewer)
+
+
+def test_parse_intersection_and_nil():
+    s = parse_schema(
+        """
+        definition user {}
+        definition vault {
+            relation manager: user
+            relation auditor: user
+            permission open = manager & auditor
+            permission never = nil
+        }
+        """
+    )
+    v = s.definitions["vault"]
+    assert v.permissions["open"].expr == Intersection(
+        (RelationRef("manager"), RelationRef("auditor"))
+    )
+    assert v.permissions["never"].expr == Nil()
+
+
+def test_parse_caveat_decl():
+    s = parse_schema(
+        """
+        caveat only_on_tuesday(day string) {
+            day == "tuesday"
+        }
+        definition user {}
+        definition document {
+            relation viewer: user with only_on_tuesday
+        }
+        """
+    )
+    c = s.caveats["only_on_tuesday"]
+    assert c.params == {"day": "string"}
+    assert c.expression == 'day == "tuesday"'
+    a = s.definitions["document"].relations["viewer"].allowed[0]
+    assert a.caveat == "only_on_tuesday"
+
+
+def test_parse_expiration_trait():
+    s = parse_schema(
+        """
+        use expiration
+        definition user {}
+        definition door {
+            relation opener: user with expiration
+        }
+        """
+    )
+    a = s.definitions["door"].relations["opener"].allowed[0]
+    assert a.expiration and not a.caveat
+
+
+def test_parse_comments():
+    s = parse_schema(
+        """
+        // a line comment
+        definition user {} /* block
+        comment */ definition thing { relation owner: user }
+        """
+    )
+    assert set(s.definitions) == {"user", "thing"}
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "definition {",  # missing name
+        "definition d { relation r user }",  # missing colon
+        "definition d { permission p = }",  # empty expr
+        "definition d { relation r: user } definition d {}",  # dup definition
+        "definition d { relation r: user permission r = r }",  # dup item
+        "wat",
+    ],
+)
+def test_parse_errors(bad):
+    with pytest.raises(SchemaParseError):
+        parse_schema(bad)
+
+
+def test_chained_arrow_rejected():
+    with pytest.raises(SchemaParseError):
+        parse_schema(
+            """
+            definition a { relation b: a relation c: a permission p = b->c->p }
+            """
+        )
+
+
+# -- compiler --------------------------------------------------------------
+
+
+def test_compile_example():
+    cs = compile_schema(parse_schema(EXAMPLE))
+    assert set(cs.slot_of_name) == {"writer", "reader", "edit", "view"}
+    assert not cs.is_recursive
+    # view -> edit -> writer is the longest chain
+    assert cs.depth == 2
+    doc = cs.types[cs.type_id("document")]
+    assert set(doc.relations) == {cs.slot("writer"), cs.slot("reader")}
+    assert set(doc.permissions) == {cs.slot("edit"), cs.slot("view")}
+    assert cs.tupleset_pairs == frozenset()
+
+
+def test_compile_folders_recursion_and_tuplesets():
+    cs = compile_schema(parse_schema(FOLDERS))
+    assert cs.is_recursive  # group#member nests; folder view recurses via parent
+    assert (cs.type_id("folder"), cs.slot("parent")) in cs.tupleset_pairs
+    assert (cs.type_id("document"), cs.slot("folder")) in cs.tupleset_pairs
+    assert cs.slot("parent") in cs.tupleset_slots
+
+
+def test_compile_validation_errors():
+    with pytest.raises(SchemaValidationError):
+        compile_schema(parse_schema("definition d { relation r: ghost }"))
+    with pytest.raises(SchemaValidationError):
+        compile_schema(
+            parse_schema("definition u {} definition d { permission p = missing }")
+        )
+    with pytest.raises(SchemaValidationError):
+        compile_schema(
+            parse_schema(
+                "definition u {} definition d { relation r: u permission p = p2->x }"
+            )
+        )
+    with pytest.raises(SchemaValidationError):
+        # arrow LHS is a permission
+        compile_schema(
+            parse_schema(
+                """
+                definition u { relation boss: u permission admin = boss }
+                definition d {
+                    relation owner: u
+                    permission p = owner
+                    permission q = p->admin
+                }
+                """
+            )
+        )
+    with pytest.raises(SchemaValidationError):
+        # unknown caveat
+        compile_schema(
+            parse_schema("definition u {} definition d { relation r: u with ghost }")
+        )
+
+
+def test_validate_relationship():
+    cs = compile_schema(parse_schema(FOLDERS))
+    cs.validate_relationship(rel.must_from_triple("document:d1", "viewer", "user:u1"))
+    cs.validate_relationship(rel.must_from_tuple("document:d1#viewer", "group:g#member"))
+    cs.validate_relationship(rel.must_from_triple("document:d1", "viewer", "user:*"))
+
+    with pytest.raises(SchemaValidationError):  # unknown resource type
+        cs.validate_relationship(rel.must_from_triple("ghost:x", "viewer", "user:u"))
+    with pytest.raises(SchemaValidationError):  # write to a permission
+        cs.validate_relationship(rel.must_from_triple("document:d", "view", "user:u"))
+    with pytest.raises(SchemaValidationError):  # subject type not allowed
+        cs.validate_relationship(rel.must_from_triple("document:d", "banned", "group:g"))
+    with pytest.raises(SchemaValidationError):  # wildcard not allowed here
+        cs.validate_relationship(rel.must_from_triple("document:d", "banned", "user:*"))
+    with pytest.raises(SchemaValidationError):  # userset relation not allowed
+        cs.validate_relationship(
+            rel.must_from_tuple("document:d#viewer", "group:g#ghost")
+        )
+
+
+def test_validate_caveated_relationship():
+    cs = compile_schema(
+        parse_schema(
+            """
+            caveat tuesday(day string) { day == "tuesday" }
+            definition user {}
+            definition document {
+                relation viewer: user with tuesday
+                relation editor: user
+            }
+            """
+        )
+    )
+    cs.validate_relationship(
+        rel.must_from_triple("document:d", "viewer", "user:u").with_caveat("tuesday", {})
+    )
+    with pytest.raises(SchemaValidationError):  # caveat required but missing
+        cs.validate_relationship(rel.must_from_triple("document:d", "viewer", "user:u"))
+    with pytest.raises(SchemaValidationError):  # caveat not accepted
+        cs.validate_relationship(
+            rel.must_from_triple("document:d", "editor", "user:u").with_caveat("tuesday", {})
+        )
+
+
+def test_permission_userset_flag():
+    cs = compile_schema(
+        parse_schema(
+            """
+            definition user {}
+            definition team {
+                relation lead: user
+                permission manage = lead
+            }
+            definition doc { relation approver: team#manage }
+            """
+        )
+    )
+    assert cs.has_permission_usersets

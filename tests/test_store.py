@@ -1,0 +1,275 @@
+"""Store tests: write semantics, preconditions, revisions/consistency,
+reads, deletes, import, watch, and snapshot materialization."""
+
+import datetime as dt
+import threading
+
+import numpy as np
+import pytest
+
+from gochugaru_tpu import consistency, rel
+from gochugaru_tpu.schema.compiler import SchemaValidationError
+from gochugaru_tpu.store.store import Store, parse_revision
+from gochugaru_tpu.utils.errors import (
+    AlreadyExistsError,
+    PreconditionFailedError,
+    RevisionUnavailableError,
+)
+
+EXAMPLE = """
+definition user {}
+definition document {
+    relation writer: user
+    relation reader: user
+
+    permission edit = writer
+    permission view = reader + edit
+}
+"""
+
+
+def make_store():
+    s = Store()
+    s.write_schema(EXAMPLE)
+    return s
+
+
+def test_write_returns_increasing_revisions():
+    s = make_store()
+    txn = rel.Txn()
+    txn.create(rel.must_from_triple("document:a", "reader", "user:jim"))
+    r1 = s.write(txn)
+    txn2 = rel.Txn()
+    txn2.touch(rel.must_from_triple("document:a", "writer", "user:jim"))
+    r2 = s.write(txn2)
+    assert parse_revision(r2) > parse_revision(r1)
+
+
+def test_create_fails_on_duplicate_touch_upserts():
+    s = make_store()
+    r = rel.must_from_triple("document:a", "reader", "user:jim")
+    txn = rel.Txn()
+    txn.create(r)
+    s.write(txn)
+    dup = rel.Txn()
+    dup.create(r)
+    with pytest.raises(AlreadyExistsError):
+        s.write(dup)
+    up = rel.Txn()
+    up.touch(r)
+    s.write(up)  # idempotent
+    assert len(s) == 1
+
+
+def test_delete_removes_and_is_idempotent():
+    s = make_store()
+    r = rel.must_from_triple("document:a", "reader", "user:jim")
+    txn = rel.Txn()
+    txn.create(r)
+    s.write(txn)
+    d = rel.Txn()
+    d.delete(r)
+    s.write(d)
+    assert len(s) == 0
+    s.write(d)  # deleting nonexistent is a no-op
+    assert len(s) == 0
+
+
+def test_write_validates_against_schema():
+    s = make_store()
+    bad = rel.Txn()
+    bad.create(rel.must_from_triple("document:a", "ghost", "user:jim"))
+    with pytest.raises(SchemaValidationError):
+        s.write(bad)
+    perm = rel.Txn()
+    perm.create(rel.must_from_triple("document:a", "view", "user:jim"))
+    with pytest.raises(SchemaValidationError):
+        s.write(perm)  # cannot write to a permission
+
+
+def test_preconditions():
+    s = make_store()
+    guard = rel.must_from_triple("document:a", "writer", "user:amy").filter()
+    txn = rel.Txn()
+    txn.must_match(guard)
+    txn.create(rel.must_from_triple("document:a", "reader", "user:jim"))
+    with pytest.raises(PreconditionFailedError):
+        s.write(txn)  # nothing matches yet — atomic, nothing applied
+    assert len(s) == 0
+
+    setup = rel.Txn()
+    setup.create(rel.must_from_triple("document:a", "writer", "user:amy"))
+    s.write(setup)
+    s.write(txn)  # now the precondition holds
+    assert len(s) == 2
+
+    neg = rel.Txn()
+    neg.must_not_match(guard)
+    neg.touch(rel.must_from_triple("document:b", "reader", "user:jim"))
+    with pytest.raises(PreconditionFailedError):
+        s.write(neg)
+
+
+def test_schema_change_protects_live_relationships():
+    s = make_store()
+    txn = rel.Txn()
+    txn.create(rel.must_from_triple("document:a", "reader", "user:jim"))
+    s.write(txn)
+    with pytest.raises(SchemaValidationError):
+        s.write_schema("definition user {}\ndefinition document { relation writer: user }")
+    # original schema still live
+    text, _ = s.read_schema()
+    assert "reader" in text
+
+
+def test_read_with_filters():
+    s = make_store()
+    txn = rel.Txn()
+    txn.create(rel.must_from_triple("document:a", "reader", "user:jim"))
+    txn.create(rel.must_from_triple("document:a", "writer", "user:amy"))
+    txn.create(rel.must_from_triple("document:b", "reader", "user:amy"))
+    s.write(txn)
+
+    all_docs = list(s.read(consistency.full(), rel.new_filter("document", "", "")))
+    assert len(all_docs) == 3
+    a_only = list(s.read(consistency.full(), rel.new_filter("document", "a", "")))
+    assert {str(r) for r in a_only} == {
+        "document:a#reader@user:jim",
+        "document:a#writer@user:amy",
+    }
+    readers = list(s.read(consistency.full(), rel.new_filter("document", "", "reader")))
+    assert len(readers) == 2
+    f = rel.new_filter("document", "", "")
+    f.with_subject_filter("user", "amy")
+    amy = list(s.read(consistency.full(), f))
+    assert len(amy) == 2
+
+
+def test_consistency_strategies_pick_generations():
+    s = make_store()
+    txn = rel.Txn()
+    txn.create(rel.must_from_triple("document:a", "reader", "user:jim"))
+    rev1 = s.write(txn)
+    snap1 = s.snapshot_for(consistency.full())
+    assert snap1.revision == parse_revision(rev1)
+
+    txn2 = rel.Txn()
+    txn2.create(rel.must_from_triple("document:b", "reader", "user:jim"))
+    rev2 = s.write(txn2)
+
+    # min_latency returns the stale materialized generation
+    assert s.snapshot_for(consistency.min_latency()).revision == parse_revision(rev1)
+    # at_least forces a fresh one
+    assert s.snapshot_for(consistency.at_least(rev2)).revision == parse_revision(rev2)
+    # snapshot pins an exact cached generation
+    assert s.snapshot_for(consistency.snapshot(rev1)).revision == parse_revision(rev1)
+    with pytest.raises(RevisionUnavailableError):
+        s.snapshot_for(consistency.snapshot("gtz1.99999"))
+    with pytest.raises(RevisionUnavailableError):
+        s.snapshot_for(consistency.at_least("gtz1.99999"))
+
+
+def test_delete_by_filter():
+    s = make_store()
+    txn = rel.Txn()
+    for i in range(5):
+        txn.create(rel.must_from_triple(f"document:d{i}", "reader", "user:jim"))
+    txn.create(rel.must_from_triple("document:keep", "writer", "user:amy"))
+    s.write(txn)
+
+    pf = rel.new_preconditioned_filter(rel.new_filter("document", "", "reader"))
+    _, complete = s.delete_by_filter(pf, limit=3)
+    assert not complete and len(s) == 3
+    _, complete = s.delete_by_filter(pf, limit=3)
+    assert complete and len(s) == 1
+
+
+def test_import_raises_already_exists():
+    s = make_store()
+    rs = [rel.must_from_triple("document:a", "reader", "user:jim")]
+    s.import_relationships(rs)
+    with pytest.raises(AlreadyExistsError):
+        s.import_relationships(rs)
+
+
+def test_expired_relationships_hidden_from_reads():
+    s = make_store()
+    past = dt.datetime.now(dt.timezone.utc) - dt.timedelta(hours=1)
+    future = dt.datetime.now(dt.timezone.utc) + dt.timedelta(hours=1)
+    txn = rel.Txn()
+    txn.create(
+        rel.must_from_triple("document:a", "reader", "user:old").with_expiration(past)
+    )
+    txn.create(
+        rel.must_from_triple("document:a", "reader", "user:new").with_expiration(future)
+    )
+    s.write(txn)
+    got = {r.subject_id for r in s.read(consistency.full(), rel.new_filter("document", "", ""))}
+    assert got == {"new"}
+
+
+def test_watch_replay_and_live():
+    s = make_store()
+    txn = rel.Txn()
+    txn.create(rel.must_from_triple("document:a", "reader", "user:jim"))
+    s.write(txn)
+
+    stop = threading.Event()
+    seen = []
+
+    def consume():
+        for rev, u in s.updates_since(0, stop=stop, poll_interval=0.01):
+            seen.append((rev, u))
+            if len(seen) >= 2:
+                return
+
+    t = threading.Thread(target=consume)
+    t.start()
+    txn2 = rel.Txn()
+    txn2.delete(rel.must_from_triple("document:a", "reader", "user:jim"))
+    s.write(txn2)
+    t.join(timeout=5)
+    stop.set()
+    assert not t.is_alive()
+    assert [u.update_type for _, u in seen] == [rel.UpdateType.CREATE, rel.UpdateType.DELETE]
+    assert seen[0][0] < seen[1][0]
+
+
+def test_snapshot_columnar_views():
+    s = Store()
+    s.write_schema(
+        """
+        definition user {}
+        definition group { relation member: user | group#member }
+        definition folder { relation parent: folder relation owner: user
+                            permission view = owner + parent->view }
+        """
+    )
+    txn = rel.Txn()
+    txn.create(rel.must_from_triple("group:eng", "member", "user:amy"))
+    txn.create(rel.must_from_tuple("group:all#member", "group:eng#member"))
+    txn.create(rel.must_from_tuple("group:sup#member", "group:all#member"))
+    txn.create(rel.must_from_triple("folder:root", "owner", "user:amy"))
+    txn.create(rel.must_from_triple("folder:sub", "parent", "folder:root"))
+    s.write(txn)
+    snap = s.snapshot_for(consistency.full())
+
+    assert snap.num_edges == 5
+    # sorted keys
+    assert np.all(np.diff(snap.e_k1) >= 0)
+    # two userset edges (all#member@eng#member, sup#member@all#member)
+    assert snap.us_k1.shape[0] == 2
+    # membership seed: user:amy ∈ group:eng#member ((eng,member) is used as
+    # a subject).  Propagation: the group:all edge targets (all,member),
+    # which is itself used as a subject (by the group:sup edge); the
+    # group:sup edge targets (sup,member), which nothing references → pruned.
+    assert snap.ms_subj.shape[0] == 1
+    assert snap.mp_skey.shape[0] == 1
+    # arrow edge: folder:sub --parent--> folder:root
+    assert snap.ar_k1.shape[0] == 1
+    child_type, child_id = snap.interner.key_of(int(snap.ar_child[0]))
+    assert (child_type, child_id) == ("folder", "root")
+    # round-trip decode
+    rels = {str(r) for r in snap.iter_relationships()}
+    assert "folder:sub#parent@folder:root" in rels
+    assert "group:all#member@group:eng#member" in rels
