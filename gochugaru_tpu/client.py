@@ -1,0 +1,493 @@
+"""The Client: the ergonomic facade with the reference's full 18-method
+surface (client/client.go §2.1 of SURVEY.md), backed by the local TPU
+evaluation engine instead of a SpiceDB server.
+
+Where the reference dials gRPC (``NewPlaintext``/``NewSystemTLS``,
+client/client.go:38-61), this framework evaluates in-process: the
+constructors build a local store + engine.  Everything else keeps the same
+shape and semantics — consistency strategies select snapshot generations,
+``Check`` batches onto the device the way ``CheckBulkPermissions`` batches
+onto the wire, the retry taxonomy wraps the dispatch (transient device
+conditions play the role of gRPC Unavailable), the overlap-key guard
+raises on the same set of methods, and streaming methods are generators
+(Python's ``iter.Seq``).
+
+Check resolution is a three-tier cascade:
+1. **Device** (fast path): batched two-phase evaluation; definite answers
+   return immediately.
+2. **Host oracle** for the slice the device flagged: conditional results
+   (caveats needing context evaluation) and static-cap overflows.
+3. Schemas the device cannot evaluate at all (permission-valued userset
+   subjects) run entirely on the oracle.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from . import consistency as _consistency
+from .consistency import OVERLAP_KEY, Strategy
+from .engine.device import DeviceEngine, DeviceSnapshot
+from .engine.oracle import Oracle, T, U
+from .engine.plan import EngineConfig
+from .rel.filter import Filter, PreconditionedFilter
+from .rel.relationship import Relationship, RelationshipLike, as_relationship
+from .rel.strings import parse_object_set, parse_typed_relation
+from .rel.txn import Txn
+from .rel.update import Update, UpdateFilter
+from .store.snapshot import Snapshot
+from .store.store import Store, parse_revision
+from .utils import metrics as _metrics
+from .utils.context import Context
+from .utils.errors import (
+    AlreadyExistsError,
+    OverlapKeyMissingError,
+    PartialDeletionError,
+    UnavailableError,
+)
+from .utils.retry import retry_retriable_errors
+
+#: Batch/page sizes mirroring the reference's wire tuning
+#: (client/client.go:166,295,348,448).
+CHECK_CHUNK = 1000
+READ_PAGE = 512
+DELETE_BATCH = 10_000
+IMPORT_CHUNK = 1000
+
+
+class _Options:
+    def __init__(self) -> None:
+        self.overlap_required = False
+        self.engine_config: Optional[EngineConfig] = None
+        self.store: Optional[Store] = None
+        self.use_device = True
+
+
+Option = Callable[[_Options], None]
+
+
+def with_overlap_required() -> Option:
+    """Raise if a request lacks an overlap key (the reference panics,
+    client/client.go:84-86,182-191)."""
+
+    def opt(o: _Options) -> None:
+        o.overlap_required = True
+
+    return opt
+
+
+def with_engine_config(cfg: EngineConfig) -> Option:
+    """Tune the device evaluator's static caps — the local analogue of
+    WithDialOpts' escape hatch (client/client.go:95-97)."""
+
+    def opt(o: _Options) -> None:
+        o.engine_config = cfg
+
+    return opt
+
+
+def with_store(store: Store) -> Option:
+    """Share a Store between clients (e.g. one writer, many checkers)."""
+
+    def opt(o: _Options) -> None:
+        o.store = store
+
+    return opt
+
+
+def with_host_only_evaluation() -> Option:
+    """Disable the device engine; evaluate every check on the host oracle.
+    Useful for debugging and differential testing."""
+
+    def opt(o: _Options) -> None:
+        o.use_device = False
+
+    return opt
+
+
+class Client:
+    """An in-process authorization client with the gochugaru surface."""
+
+    def __init__(self, *opts: Option) -> None:
+        o = _Options()
+        for opt in opts:
+            opt(o)
+        self._store = o.store or Store()
+        self._overlap_required = o.overlap_required
+        self._engine_config = o.engine_config
+        self._use_device = o.use_device
+        self._lock = threading.Lock()
+        self._engine: Optional[DeviceEngine] = None
+        self._engine_schema = None  # CompiledSchema the engine was built for
+        self._dsnap_cache: Dict[int, DeviceSnapshot] = {}
+        self._oracle_cache: Dict[int, Oracle] = {}
+        self._metrics = _metrics.default
+
+    # -- store access (shared by watch etc.) -----------------------------
+    @property
+    def store(self) -> Store:
+        return self._store
+
+    # -- overlap guard (client/client.go:182-191) ------------------------
+    def _check_overlap(self, ctx: Context) -> None:
+        if self._overlap_required and ctx.value(OVERLAP_KEY) is None:
+            raise OverlapKeyMissingError()
+
+    # -- engine / oracle plumbing ----------------------------------------
+    def _engine_for(self, snap: Snapshot) -> Optional[DeviceEngine]:
+        if not self._use_device or snap.compiled.has_permission_usersets:
+            return None
+        with self._lock:
+            if self._engine is None or self._engine_schema is not snap.compiled:
+                self._engine = DeviceEngine(snap.compiled, self._engine_config)
+                self._engine_schema = snap.compiled
+                self._dsnap_cache.clear()
+            return self._engine
+
+    def _dsnap_for(self, engine: DeviceEngine, snap: Snapshot) -> DeviceSnapshot:
+        with self._lock:
+            ds = self._dsnap_cache.get(snap.revision)
+            if ds is None or ds.snapshot is not snap:
+                ds = engine.prepare(snap)
+                self._dsnap_cache[snap.revision] = ds
+                while len(self._dsnap_cache) > 4:
+                    self._dsnap_cache.pop(min(self._dsnap_cache))
+            return ds
+
+    def _oracle_for(self, snap: Snapshot) -> Oracle:
+        with self._lock:
+            o = self._oracle_cache.get(snap.revision)
+            if o is None:
+                o = Oracle(
+                    snap.compiled,
+                    snap.iter_relationships(None, None),
+                    {
+                        name: self._store.caveat_program(name)
+                        for name in snap.compiled.schema.caveats
+                    },
+                )
+                self._oracle_cache[snap.revision] = o
+                while len(self._oracle_cache) > 4:
+                    self._oracle_cache.pop(min(self._oracle_cache))
+            return o
+
+    # ------------------------------------------------------------------
+    # Writes (client/client.go:117-126 — deliberately NO retry wrapper)
+    # ------------------------------------------------------------------
+    def write(self, ctx: Context, txn: Txn) -> str:
+        """Atomically perform a transaction on relationships; returns the
+        revision it was written at."""
+        return self._store.write(txn)
+
+    # ------------------------------------------------------------------
+    # The Check family (client/client.go:128-180,238-284)
+    # ------------------------------------------------------------------
+    def check_one(self, ctx: Context, cs: Strategy, r: RelationshipLike) -> bool:
+        return self.check(ctx, cs, r)[0]
+
+    def check_any(self, ctx: Context, cs: Strategy, *rs: RelationshipLike) -> bool:
+        return any(self.check(ctx, cs, *rs))
+
+    def check_all(self, ctx: Context, cs: Strategy, *rs: RelationshipLike) -> bool:
+        return all(self.check(ctx, cs, *rs))
+
+    def check_iter(
+        self,
+        ctx: Context,
+        cs: Strategy,
+        rs: Iterable[RelationshipLike],
+        *,
+        chunk_size: int = CHECK_CHUNK,
+    ) -> Iterator[bool]:
+        """Batched streaming checks (client/client.go:164-180)."""
+        batch: List[RelationshipLike] = []
+        for r in rs:
+            batch.append(r)
+            if len(batch) >= chunk_size:
+                yield from self.check(ctx, cs, *batch)
+                batch.clear()
+        if batch:
+            yield from self.check(ctx, cs, *batch)
+
+    def check(self, ctx: Context, cs: Strategy, *rs: RelationshipLike) -> List[bool]:
+        """Batched permission check — the core path.  The reference folds N
+        checks into one CheckBulkPermissions RPC (client/client.go:238-266);
+        here they fold into one device dispatch, with host-oracle resolution
+        for conditional/overflowed items, wrapped in the same retry
+        envelope."""
+        self._check_overlap(ctx)
+        rels = [as_relationship(r) for r in rs]
+        if not rels:
+            return []
+        self._metrics.inc("checks.requested", len(rels))
+
+        def dispatch() -> List[bool]:
+            snap = self._store.snapshot_for(cs)
+            engine = self._engine_for(snap)
+            with self._metrics.timer("checks.dispatch"):
+                if engine is None:
+                    self._metrics.inc("checks.oracle", len(rels))
+                    oracle = self._oracle_for(snap)
+                    return [oracle.check_relationship(r) == T for r in rels]
+                dsnap = self._dsnap_for(engine, snap)
+                try:
+                    d, p, ovf = engine.check_batch(dsnap, rels)
+                except Exception as e:  # classify device dispatch failures
+                    msg = str(e)
+                    if "RESOURCE_EXHAUSTED" in msg or "UNAVAILABLE" in msg:
+                        raise UnavailableError(msg) from e
+                    raise
+                needs_host = (p & ~d) | ovf
+                if not needs_host.any():
+                    self._metrics.inc("checks.device_definite", len(rels))
+                    return [bool(x) for x in d]
+                oracle = self._oracle_for(snap)
+                out = []
+                for i, r in enumerate(rels):
+                    if needs_host[i]:
+                        self._metrics.inc(
+                            "checks.fallback_overflow"
+                            if ovf[i]
+                            else "checks.fallback_conditional"
+                        )
+                        out.append(oracle.check_relationship(r) == T)
+                    else:
+                        out.append(bool(d[i]))
+                return out
+
+        return retry_retriable_errors(ctx, dispatch)
+
+    # ------------------------------------------------------------------
+    # Reads (client/client.go:286-315)
+    # ------------------------------------------------------------------
+    def read_relationships(
+        self, ctx: Context, cs: Strategy, f: Filter
+    ) -> Iterator[Relationship]:
+        """Stream the relationships matching the filter.  The reference
+        pages server-side at 512 (client/client.go:295); locally the scan
+        is vectorized, and the generator honors context cancellation at
+        page boundaries."""
+        self._check_overlap(ctx)
+        count = 0
+        for r in self._store.read(cs, f):
+            err = ctx.err()
+            if err is not None and count % READ_PAGE == 0:
+                raise err
+            count += 1
+            yield r
+
+    # ------------------------------------------------------------------
+    # Deletes (client/client.go:317-358)
+    # ------------------------------------------------------------------
+    def delete_atomic(self, ctx: Context, pf: PreconditionedFilter) -> str:
+        """Remove all matching relationships in one transaction.
+        Explicitly NO retry (client/client.go:322)."""
+        self._check_overlap(ctx)
+        revision, complete = self._store.delete_by_filter(pf, limit=0)
+        if not complete:
+            raise PartialDeletionError(
+                "delete disallowing partial deletion did not complete"
+            )
+        return revision
+
+    def delete(self, ctx: Context, pf: PreconditionedFilter) -> None:
+        """Remove all matching relationships in batches of 10,000 with
+        retry (client/client.go:340-358)."""
+        self._check_overlap(ctx)
+        while True:
+            _, complete = retry_retriable_errors(
+                ctx, lambda: self._store.delete_by_filter(pf, limit=DELETE_BATCH)
+            )
+            if complete:
+                return
+
+    # ------------------------------------------------------------------
+    # Watch (client/client.go:360-413)
+    # ------------------------------------------------------------------
+    def updates(self, ctx: Context, f: UpdateFilter) -> Iterator[Update]:
+        return self.updates_since_revision(ctx, f, "")
+
+    def updates_since_revision(
+        self, ctx: Context, f: UpdateFilter, revision: str
+    ) -> Iterator[Update]:
+        """Subscribe to ordered, filtered, resumable updates.  Cancel via
+        the context, exactly like the reference's Watch loop
+        (client/client.go:394-411)."""
+        self._check_overlap(ctx)
+        if f.object_types and f.relationship_filters:
+            raise ValueError(
+                "UpdateFilter.object_types and relationship_filters are mutually"
+                " exclusive"
+            )
+        since = parse_revision(revision) if revision else 0
+        stop = threading.Event()
+
+        def watch() -> Iterator[Update]:
+            try:
+                for _rev, u in self._store.updates_since(
+                    since, stop=stop, poll_interval=0.05, cancelled=ctx.done
+                ):
+                    if ctx.done():
+                        return
+                    if f.admits(u):
+                        yield u
+                    if ctx.done():
+                        return
+            finally:
+                stop.set()
+
+        # poll the context from the consuming thread between items; the
+        # stop event ends the store-side wait loop
+        def gen() -> Iterator[Update]:
+            it = watch()
+            while True:
+                if ctx.done():
+                    stop.set()
+                    return
+                try:
+                    u = next(it)
+                except StopIteration:
+                    return
+                yield u
+
+        return gen()
+
+    # ------------------------------------------------------------------
+    # Schema (client/client.go:415-434)
+    # ------------------------------------------------------------------
+    def read_schema(self, ctx: Context) -> Tuple[str, str]:
+        """Read the current schema with full consistency; returns
+        (schema_text, revision)."""
+        return self._store.read_schema()
+
+    def write_schema(self, ctx: Context, schema: str) -> str:
+        """Apply the schema.  A schema leaving live relationships
+        unreferenced raises (client/client.go:426-427)."""
+        return self._store.write_schema(schema)
+
+    # ------------------------------------------------------------------
+    # Bulk import/export (client/client.go:436-499)
+    # ------------------------------------------------------------------
+    def import_relationships(
+        self, ctx: Context, rs: Iterable[RelationshipLike]
+    ) -> None:
+        """Bulk restore, optimized over Write.  Chunks of 1000; a chunk
+        that already exists falls back to a retried TOUCH transaction —
+        the same recovery the reference performs on AlreadyExists
+        (client/client.go:448-463)."""
+        chunk: List[Relationship] = []
+
+        def flush() -> None:
+            if not chunk:
+                return
+            try:
+                self._store.import_relationships(chunk)
+            except AlreadyExistsError:
+                def touch_all() -> str:
+                    txn = Txn()
+                    for r in chunk:
+                        txn.touch(r)
+                    return self._store.write(txn)
+
+                retry_retriable_errors(ctx, touch_all)
+            chunk.clear()
+
+        for r in rs:
+            chunk.append(as_relationship(r))
+            if len(chunk) >= IMPORT_CHUNK:
+                flush()
+        flush()
+
+    def export_relationships(
+        self, ctx: Context, revision: str
+    ) -> Iterator[Relationship]:
+        """Stream every relationship at an exact snapshot revision — the
+        backup half of backup/restore (client/client.go:467-499)."""
+        self._check_overlap(ctx)
+        for r in self._store.export_at(revision):
+            err = ctx.err()
+            if err is not None:
+                raise err
+            yield r
+
+    # ------------------------------------------------------------------
+    # Lookups (client/client.go:501-599)
+    # ------------------------------------------------------------------
+    def lookup_resources(
+        self, ctx: Context, cs: Strategy, permission: str, subject: str
+    ) -> Iterator[str]:
+        """Stream resource IDs the subject can access.
+        ``permission`` = "type#perm", ``subject`` = "type:id[#rel]"
+        (client/client.go:501-552)."""
+        self._check_overlap(ctx)
+        subj_type, subj_id, subj_rel = parse_object_set(subject)
+        obj_type, obj_rel = parse_typed_relation(permission)
+        snap = self._store.snapshot_for(cs)
+        oracle = self._oracle_for(snap)
+        for rid in oracle.lookup_resources(
+            obj_type, obj_rel, subj_type, subj_id, subj_rel
+        ):
+            err = ctx.err()
+            if err is not None:
+                raise err
+            yield rid
+
+    def lookup_subjects(
+        self, ctx: Context, cs: Strategy, resource: str, permission: str, subject: str
+    ) -> Iterator[str]:
+        """Stream subject IDs holding the permission on the resource.
+        ``resource`` = "type:id", ``subject`` = "type[#rel]"
+        (client/client.go:554-599)."""
+        self._check_overlap(ctx)
+        res_type, res_id, _ = parse_object_set(resource)
+        subj_type, _, subj_rel = subject.partition("#")
+        snap = self._store.snapshot_for(cs)
+        oracle = self._oracle_for(snap)
+        for sid in oracle.lookup_subjects(
+            res_type, res_id, permission, subj_type, subj_rel
+        ):
+            err = ctx.err()
+            if err is not None:
+                raise err
+            yield sid
+
+
+# ---------------------------------------------------------------------------
+# Constructors (client/client.go:35-77)
+# ---------------------------------------------------------------------------
+
+
+def new_tpu_evaluator(*opts: Option) -> Client:
+    """Create a client backed by the local TPU evaluation engine — the
+    constructor BASELINE.json names as the north star."""
+    return Client(*opts)
+
+
+def new_with_opts(*opts: Option) -> Client:
+    """Create a client with defaults overridden by options
+    (client/client.go:63-77)."""
+    return Client(*opts)
+
+
+def new_plaintext(endpoint: str = "", preshared_key: str = "", *opts: Option) -> Client:
+    """API-parity constructor (client/client.go:38-44).  The reference
+    dials an insecure gRPC channel; this framework evaluates locally, so
+    the endpoint and key are accepted for drop-in compatibility and
+    ignored."""
+    return Client(*opts)
+
+
+def new_system_tls(endpoint: str = "", preshared_key: str = "", *opts: Option) -> Client:
+    """API-parity constructor (client/client.go:50-61); see new_plaintext."""
+    return Client(*opts)
+
+
+# Go-parity aliases.
+NewTPUEvaluator = new_tpu_evaluator
+NewWithOpts = new_with_opts
+NewPlaintext = new_plaintext
+NewSystemTLS = new_system_tls
+WithOverlapRequired = with_overlap_required

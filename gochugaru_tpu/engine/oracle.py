@@ -79,7 +79,9 @@ class Oracle:
         self.compiled = compiled
         self.schema = compiled.schema
         self.caveat_programs = dict(caveat_programs or {})
-        self.now_us = now_us if now_us is not None else int(time.time() * 1_000_000)
+        #: pinned evaluation time; None = wall clock at each call (an Oracle
+        #: is cached per revision, so liveness must not freeze at build time)
+        self.now_us = now_us
         # (rtype, rid, relation) → edges
         self._by_onr: Dict[Tuple[str, str, str], List[_Edge]] = {}
         # candidate object ids per type (resources with any tuple)
@@ -102,9 +104,12 @@ class Oracle:
             self._subjects_of_type.setdefault(r.subject_type, set()).add(r.subject_id)
 
     # ------------------------------------------------------------------
-    def _edge_gate(self, e: _Edge, query_ctx: Mapping[str, Any]) -> int:
+    def _now_us(self) -> int:
+        return self.now_us if self.now_us is not None else int(time.time() * 1_000_000)
+
+    def _edge_gate(self, e: _Edge, query_ctx: Mapping[str, Any], now_us: int) -> int:
         """Tri-state admissibility of one edge: expiry mask and caveat."""
-        if e.expires_us and e.expires_us <= self.now_us:
+        if e.expires_us and e.expires_us <= now_us:
             return F
         if not e.caveat_name:
             return T
@@ -140,6 +145,7 @@ class Oracle:
         # final answer for siblings outside the cycle.
         cut_hits: Set[Tuple[str, str, str]] = set()
         ctx = context or {}
+        now_us = self._now_us()
         subject = (subject_type, subject_id, subject_relation)
 
         def eval_item(rtype: str, rid: str, item: str) -> int:
@@ -172,7 +178,7 @@ class Oracle:
         def eval_relation(rtype: str, rid: str, relation: str) -> int:
             out = F
             for e in self._by_onr.get((rtype, rid, relation), ()):  # noqa: B905
-                gate = self._edge_gate(e, ctx)
+                gate = self._edge_gate(e, ctx, now_us)
                 if gate == F:
                     continue
                 if e.subject_relation == "":
@@ -202,7 +208,7 @@ class Oracle:
                 for e in self._by_onr.get((rtype, rid, expr.left), ()):
                     if e.subject_relation != "" or e.subject_id == WILDCARD_ID:
                         continue  # arrows traverse direct (ellipsis) subjects
-                    gate = self._edge_gate(e, ctx)
+                    gate = self._edge_gate(e, ctx, now_us)
                     if gate == F:
                         continue
                     sub_def = self.schema.definitions.get(e.subject_type)

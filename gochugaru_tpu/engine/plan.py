@@ -1,0 +1,203 @@
+"""Static device-program structure compiled from a schema.
+
+``build_plan`` turns a CompiledSchema into the *static* structure the JAX
+engine's codegen closes over: tupleset slot numbering, the relation slots
+that need leaf tests, permission expressions lowered to nested tuples, a
+global topological update order, and schema-derived iteration bounds.  None
+of this touches tuple data — it is fixed at WriteSchema time, so the jitted
+check function is traced once per (schema, config, shape-bucket).
+
+``EngineConfig`` holds the static capacity caps (SURVEY.md §7 "hard parts":
+hop caps must be provably sufficient for non-recursive schemas — the
+``for_schema`` constructor derives them from the compiler's depth analysis;
+recursive schemas fall back to configurable caps with overflow detection
+and host-oracle fallback).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Dict, List, Optional, Tuple
+
+from ..schema.ast import (
+    Arrow,
+    Exclusion,
+    Expr,
+    Intersection,
+    Nil,
+    RelationRef,
+    Union,
+)
+from ..schema.compiler import CompiledSchema
+
+# Expression IR: nested tuples, all leaves static ints.
+#   ("ref", slot) ("arrow", ts_idx, right_slot) ("union", (c...))
+#   ("inter", (c...)) ("excl", base, sub) ("nil",)
+ExprIR = tuple
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Static capacity caps for the device evaluator.  Every cap has an
+    overflow flag on device; overflowing queries are re-checked on the host
+    oracle, so caps trade device coverage for speed, never correctness."""
+
+    closure_size: int = 256  # max usersets a subject transitively belongs to
+    seed_cap: int = 64  # max direct group memberships gathered per subject
+    prop_cap: int = 8  # max parents per userset per closure hop
+    closure_hops: int = 8  # userset-nesting depth walked on device
+    subgraph_nodes: int = 8  # max arrow-reachable nodes per resource
+    arrow_fanout: int = 4  # max tuples walked per (node, tupleset relation)
+    us_leaf_cap: int = 8  # max userset grants tested per (node, relation)
+    eval_iters: int = 2  # fixpoint iterations over the rewrite system
+    batch_bucket_min: int = 8  # pad batch/unique-subject counts to pow2 ≥ this
+
+    @staticmethod
+    def for_schema(compiled: CompiledSchema, **overrides) -> "EngineConfig":
+        cfg = EngineConfig()
+        userset_depth = _userset_depth(compiled)
+        has_arrows = bool(compiled.tupleset_pairs)
+        if userset_depth == 0:
+            cfg = replace(cfg, closure_hops=0)
+        elif userset_depth > 0:
+            cfg = replace(cfg, closure_hops=min(userset_depth, cfg.closure_hops))
+        # -1 (cyclic): keep the default cap.
+        if not has_arrows:
+            cfg = replace(cfg, subgraph_nodes=1, eval_iters=1)
+        elif not compiled.is_recursive:
+            # acyclic arrows: the subgraph is as deep as the longest arrow
+            # chain; one topo-ordered iteration resolves everything.
+            cfg = replace(
+                cfg,
+                subgraph_nodes=max(2, min(2 ** (compiled.depth), 32)),
+                eval_iters=1,
+            )
+        else:
+            # recursion through arrows (e.g. folder parent->view): value
+            # flows one node per iteration along the recursive chain.
+            cfg = replace(cfg, eval_iters=cfg.subgraph_nodes)
+        return replace(cfg, **overrides)
+
+
+def _userset_depth(compiled: CompiledSchema) -> int:
+    """Nesting depth of the relation-userset graph: 0 = no relation admits
+    userset subjects; -1 = cyclic (groups-in-groups); else the max depth."""
+    schema = compiled.schema
+    edges: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
+    for tname, d in schema.definitions.items():
+        for rname, relation in d.relations.items():
+            for a in relation.allowed:
+                if a.relation:
+                    edges.setdefault((tname, rname), []).append((a.type, a.relation))
+    if not edges:
+        return 0
+    memo: Dict[Tuple[str, str], int] = {}
+    stack: set = set()
+    cyclic = False
+
+    def depth(node: Tuple[str, str]) -> int:
+        nonlocal cyclic
+        if node in memo:
+            return memo[node]
+        if node in stack:
+            cyclic = True
+            return 0
+        stack.add(node)
+        d = 0
+        for nxt in edges.get(node, ()):  # noqa: B905
+            d = max(d, 1 + depth(nxt))
+        stack.discard(node)
+        memo[node] = d
+        return d
+
+    m = max(depth(n) for n in list(edges))
+    return -1 if cyclic else m
+
+
+@dataclass(frozen=True)
+class TypeProgram:
+    type_name: str
+    schema_tid: int
+    #: (perm_slot, expr_ir) pairs for this type
+    perms: Tuple[Tuple[int, ExprIR], ...]
+
+
+@dataclass(frozen=True)
+class DevicePlan:
+    """Everything static the device codegen needs."""
+
+    ts_slots: Tuple[int, ...]  # tupleset slots; index = ts_idx in arrays
+    rel_leaf_slots: Tuple[int, ...]  # relation slots needing leaf tests
+    #: (type_name, schema_tid, perm_slot, expr_ir), globally topo-ordered by
+    #: dependency depth so one fixpoint iteration resolves any acyclic chain
+    topo_programs: Tuple[Tuple[str, int, int, ExprIR], ...]
+    num_slots: int
+    two_plane: bool  # caveats present → track (definite, possible) planes
+    has_permission_usersets: bool
+    num_schema_types: int
+
+
+def _lower_expr(
+    e: Expr, ts_index: Dict[int, int], slot_of: Dict[str, int]
+) -> ExprIR:
+    if isinstance(e, RelationRef):
+        return ("ref", slot_of[e.name])
+    if isinstance(e, Arrow):
+        return ("arrow", ts_index[slot_of[e.left]], slot_of[e.right])
+    if isinstance(e, Union):
+        return ("union", tuple(_lower_expr(c, ts_index, slot_of) for c in e.children))
+    if isinstance(e, Intersection):
+        return ("inter", tuple(_lower_expr(c, ts_index, slot_of) for c in e.children))
+    if isinstance(e, Exclusion):
+        return (
+            "excl",
+            _lower_expr(e.base, ts_index, slot_of),
+            _lower_expr(e.subtracted, ts_index, slot_of),
+        )
+    if isinstance(e, Nil):
+        return ("nil",)
+    raise TypeError(f"unknown expression node {e!r}")
+
+
+def build_plan(compiled: CompiledSchema) -> DevicePlan:
+    ts_slots = tuple(sorted(compiled.tupleset_slots))
+    ts_index = {slot: i for i, slot in enumerate(ts_slots)}
+    slot_of = compiled.slot_of_name
+
+    rel_leaf = set()
+    for d in compiled.schema.definitions.values():
+        for rname in d.relations:
+            rel_leaf.add(slot_of[rname])
+
+    programs: List[Tuple[str, int, int, ExprIR]] = []
+    for tname, d in compiled.schema.definitions.items():
+        tid = compiled.type_ids[tname]
+        for pname, perm in d.permissions.items():
+            programs.append(
+                (
+                    tname,
+                    tid,
+                    slot_of[pname],
+                    _lower_expr(perm.expr, ts_index, slot_of),
+                )
+            )
+    # Global topological order by dependency depth: shallow first, so within
+    # one iteration every acyclic dependency is already updated when read.
+    programs.sort(key=lambda p: (compiled.item_depths.get((p[0], _name_of(compiled, p[2])), 0), p[0], p[2]))
+
+    return DevicePlan(
+        ts_slots=ts_slots,
+        rel_leaf_slots=tuple(sorted(rel_leaf)),
+        topo_programs=tuple(programs),
+        num_slots=max(compiled.num_slots, 1),
+        two_plane=bool(compiled.schema.caveats),
+        has_permission_usersets=compiled.has_permission_usersets,
+        num_schema_types=len(compiled.type_ids),
+    )
+
+
+def _name_of(compiled: CompiledSchema, slot: int) -> str:
+    for name, s in compiled.slot_of_name.items():
+        if s == slot:
+            return name
+    return ""

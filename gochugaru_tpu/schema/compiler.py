@@ -89,6 +89,11 @@ class CompiledSchema:
     #: permission — the device closure phase cannot expand those; the client
     #: routes affected checks to the host oracle
     has_permission_usersets: bool = False
+    #: acyclic dependency depth per (type_name, item_name) — cycle members
+    #: get their acyclic-part depth; used to topologically order permission
+    #: updates in the device fixpoint so each iteration propagates a full
+    #: dependency level
+    item_depths: Dict[Tuple[str, str], int] = field(default_factory=dict)
 
     # -- name helpers ------------------------------------------------------
     def slot(self, name: str) -> int:
@@ -348,4 +353,5 @@ def compile_schema(schema: Schema) -> CompiledSchema:
         depth=max_depth,
         is_recursive=recursive,
         has_permission_usersets=has_permission_usersets,
+        item_depths=dict(depth_memo),
     )

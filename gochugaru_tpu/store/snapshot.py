@@ -1,48 +1,58 @@
 """Columnar snapshot materialization.
 
 A Snapshot is the device-facing form of the tuple graph at one revision:
-sorted int64-keyed columnar arrays built once on the host, then shipped to
-TPU.  Four views cover every access pattern the evaluator needs, each a
-sorted array family binary-searchable on device:
+lexicographically sorted int32 columns built once on the host, then shipped
+to TPU.  Everything is int32 on purpose — TPU has no native int64, so keys
+are kept as column tuples compared lexicographically (custom binary search /
+multi-operand ``lax.sort``) instead of packed 64-bit scalars.  Expirations
+are epoch-relative seconds clipped into int32 around a per-snapshot epoch.
 
-- **primary** (``e_*``): every live edge sorted by (forward key, subject
-  key) — O(log E) exact-match direct/wildcard leaf tests.
-- **usersets** (``us_*``): edges with userset subjects sorted by forward
-  key — leaf tests gather the userset grants under (relation, resource).
+Four views cover every access pattern the evaluator needs:
+
+- **primary** (``e_*``): every live edge sorted by (rel, res, subj, srel) —
+  O(log E) exact-match direct/wildcard leaf tests.
+- **usersets** (``us_*``): edges with userset subjects sorted by (rel, res)
+  — leaf tests gather the userset grants under (relation, resource).
 - **membership** (``ms_*``/``mp_*``): the group-nesting subgraph — direct
-  seeds by subject node, userset propagation edges by subject userset key —
-  the Phase-A subject-closure BFS frontier arrays.  Restricted to usersets
-  that actually appear as tuple subjects, which keeps the closure the size
-  of the *group* structure rather than the whole grant set.
-- **arrows** (``ar_*``): edges of tupleset (arrow-LHS) relations by forward
-  key — the Phase-B resource-subgraph BFS.
-
-Key packing: ``fwd = rel_slot * num_nodes + res_node`` and
-``userset = node * num_slots + rel_slot`` (both < 2^40 for int64 safety at
-2^31 nodes × 2^8 slots).
+  seeds by subject node, userset propagation edges by (subject, srel) — the
+  Phase-A subject-closure BFS arrays.  Restricted to usersets that actually
+  appear as tuple subjects, which keeps the closure the size of the *group*
+  structure rather than the whole grant set.
+- **arrows** (``ar_*``): edges of tupleset (arrow-LHS) relations by
+  (rel, res) — the Phase-B resource-subgraph BFS.
 """
 
 from __future__ import annotations
 
 import datetime as _dt
 from dataclasses import dataclass, field
-from typing import Any, Dict, Iterator, List, Mapping, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterator, List, Mapping, Optional, Sequence
 
 import numpy as np
 
 from ..rel.filter import Filter
-from ..rel.relationship import Relationship, WILDCARD_ID
+from ..rel.relationship import Relationship, WILDCARD_ID, expiration_micros
 from ..schema.compiler import CompiledSchema
 from .interner import Interner
 
+#: int32 sentinel used to pad sorted key columns past the end.
+I32_MAX = np.int32(2**31 - 1)
 
-from ..rel.relationship import expiration_micros as _to_micros
 
-
-def _from_micros(us: int) -> Optional[_dt.datetime]:
-    if us == 0:
-        return None
-    return _dt.datetime.fromtimestamp(us / 1_000_000, tz=_dt.timezone.utc)
+def _exp_to_rel32(exp_us: np.ndarray, epoch_us: int) -> np.ndarray:
+    """Expiry micros → epoch-relative seconds in int32 (ceiling, so an
+    expiry never rounds earlier).  0 stays 0 ("no expiration"); an expiry
+    that would land exactly on 0 (i.e. at/before the snapshot epoch) maps
+    to -1 so it can't collide with the no-expiration sentinel; out-of-range
+    futures clip to I32_MAX-1 (still in the future for any plausible query
+    time)."""
+    rel = np.clip(
+        -(-(exp_us - epoch_us) // 1_000_000),  # ceil division
+        -(2**31) + 2,
+        2**31 - 2,
+    )
+    rel = np.where(rel == 0, np.int64(-1), rel)
+    return np.where(exp_us == 0, np.int64(0), rel).astype(np.int32)
 
 
 @dataclass
@@ -54,39 +64,50 @@ class Snapshot:
     interner: Interner
     num_nodes: int
     num_slots: int
-    node_type: np.ndarray  # int32[num_nodes]
-    wildcard_node_of_type: np.ndarray  # int32[num_types]; -1 = none
+    epoch_us: int  # expiration reference epoch (snapshot build time)
+    node_type: np.ndarray  # int32[num_nodes] INTERNER type ids
+    wildcard_node_of_type: np.ndarray  # int32[interner num_types]; -1 = none
 
-    # primary: all edges sorted by (e_k1, e_k2)
-    e_k1: np.ndarray  # int64[E]  rel_slot * num_nodes + res_node
-    e_k2: np.ndarray  # int64[E]  subj_node * (num_slots+1) + subj_rel_slot + 1
+    # primary: all edges sorted lex by (rel, res, subj, srel1)
+    e_rel: np.ndarray  # int32[E]
+    e_res: np.ndarray  # int32[E]
+    e_subj: np.ndarray  # int32[E]
+    e_srel1: np.ndarray  # int32[E]  subject relation slot + 1; 0 = direct
     e_caveat: np.ndarray  # int32[E]  0 = none
     e_ctx: np.ndarray  # int32[E]  index into contexts, -1 = none
-    e_exp: np.ndarray  # int64[E]  expiry micros, 0 = none
+    e_exp: np.ndarray  # int32[E]  epoch-relative expiry seconds, 0 = none
+    e_exp_us: np.ndarray  # int64[E] exact expiry micros (host-only; 0 = none)
 
-    # userset edges sorted by us_k1
-    us_k1: np.ndarray
-    us_key: np.ndarray  # int64  subj_node * num_slots + subj_rel_slot
+    # userset edges sorted lex by (rel, res)
+    us_rel: np.ndarray
+    us_res: np.ndarray
+    us_subj: np.ndarray
+    us_srel: np.ndarray  # subject relation slot (>= 0)
     us_caveat: np.ndarray
     us_ctx: np.ndarray
     us_exp: np.ndarray
 
     # membership seeds (direct edges into used usersets) sorted by ms_subj
-    ms_subj: np.ndarray  # int32
-    ms_key: np.ndarray  # int64  res_node * num_slots + rel_slot
+    ms_subj: np.ndarray
+    ms_res: np.ndarray
+    ms_rel: np.ndarray
     ms_caveat: np.ndarray
     ms_ctx: np.ndarray
     ms_exp: np.ndarray
 
-    # membership propagation (userset edges into used usersets) by mp_skey
-    mp_skey: np.ndarray  # int64  subj_node * num_slots + subj_rel_slot
-    mp_key: np.ndarray  # int64  res_node * num_slots + rel_slot
+    # membership propagation (userset edges into used usersets) sorted lex
+    # by (mp_subj, mp_srel)
+    mp_subj: np.ndarray
+    mp_srel: np.ndarray
+    mp_res: np.ndarray
+    mp_rel: np.ndarray
     mp_caveat: np.ndarray
     mp_ctx: np.ndarray
     mp_exp: np.ndarray
 
-    # arrow (tupleset) edges sorted by ar_k1
-    ar_k1: np.ndarray
+    # arrow (tupleset) edges sorted lex by (rel, res)
+    ar_rel: np.ndarray
+    ar_res: np.ndarray
     ar_child: np.ndarray  # int32 subject node
     ar_caveat: np.ndarray
     ar_ctx: np.ndarray
@@ -97,23 +118,24 @@ class Snapshot:
     # ------------------------------------------------------------------
     @property
     def num_edges(self) -> int:
-        return int(self.e_k1.shape[0])
+        return int(self.e_rel.shape[0])
 
-    def fwd_key(self, rel_slot: int, res_node: int) -> int:
-        return rel_slot * self.num_nodes + res_node
+    def now_rel32(self, now_us: Optional[int] = None) -> int:
+        """Query time in the snapshot's epoch-relative seconds."""
+        import time as _time
 
-    def userset_key(self, node: int, rel_slot: int) -> int:
-        return node * self.num_slots + rel_slot
+        if now_us is None:
+            now_us = int(_time.time() * 1_000_000)
+        return int(
+            np.clip((now_us - self.epoch_us) // 1_000_000, -(2**31) + 2, 2**31 - 2)
+        )
 
     # -- host-side reads ------------------------------------------------
     def decode_edge(self, i: int) -> Relationship:
-        k1 = int(self.e_k1[i])
-        k2 = int(self.e_k2[i])
-        rel_slot, res_node = divmod(k1, self.num_nodes)
-        subj_node, srel1 = divmod(k2, self.num_slots + 1)
-        rtype, rid = self.interner.key_of(res_node)
-        stype, sid = self.interner.key_of(subj_node)
+        rtype, rid = self.interner.key_of(int(self.e_res[i]))
+        stype, sid = self.interner.key_of(int(self.e_subj[i]))
         slot_names = self._slot_names()
+        srel1 = int(self.e_srel1[i])
         caveat_id = int(self.e_caveat[i])
         caveat_name = ""
         caveat_ctx: Mapping[str, Any] = {}
@@ -122,16 +144,22 @@ class Snapshot:
             ctx_i = int(self.e_ctx[i])
             if ctx_i >= 0:
                 caveat_ctx = self.contexts[ctx_i]
+        exp_us = int(self.e_exp_us[i])
+        expiration = None
+        if exp_us != 0:
+            expiration = _dt.datetime.fromtimestamp(
+                exp_us / 1_000_000, tz=_dt.timezone.utc
+            )
         return Relationship(
             resource_type=rtype,
             resource_id=rid,
-            resource_relation=slot_names[rel_slot],
+            resource_relation=slot_names[int(self.e_rel[i])],
             subject_type=stype,
             subject_id=sid,
             subject_relation=slot_names[srel1 - 1] if srel1 > 0 else "",
             caveat_name=caveat_name,
             caveat_context=caveat_ctx,
-            expiration=_from_micros(int(self.e_exp[i])),
+            expiration=expiration,
         )
 
     def _slot_names(self) -> Dict[int, str]:
@@ -149,54 +177,52 @@ class Snapshot:
     ) -> Iterator[Relationship]:
         """Filtered scan, vectorized on the interned columns; expired edges
         are excluded (they no longer grant, rel/relationship.go:43-45)."""
+        if self.num_edges == 0:
+            return
         mask = np.ones(self.num_edges, dtype=bool)
         if now_us is not None:
-            mask &= (self.e_exp == 0) | (self.e_exp > now_us)
-        if f is not None and self.num_edges:
-            rel_slot = self.e_k1 // self.num_nodes
-            res_node = self.e_k1 % self.num_nodes
-            subj_node = self.e_k2 // (self.num_slots + 1)
-            srel1 = self.e_k2 % (self.num_slots + 1)
+            mask &= (self.e_exp_us == 0) | (self.e_exp_us > now_us)
+        if f is not None:
             if f.resource_type != "":
                 # node_type holds INTERNER type ids, not schema type ids
                 tid = self.interner.type_lookup(f.resource_type)
                 if tid < 0:
                     return
-                mask &= self.node_type[res_node] == tid
+                mask &= self.node_type[self.e_res] == tid
             if f.optional_resource_id != "":
                 if f.resource_type == "":
                     return  # resource type is required by construction
                 n = self.interner.lookup(f.resource_type, f.optional_resource_id)
                 if n < 0:
                     return
-                mask &= res_node == n
+                mask &= self.e_res == n
             if f.optional_relation != "":
                 s = self.compiled.slot_of_name.get(f.optional_relation)
                 if s is None:
                     return
-                mask &= rel_slot == s
+                mask &= self.e_rel == s
             sf = f.optional_subject_filter
             if sf is not None:
                 if sf.subject_type != "":
                     tid = self.interner.type_lookup(sf.subject_type)
                     if tid < 0:
                         return
-                    mask &= self.node_type[subj_node] == tid
+                    mask &= self.node_type[self.e_subj] == tid
                 if sf.optional_subject_id != "":
                     if sf.subject_type == "":
                         return
                     n = self.interner.lookup(sf.subject_type, sf.optional_subject_id)
                     if n < 0:
                         return
-                    mask &= subj_node == n
+                    mask &= self.e_subj == n
                 if sf.optional_relation is not None:
                     if sf.optional_relation == "":
-                        mask &= srel1 == 0
+                        mask &= self.e_srel1 == 0
                     else:
                         s = self.compiled.slot_of_name.get(sf.optional_relation)
                         if s is None:
                             return
-                        mask &= srel1 == s + 1
+                        mask &= self.e_srel1 == s + 1
         for i in np.nonzero(mask)[0]:
             yield self.decode_edge(int(i))
 
@@ -206,19 +232,23 @@ def build_snapshot(
     compiled: CompiledSchema,
     interner: Interner,
     relationships: Sequence[Relationship],
+    *,
+    epoch_us: Optional[int] = None,
 ) -> Snapshot:
     """Materialize sorted columnar arrays from live relationships."""
-    num_nodes = max(len(interner), 1)
-    num_slots = max(compiled.num_slots, 1)
-    E = len(relationships)
+    import time as _time
 
+    if epoch_us is None:
+        epoch_us = int(_time.time() * 1_000_000)
+
+    E = len(relationships)
     res = np.empty(E, dtype=np.int64)
     rel_s = np.empty(E, dtype=np.int64)
     subj = np.empty(E, dtype=np.int64)
     srel = np.empty(E, dtype=np.int64)  # -1 = direct
     cav = np.zeros(E, dtype=np.int32)
     ctx = np.full(E, -1, dtype=np.int32)
-    exp = np.zeros(E, dtype=np.int64)
+    exp_us = np.zeros(E, dtype=np.int64)
     contexts: List[Mapping[str, Any]] = []
 
     slot_of = compiled.slot_of_name
@@ -233,58 +263,113 @@ def build_snapshot(
             if r.caveat_context:
                 ctx[i] = len(contexts)
                 contexts.append(r.caveat_context)
-        exp[i] = _to_micros(r.expiration)
+        exp_us[i] = expiration_micros(r.expiration) if r.has_expiration() else 0
+
+    return build_snapshot_from_columns(
+        revision, compiled, interner,
+        res=res, rel=rel_s, subj=subj, srel=srel,
+        caveat=cav, ctx=ctx, exp_us=exp_us,
+        contexts=contexts, epoch_us=epoch_us,
+    )
+
+
+def build_snapshot_from_columns(
+    revision: int,
+    compiled: CompiledSchema,
+    interner: Interner,
+    *,
+    res: np.ndarray,
+    rel: np.ndarray,
+    subj: np.ndarray,
+    srel: np.ndarray,
+    caveat: Optional[np.ndarray] = None,
+    ctx: Optional[np.ndarray] = None,
+    exp_us: Optional[np.ndarray] = None,
+    contexts: Optional[List[Mapping[str, Any]]] = None,
+    epoch_us: Optional[int] = None,
+) -> Snapshot:
+    """Materialize directly from pre-interned integer columns — the fast
+    bulk path synthetic benchmarks use so 100M+-edge graphs never pass
+    through per-tuple Python objects (SURVEY.md §7 "interning throughput
+    at 1B edges is the real bottleneck")."""
+    import time as _time
+
+    if epoch_us is None:
+        epoch_us = int(_time.time() * 1_000_000)
+    E = res.shape[0]
+    if caveat is None:
+        caveat = np.zeros(E, dtype=np.int32)
+    if ctx is None:
+        ctx = np.full(E, -1, dtype=np.int32)
+    if exp_us is None:
+        exp_us = np.zeros(E, dtype=np.int64)
+    contexts = contexts or []
+
+    res = res.astype(np.int64)
+    rel = rel.astype(np.int64)
+    subj = subj.astype(np.int64)
+    srel = srel.astype(np.int64)
+    exp32 = _exp_to_rel32(exp_us.astype(np.int64), epoch_us)
 
     node_type = interner.node_type_array()
-    num_nodes = max(len(interner), 1)  # interning above may have grown it
+    num_nodes = max(len(interner), 1)
+    num_slots = max(compiled.num_slots, 1)
+    if num_slots > 2**15:
+        raise ValueError("schemas with >32768 relation/permission names unsupported")
 
-    wc = np.full(interner.num_types, -1, dtype=np.int32)
-    for tname, tid_schema in compiled.type_ids.items():
+    wc = np.full(max(interner.num_types, 1), -1, dtype=np.int32)
+    for tname in compiled.type_ids:
         n = interner.lookup(tname, WILDCARD_ID)
         if n >= 0:
-            itid = interner.type_id(tname)
-            if itid < wc.shape[0]:
-                wc[itid] = n
+            wc[interner.type_lookup(tname)] = n
 
-    k1 = rel_s * num_nodes + res
-    k2 = subj * (num_slots + 1) + (srel + 1)
+    srel1 = srel + 1
 
-    order = np.lexsort((k2, k1))
-    e_k1, e_k2 = k1[order], k2[order]
-    e_cav, e_ctx, e_exp = cav[order], ctx[order], exp[order]
+    order = np.lexsort((srel1, subj, res, rel))
+    e_rel = rel[order].astype(np.int32)
+    e_res = res[order].astype(np.int32)
+    e_subj = subj[order].astype(np.int32)
+    e_srel1 = srel1[order].astype(np.int32)
+    e_cav = caveat[order]
+    e_ctx = ctx[order]
+    e_exp = exp32[order]
+    e_exp_us = exp_us.astype(np.int64)[order]
 
-    res_o, rel_o, subj_o, srel_o = res[order], rel_s[order], subj[order], srel[order]
+    res_o, rel_o, subj_o, srel_o = res[order], rel[order], subj[order], srel[order]
 
-    # userset view
+    # userset view (sorted by rel, res — inherited from the primary order)
     is_us = srel_o >= 0
-    us_sort = np.argsort(e_k1[is_us], kind="stable")
-    us_k1 = e_k1[is_us][us_sort]
-    us_key = (subj_o[is_us] * num_slots + srel_o[is_us])[us_sort]
-    us_cav = e_cav[is_us][us_sort]
-    us_ctx = e_ctx[is_us][us_sort]
-    us_exp = e_exp[is_us][us_sort]
+    us_rel = e_rel[is_us]
+    us_res = e_res[is_us]
+    us_subj = e_subj[is_us]
+    us_srel = srel_o[is_us].astype(np.int32)
+    us_cav = e_cav[is_us]
+    us_ctx = e_ctx[is_us]
+    us_exp = e_exp[is_us]
 
-    # usersets used as subjects anywhere
-    used = np.unique(us_key)
-
+    # usersets used as subjects anywhere (packed int64 keys, host-only)
+    us_subj_key = subj_o[is_us] * num_slots + srel_o[is_us]
+    used = np.unique(us_subj_key)
     edge_key = res_o * num_slots + rel_o  # the userset each edge grants
-
     feeds = np.isin(edge_key, used)
+
     # seeds: direct edges into used usersets, by subject node
     seed_mask = feeds & (srel_o < 0)
     seed_sort = np.argsort(subj_o[seed_mask], kind="stable")
     ms_subj = subj_o[seed_mask][seed_sort].astype(np.int32)
-    ms_key = edge_key[seed_mask][seed_sort]
+    ms_res = res_o[seed_mask][seed_sort].astype(np.int32)
+    ms_rel = rel_o[seed_mask][seed_sort].astype(np.int32)
     ms_cav = e_cav[seed_mask][seed_sort]
     ms_ctx = e_ctx[seed_mask][seed_sort]
     ms_exp = e_exp[seed_mask][seed_sort]
 
-    # propagation: userset edges into used usersets, by subject userset key
+    # propagation: userset edges into used usersets, by (subj, srel)
     prop_mask = feeds & (srel_o >= 0)
-    prop_skey = subj_o[prop_mask] * num_slots + srel_o[prop_mask]
-    prop_sort = np.argsort(prop_skey, kind="stable")
-    mp_skey = prop_skey[prop_sort]
-    mp_key = edge_key[prop_mask][prop_sort]
+    prop_sort = np.lexsort((srel_o[prop_mask], subj_o[prop_mask]))
+    mp_subj = subj_o[prop_mask][prop_sort].astype(np.int32)
+    mp_srel = srel_o[prop_mask][prop_sort].astype(np.int32)
+    mp_res = res_o[prop_mask][prop_sort].astype(np.int32)
+    mp_rel = rel_o[prop_mask][prop_sort].astype(np.int32)
     mp_cav = e_cav[prop_mask][prop_sort]
     mp_ctx = e_ctx[prop_mask][prop_sort]
     mp_exp = e_exp[prop_mask][prop_sort]
@@ -293,12 +378,12 @@ def build_snapshot(
     # traverse ellipsis subjects)
     ts_slots = np.asarray(sorted(compiled.tupleset_slots), dtype=np.int64)
     ar_mask = np.isin(rel_o, ts_slots) & (srel_o < 0)
-    ar_sort = np.argsort(e_k1[ar_mask], kind="stable")
-    ar_k1 = e_k1[ar_mask][ar_sort]
-    ar_child = subj_o[ar_mask][ar_sort].astype(np.int32)
-    ar_cav = e_cav[ar_mask][ar_sort]
-    ar_ctx = e_ctx[ar_mask][ar_sort]
-    ar_exp = e_exp[ar_mask][ar_sort]
+    ar_rel = e_rel[ar_mask]
+    ar_res = e_res[ar_mask]
+    ar_child = e_subj[ar_mask]
+    ar_cav = e_cav[ar_mask]
+    ar_ctx = e_ctx[ar_mask]
+    ar_exp = e_exp[ar_mask]
 
     return Snapshot(
         revision=revision,
@@ -306,12 +391,18 @@ def build_snapshot(
         interner=interner,
         num_nodes=num_nodes,
         num_slots=num_slots,
+        epoch_us=epoch_us,
         node_type=node_type,
         wildcard_node_of_type=wc,
-        e_k1=e_k1, e_k2=e_k2, e_caveat=e_cav, e_ctx=e_ctx, e_exp=e_exp,
-        us_k1=us_k1, us_key=us_key, us_caveat=us_cav, us_ctx=us_ctx, us_exp=us_exp,
-        ms_subj=ms_subj, ms_key=ms_key, ms_caveat=ms_cav, ms_ctx=ms_ctx, ms_exp=ms_exp,
-        mp_skey=mp_skey, mp_key=mp_key, mp_caveat=mp_cav, mp_ctx=mp_ctx, mp_exp=mp_exp,
-        ar_k1=ar_k1, ar_child=ar_child, ar_caveat=ar_cav, ar_ctx=ar_ctx, ar_exp=ar_exp,
+        e_rel=e_rel, e_res=e_res, e_subj=e_subj, e_srel1=e_srel1,
+        e_caveat=e_cav, e_ctx=e_ctx, e_exp=e_exp, e_exp_us=e_exp_us,
+        us_rel=us_rel, us_res=us_res, us_subj=us_subj, us_srel=us_srel,
+        us_caveat=us_cav, us_ctx=us_ctx, us_exp=us_exp,
+        ms_subj=ms_subj, ms_res=ms_res, ms_rel=ms_rel,
+        ms_caveat=ms_cav, ms_ctx=ms_ctx, ms_exp=ms_exp,
+        mp_subj=mp_subj, mp_srel=mp_srel, mp_res=mp_res, mp_rel=mp_rel,
+        mp_caveat=mp_cav, mp_ctx=mp_ctx, mp_exp=mp_exp,
+        ar_rel=ar_rel, ar_res=ar_res, ar_child=ar_child,
+        ar_caveat=ar_cav, ar_ctx=ar_ctx, ar_exp=ar_exp,
         contexts=contexts,
     )

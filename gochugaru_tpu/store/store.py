@@ -22,7 +22,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass
-from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
 
 from ..caveats import CelProgram, compile_cel
 from ..consistency import Requirement, Strategy
@@ -347,10 +347,13 @@ class Store:
     def updates_since(
         self, since_rev: int, *, stop: Optional[threading.Event] = None,
         poll_interval: float = 0.1,
+        cancelled: Optional[Callable[[], bool]] = None,
     ) -> Iterator[Tuple[int, Update]]:
         """Yield (revision, update) in log order, blocking for new writes.
         Resumable: pass the revision of the last seen entry
-        (client/client.go:370-382).  Ends when ``stop`` is set."""
+        (client/client.go:370-382).  Ends when ``stop`` is set or
+        ``cancelled()`` returns True (polled between waits, so a blocked
+        subscriber unblocks within ``poll_interval`` of cancellation)."""
         import bisect
 
         next_rev = since_rev
@@ -367,6 +370,8 @@ class Store:
                     if batch:
                         break
                     if stop is not None and stop.is_set():
+                        return
+                    if cancelled is not None and cancelled():
                         return
                     self._new_data.wait(timeout=poll_interval)
             for entry in batch:

@@ -1,0 +1,421 @@
+"""Client integration tests — the local mirror of the reference's
+dockerized integration suite (client/client_test.go).  Each test builds a
+fresh client (the analogue of `serve-testing`'s per-token isolated
+datastore) and exercises the full surface."""
+
+import threading
+import time
+
+import pytest
+
+from gochugaru_tpu import consistency, rel
+from gochugaru_tpu.client import (
+    Client,
+    new_plaintext,
+    new_tpu_evaluator,
+    new_with_opts,
+    with_host_only_evaluation,
+    with_overlap_required,
+)
+from gochugaru_tpu.utils.context import background
+from gochugaru_tpu.utils.errors import (
+    OverlapKeyMissingError,
+    PreconditionFailedError,
+)
+
+# the example schema from client/client_test.go:23-32
+EXAMPLE_SCHEMA = """
+definition user {}
+definition document {
+    relation writer: user
+    relation reader: user
+
+    permission edit = writer
+    permission view = reader + edit
+}
+"""
+
+
+def make_client(*opts):
+    ctx = background()
+    c = new_tpu_evaluator(*opts)
+    c.write_schema(ctx, EXAMPLE_SCHEMA)
+    return ctx, c
+
+
+# -- ExampleClient_ReadRelationships (client/client_test.go:73-105) --------
+
+def test_read_relationships_example():
+    ctx, c = make_client()
+    txn = rel.Txn()
+    txn.create(rel.must_from_triple("document:README", "reader", "user:jimmy"))
+    c.write(ctx, txn)
+
+    got = [
+        str(r)
+        for r in c.read_relationships(
+            ctx, consistency.min_latency(), rel.new_filter("document", "", "")
+        )
+    ]
+    assert got == ["document:README#reader@user:jimmy"]
+
+
+# -- TestClient_LookupResources (client/client_test.go:107-139) ------------
+
+def test_lookup_resources():
+    ctx, c = make_client()
+    txn = rel.Txn()
+    txn.create(rel.must_from_triple("document:check_test1", "writer", "user:alice"))
+    txn.create(rel.must_from_triple("document:check_test1", "reader", "user:bob"))
+    txn.create(rel.must_from_triple("document:check_test1", "writer", "user:charlie"))
+    txn.create(rel.must_from_triple("document:check_test2", "writer", "user:charlie"))
+    c.write(ctx, txn)
+
+    ids = list(c.lookup_resources(ctx, consistency.full(), "document#writer", "user:alice"))
+    assert ids == ["check_test1"]
+    ids = sorted(
+        c.lookup_resources(ctx, consistency.full(), "document#writer", "user:charlie")
+    )
+    assert ids == ["check_test1", "check_test2"]
+
+
+# -- TestClient_Check (client/client_test.go:141-216) ----------------------
+
+@pytest.fixture(params=["device", "host"])
+def check_client(request):
+    opts = () if request.param == "device" else (with_host_only_evaluation(),)
+    ctx, c = make_client(*opts)
+    txn = rel.Txn()
+    txn.create(rel.must_from_triple("document:check_test1", "writer", "user:alice"))
+    txn.create(rel.must_from_triple("document:check_test1", "reader", "user:bob"))
+    txn.create(rel.must_from_triple("document:check_test2", "writer", "user:charlie"))
+    c.write(ctx, txn)
+    return ctx, c
+
+
+def test_check_single_has_permission(check_client):
+    ctx, c = check_client
+    results = c.check(
+        ctx, consistency.min_latency(),
+        rel.must_from_triple("document:check_test1", "edit", "user:alice"),
+    )
+    assert results == [True]
+
+
+def test_check_single_no_permission(check_client):
+    ctx, c = check_client
+    results = c.check(
+        ctx, consistency.min_latency(),
+        rel.must_from_triple("document:check_test1", "edit", "user:bob"),
+    )
+    assert results == [False]
+
+
+def test_check_multiple(check_client):
+    ctx, c = check_client
+    results = c.check(
+        ctx, consistency.min_latency(),
+        rel.must_from_triple("document:check_test1", "edit", "user:alice"),
+        rel.must_from_triple("document:check_test1", "view", "user:bob"),
+        rel.must_from_triple("document:check_test2", "edit", "user:charlie"),
+        rel.must_from_triple("document:check_test2", "view", "user:alice"),
+    )
+    assert results == [True, True, True, False]
+
+
+def test_check_consistency_strategies(check_client):
+    ctx, c = check_client
+    for strategy in (consistency.min_latency(), consistency.full()):
+        results = c.check(
+            ctx, strategy,
+            rel.must_from_triple("document:check_test1", "edit", "user:alice"),
+        )
+        assert results == [True]
+
+
+def test_check_empty(check_client):
+    ctx, c = check_client
+    assert c.check(ctx, consistency.min_latency()) == []
+
+
+def test_check_nonexistent_resource(check_client):
+    ctx, c = check_client
+    results = c.check(
+        ctx, consistency.min_latency(),
+        rel.must_from_triple("document:nonexistent", "edit", "user:alice"),
+    )
+    assert results == [False]
+
+
+# -- README founders example (README.md:64-89) -----------------------------
+
+def test_readme_founders_check_all():
+    ctx, c = make_client()
+    c.write_schema(
+        ctx,
+        "definition user {}\ndefinition company { relation founder: user }",
+    )
+    txn = rel.Txn()
+    founders = [
+        rel.from_triple("company:authzed", "founder", "user:" + f)
+        for f in ("jake", "joey", "jimmy")
+    ]
+    for f in founders:
+        txn.touch(f)
+    c.write(ctx, txn)
+
+    assert c.check_all(ctx, consistency.min_latency(), *founders)
+    assert not c.check_all(
+        ctx, consistency.min_latency(), *founders,
+        rel.must_from_triple("company:authzed", "founder", "user:impostor"),
+    )
+    assert c.check_any(
+        ctx, consistency.min_latency(),
+        rel.must_from_triple("company:authzed", "founder", "user:impostor"),
+        rel.must_from_triple("company:authzed", "founder", "user:jake"),
+    )
+    assert c.check_one(ctx, consistency.min_latency(), founders[0])
+
+
+# -- check_iter batching (client/client.go:164-180) ------------------------
+
+def test_check_iter():
+    ctx, c = make_client()
+    txn = rel.Txn()
+    for i in range(0, 10, 2):
+        txn.create(rel.must_from_triple(f"document:d{i}", "reader", "user:amy"))
+    c.write(ctx, txn)
+    checks = [
+        rel.must_from_triple(f"document:d{i}", "view", "user:amy") for i in range(10)
+    ]
+    got = list(c.check_iter(ctx, consistency.full(), checks, chunk_size=3))
+    assert got == [i % 2 == 0 for i in range(10)]
+
+
+# -- read-after-write with at_least (consistency/consistency.go:54-62) -----
+
+def test_read_after_write_at_least():
+    ctx, c = make_client()
+    txn = rel.Txn()
+    txn.create(rel.must_from_triple("document:new", "reader", "user:amy"))
+    rev = c.write(ctx, txn)
+    assert c.check_one(
+        ctx, consistency.at_least(rev),
+        rel.must_from_triple("document:new", "view", "user:amy"),
+    )
+
+
+# -- writes with preconditions (README.md:101-111) -------------------------
+
+def test_write_precondition_flow():
+    ctx, c = make_client()
+    c.write_schema(
+        ctx,
+        "definition user {}\ndefinition module {"
+        " relation creator: user relation maintainer: user }",
+    )
+    txn = rel.Txn()
+    for rival in ("joey", "jake"):
+        txn.must_not_match(
+            rel.must_from_triple("module:gochugaru", "creator", "user:" + rival).filter()
+        )
+    txn.touch(rel.must_from_triple("module:gochugaru", "creator", "user:jimmy"))
+    rev = c.write(ctx, txn)
+    assert rev
+
+    # now a rival exists → precondition fails
+    t2 = rel.Txn()
+    t2.touch(rel.must_from_triple("module:gochugaru", "creator", "user:joey"))
+    c.write(ctx, t2)
+    with pytest.raises(PreconditionFailedError):
+        c.write(ctx, txn)
+
+
+# -- deletes (client/client.go:317-358) ------------------------------------
+
+def test_delete_and_delete_atomic():
+    ctx, c = make_client()
+    txn = rel.Txn()
+    for i in range(7):
+        txn.create(rel.must_from_triple(f"document:d{i}", "reader", "user:amy"))
+    c.write(ctx, txn)
+
+    pf = rel.new_preconditioned_filter(rel.new_filter("document", "d0", ""))
+    rev = c.delete_atomic(ctx, pf)
+    assert rev
+    remaining = list(
+        c.read_relationships(ctx, consistency.full(), rel.new_filter("document", "", ""))
+    )
+    assert len(remaining) == 6
+
+    c.delete(ctx, rel.new_preconditioned_filter(rel.new_filter("document", "", "")))
+    assert (
+        list(
+            c.read_relationships(
+                ctx, consistency.full(), rel.new_filter("document", "", "")
+            )
+        )
+        == []
+    )
+
+
+# -- import/export (client/client.go:436-499) ------------------------------
+
+def test_import_and_export():
+    ctx, c = make_client()
+    rs = [
+        rel.must_from_triple(f"document:d{i}", "reader", f"user:u{i % 3}")
+        for i in range(10)
+    ]
+    c.import_relationships(ctx, iter(rs))
+    # importing again hits AlreadyExists and falls back to TOUCH
+    c.import_relationships(ctx, iter(rs))
+    _, rev = c.read_schema(ctx)
+    # pin the export at the current head by materializing it
+    c.check_one(
+        ctx, consistency.full(),
+        rel.must_from_triple("document:d0", "view", "user:u0"),
+    )
+    exported = sorted(str(r) for r in c.export_relationships(ctx, rev))
+    assert len(exported) == 10
+    assert exported[0].startswith("document:d0#reader@")
+
+
+# -- watch (client/client.go:360-413) --------------------------------------
+
+def test_updates_stream_and_resume():
+    ctx, c = make_client()
+    txn = rel.Txn()
+    txn.create(rel.must_from_triple("document:a", "reader", "user:amy"))
+    rev1 = c.write(ctx, txn)
+
+    seen = []
+    wctx = ctx.with_cancel()
+
+    def consume():
+        for u in c.updates(wctx, rel.UpdateFilter()):
+            seen.append(u)
+            if len(seen) >= 2:
+                return
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.05)
+    t2 = rel.Txn()
+    t2.delete(rel.must_from_triple("document:a", "reader", "user:amy"))
+    c.write(ctx, t2)
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert [u.update_type for u in seen] == [rel.UpdateType.CREATE, rel.UpdateType.DELETE]
+
+    # resume from rev1: only the delete replays
+    resumed = []
+    for u in c.updates_since_revision(wctx, rel.UpdateFilter(), rev1):
+        resumed.append(u)
+        break
+    assert resumed[0].update_type == rel.UpdateType.DELETE
+
+    # cancellation ends the stream
+    wctx.cancel()
+    assert list(c.updates(wctx, rel.UpdateFilter())) == []
+
+
+def test_updates_filters():
+    ctx, c = make_client()
+    c.write_schema(
+        ctx,
+        "definition user {}\ndefinition doc { relation viewer: user }\n"
+        "definition folder { relation viewer: user }",
+    )
+    txn = rel.Txn()
+    txn.create(rel.must_from_triple("doc:a", "viewer", "user:amy"))
+    txn.create(rel.must_from_triple("folder:f", "viewer", "user:amy"))
+    c.write(ctx, txn)
+
+    wctx = ctx.with_cancel()
+    got = []
+    f = rel.UpdateFilter(object_types=["doc"])
+    for u in c.updates(wctx, f):
+        got.append(u)
+        break
+    assert [u.relationship.resource_type for u in got] == ["doc"]
+    with pytest.raises(ValueError):
+        next(
+            c.updates(
+                wctx,
+                rel.UpdateFilter(
+                    object_types=["doc"],
+                    relationship_filters=[rel.new_filter("doc", "", "")],
+                ),
+            )
+        )
+
+
+# -- lookup_subjects (client/client.go:554-599) ----------------------------
+
+def test_lookup_subjects():
+    ctx, c = make_client()
+    txn = rel.Txn()
+    txn.create(rel.must_from_triple("document:README", "writer", "user:alice"))
+    txn.create(rel.must_from_triple("document:README", "reader", "user:bob"))
+    c.write(ctx, txn)
+    subjects = sorted(
+        c.lookup_subjects(ctx, consistency.full(), "document:README", "view", "user")
+    )
+    assert subjects == ["alice", "bob"]
+
+
+# -- TestMissingOverlapPanic (client/client_test.go:218-277) ---------------
+
+def test_missing_overlap_raises():
+    ctx = background()
+    c = new_with_opts(with_overlap_required())
+    c.write_schema(ctx, EXAMPLE_SCHEMA)  # schema ops are exempt, as in the ref
+
+    pf = rel.new_preconditioned_filter(rel.new_filter("document", "", ""))
+    cases = [
+        lambda: next(
+            c.read_relationships(ctx, consistency.full(), rel.new_filter("document", "", "")),
+            None,
+        ),
+        lambda: next(c.export_relationships(ctx, "gtz1.1"), None),
+        lambda: c.check_one(
+            ctx, consistency.full(),
+            rel.must_from_triple("document:README", "view", "user:bot"),
+        ),
+        lambda: c.delete_atomic(ctx, pf),
+        lambda: c.delete(ctx, pf),
+        lambda: next(iter(c.updates(ctx, rel.UpdateFilter())), None),
+        lambda: next(
+            c.lookup_resources(ctx, consistency.full(), "document#writer", "user:alice"),
+            None,
+        ),
+        lambda: next(
+            c.lookup_subjects(ctx, consistency.full(), "document:x", "view", "user"),
+            None,
+        ),
+    ]
+    for i, case in enumerate(cases):
+        with pytest.raises(OverlapKeyMissingError):
+            case()
+
+    # provided overlap key doesn't raise
+    okctx = consistency.with_overlap_key(ctx, "test")
+    c.check_one(
+        okctx, consistency.full(),
+        rel.must_from_triple("document:README", "view", "user:bot"),
+    )
+
+
+def test_constructor_parity():
+    # the reference's constructors exist and return working local clients
+    ctx = background()
+    for c in (new_plaintext("127.0.0.1:50051", "key"), new_with_opts()):
+        c.write_schema(ctx, EXAMPLE_SCHEMA)
+        txn = rel.Txn()
+        txn.create(rel.must_from_triple("document:x", "reader", "user:u"))
+        c.write(ctx, txn)
+        assert c.check_one(
+            ctx, consistency.full(),
+            rel.must_from_triple("document:x", "view", "user:u"),
+        )

@@ -1,0 +1,321 @@
+"""Device-engine tests: every check differentially validated against the
+oracle (the tier-2 strategy from SURVEY.md §4 — the oracle plays the role
+of `spicedb serve-testing`)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from gochugaru_tpu import rel
+from gochugaru_tpu.caveats import compile_cel
+from gochugaru_tpu.engine.device import DeviceEngine
+from gochugaru_tpu.engine.oracle import F, T, U, Oracle
+from gochugaru_tpu.engine.plan import EngineConfig
+from gochugaru_tpu.schema import compile_schema, parse_schema
+from gochugaru_tpu.store.interner import Interner
+from gochugaru_tpu.store.snapshot import build_snapshot
+
+
+def setup(schema_text, tuples, config=None, now_us=1_700_000_000_000_000):
+    cs = compile_schema(parse_schema(schema_text))
+    rels = [t if isinstance(t, rel.Relationship) else rel.must_from_tuple(*t) for t in tuples]
+    interner = Interner()
+    snap = build_snapshot(1, cs, interner, rels, epoch_us=now_us)
+    programs = {
+        name: compile_cel(name, decl.params, decl.expression)
+        for name, decl in cs.schema.caveats.items()
+    }
+    oracle = Oracle(cs, rels, programs, now_us=now_us)
+    engine = DeviceEngine(cs, config)
+    dsnap = engine.prepare(snap)
+    return engine, dsnap, oracle, now_us
+
+
+def run_checks(engine, dsnap, oracle, now_us, queries):
+    """queries: list of (resource, permission, subject) triple strings.
+    Asserts device (definite, possible) matches oracle tri-state."""
+    rels = [rel.must_from_triple(r, p, s) for (r, p, s) in queries]
+    d, p, ovf = engine.check_batch(dsnap, rels, now_us=now_us)
+    for i, (r, pm, s) in enumerate(queries):
+        tri = oracle.check_relationship(rels[i])
+        assert not ovf[i], f"unexpected overflow for {queries[i]}"
+        assert d[i] == (tri == T), f"{queries[i]}: device definite={d[i]} oracle={tri}"
+        assert p[i] == (tri >= U), f"{queries[i]}: device possible={p[i]} oracle={tri}"
+
+
+EXAMPLE = """
+definition user {}
+definition document {
+    relation writer: user
+    relation reader: user
+    permission edit = writer
+    permission view = reader + edit
+}
+"""
+
+
+def test_reference_matrix_on_device():
+    engine, dsnap, oracle, now = setup(
+        EXAMPLE,
+        [
+            ("document:t1#writer", "user:alice"),
+            ("document:t1#reader", "user:bob"),
+            ("document:t2#writer", "user:charlie"),
+        ],
+    )
+    run_checks(
+        engine, dsnap, oracle, now,
+        [
+            ("document:t1", "edit", "user:alice"),
+            ("document:t1", "edit", "user:bob"),
+            ("document:t1", "view", "user:bob"),
+            ("document:t1", "view", "user:alice"),
+            ("document:t2", "edit", "user:charlie"),
+            ("document:t2", "view", "user:alice"),
+            ("document:nonexistent", "edit", "user:alice"),
+            ("document:t1", "ghost", "user:alice"),
+            ("document:t1", "edit", "user:ghost"),
+        ],
+    )
+
+
+NESTED = """
+definition user {}
+definition group { relation member: user | group#member }
+definition document {
+    relation viewer: group#member
+    permission view = viewer
+}
+"""
+
+
+def test_nested_groups_on_device():
+    engine, dsnap, oracle, now = setup(
+        NESTED,
+        [
+            ("group:leaf#member", "user:amy"),
+            ("group:mid#member", "group:leaf#member"),
+            ("group:top#member", "group:mid#member"),
+            ("document:d#viewer", "group:top#member"),
+            ("document:e#viewer", "group:leaf#member"),
+        ],
+    )
+    run_checks(
+        engine, dsnap, oracle, now,
+        [
+            ("document:d", "view", "user:amy"),
+            ("document:e", "view", "user:amy"),
+            ("document:d", "view", "user:bob"),
+            ("group:top", "member", "user:amy"),
+            ("group:mid", "member", "user:amy"),
+        ],
+    )
+
+
+def test_userset_self_identity_on_device():
+    engine, dsnap, oracle, now = setup(
+        NESTED, [("document:d#viewer", "group:g#member")]
+    )
+    rels = [
+        rel.must_from_tuple("group:g#member", "group:g#member"),
+        rel.must_from_tuple("document:d#view", "group:g#member"),
+    ]
+    d, p, ovf = engine.check_batch(dsnap, rels, now_us=now)
+    assert d[0] and d[1]
+    assert oracle.check_relationship(rels[0]) == T
+    assert oracle.check_relationship(rels[1]) == T
+
+
+FOLDERS = """
+definition user {}
+definition folder {
+    relation parent: folder
+    relation owner: user
+    permission view = owner + parent->view
+}
+definition document {
+    relation folder: folder
+    relation viewer: user
+    relation banned: user
+    permission view = (viewer + folder->view) - banned
+}
+"""
+
+
+def test_folder_recursion_on_device():
+    triples = [("folder:f0#owner", "user:root")]
+    for i in range(1, 6):
+        triples.append((f"folder:f{i}#parent", f"folder:f{i-1}"))
+    triples.append(("document:d#folder", "folder:f5"))
+    triples.append(("document:d#viewer", "user:amy"))
+    triples.append(("document:d#banned", "user:amy"))
+    engine, dsnap, oracle, now = setup(FOLDERS, triples)
+    run_checks(
+        engine, dsnap, oracle, now,
+        [
+            ("document:d", "view", "user:root"),  # 5-hop arrow chain
+            ("folder:f5", "view", "user:root"),
+            ("folder:f0", "view", "user:root"),
+            ("document:d", "view", "user:amy"),  # banned beats viewer
+            ("document:d", "view", "user:other"),
+        ],
+    )
+
+
+def test_intersection_and_wildcard_on_device():
+    engine, dsnap, oracle, now = setup(
+        """
+        definition user {}
+        definition vault {
+            relation manager: user
+            relation auditor: user | user:*
+            permission open = manager & auditor
+        }
+        """,
+        [
+            ("vault:v#manager", "user:amy"),
+            ("vault:v#auditor", "user:amy"),
+            ("vault:v#manager", "user:bob"),
+            ("vault:w#manager", "user:cat"),
+            ("vault:w#auditor", "user:*"),
+        ],
+    )
+    run_checks(
+        engine, dsnap, oracle, now,
+        [
+            ("vault:v", "open", "user:amy"),
+            ("vault:v", "open", "user:bob"),
+            ("vault:w", "open", "user:cat"),  # wildcard satisfies auditor
+            ("vault:w", "open", "user:amy"),
+            ("vault:w", "auditor", "user:never_seen"),  # wildcard, unknown subject
+        ],
+    )
+
+
+def test_caveats_flow_to_possible_plane():
+    r1 = rel.must_from_triple("doc:d", "viewer", "user:amy").with_caveat("c", {})
+    engine, dsnap, oracle, now = setup(
+        """
+        caveat c(flag bool) { flag }
+        definition user {}
+        definition doc {
+            relation viewer: user | user with c
+            permission view = viewer
+        }
+        """,
+        [r1, ("doc:d#viewer", "user:bob")],
+    )
+    rels = [
+        rel.must_from_triple("doc:d", "view", "user:amy"),
+        rel.must_from_triple("doc:d", "view", "user:bob"),
+        rel.must_from_triple("doc:d", "view", "user:eve"),
+    ]
+    d, p, ovf = engine.check_batch(dsnap, rels, now_us=now)
+    # amy: conditional → not definite but possible (client resolves on host)
+    assert not d[0] and p[0]
+    assert oracle.check_relationship(rels[0]) == U
+    # bob: unconditional
+    assert d[1] and p[1]
+    # eve: nothing
+    assert not d[2] and not p[2]
+
+
+def test_expiration_on_device():
+    import datetime as dt
+
+    now_us = 1_700_000_000_000_000
+    past = dt.datetime.fromtimestamp((now_us - 3600_000_000) / 1e6, tz=dt.timezone.utc)
+    future = dt.datetime.fromtimestamp((now_us + 3600_000_000) / 1e6, tz=dt.timezone.utc)
+    engine, dsnap, oracle, now = setup(
+        """
+        use expiration
+        definition user {}
+        definition door { relation opener: user with expiration
+                          permission open = opener }
+        """,
+        [
+            rel.must_from_triple("door:front", "opener", "user:old").with_expiration(past),
+            rel.must_from_triple("door:front", "opener", "user:new").with_expiration(future),
+        ],
+        now_us=now_us,
+    )
+    run_checks(
+        engine, dsnap, oracle, now,
+        [
+            ("door:front", "open", "user:old"),
+            ("door:front", "open", "user:new"),
+        ],
+    )
+
+
+def test_overflow_flags_instead_of_wrong_answers():
+    # fanout bigger than the arrow cap → overflow must be reported
+    triples = [("document:d#viewer", "user:amy")]
+    for i in range(10):
+        triples.append((f"document:d#folder", f"folder:f{i}"))
+    triples.append(("folder:f7#owner", "user:amy"))
+    engine, dsnap, oracle, now = setup(
+        FOLDERS, triples, config=EngineConfig.for_schema(
+            compile_schema(parse_schema(FOLDERS)), arrow_fanout=4
+        )
+    )
+    rels = [rel.must_from_triple("document:d", "view", "user:bob")]
+    d, p, ovf = engine.check_batch(dsnap, rels, now_us=now)
+    assert ovf[0]  # 10 folder edges > fanout 4
+
+
+GH_RBAC = """
+definition user {}
+definition team {
+    relation member: user
+}
+definition org {
+    relation admin: user
+    relation member: user | team#member
+}
+definition repo {
+    relation org: org
+    relation maintainer: user | team#member
+    relation reader: user
+    permission admin = org->admin + maintainer
+    permission read = reader + admin + org->member
+}
+"""
+
+
+def test_github_rbac_differential_random():
+    rng = random.Random(42)
+    users = [f"user:u{i}" for i in range(30)]
+    teams = [f"team:t{i}" for i in range(5)]
+    orgs = [f"org:o{i}" for i in range(3)]
+    repos = [f"repo:r{i}" for i in range(10)]
+    triples = []
+    for t in teams:
+        for u in rng.sample(users, 6):
+            triples.append((f"{t}#member", u))
+    for o in orgs:
+        triples.append((f"{o}#admin", rng.choice(users)))
+        for t in rng.sample(teams, 2):
+            triples.append((f"{o}#member", f"{t}#member"))
+        for u in rng.sample(users, 4):
+            triples.append((f"{o}#member", u))
+    for r in repos:
+        triples.append((f"{r}#org", rng.choice(orgs)))
+        triples.append((f"{r}#maintainer", f"{rng.choice(teams)}#member"))
+        for u in rng.sample(users, 2):
+            triples.append((f"{r}#reader", u))
+
+    engine, dsnap, oracle, now = setup(GH_RBAC, triples)
+    queries = []
+    for r in repos:
+        for u in rng.sample(users, 10):
+            perm = rng.choice(["read", "admin"])
+            queries.append((r, perm, u))
+    run_checks(engine, dsnap, oracle, now, queries)
+
+
+def test_empty_batch():
+    engine, dsnap, oracle, now = setup(EXAMPLE, [("document:a#reader", "user:u")])
+    d, p, ovf = engine.check_batch(dsnap, [], now_us=now)
+    assert d.shape == (0,)

@@ -1,0 +1,104 @@
+"""Regression tests for code-review findings (round 1, batch 3)."""
+
+import datetime as dt
+import threading
+import time
+
+import pytest
+
+from gochugaru_tpu import consistency, rel
+from gochugaru_tpu.client import new_tpu_evaluator
+from gochugaru_tpu.engine.oracle import F, T, Oracle
+from gochugaru_tpu.schema import compile_schema, parse_schema
+from gochugaru_tpu.store.interner import Interner
+from gochugaru_tpu.store.snapshot import build_snapshot
+from gochugaru_tpu.utils.context import background
+
+SCHEMA = """
+use expiration
+definition user {}
+definition door {
+    relation opener: user with expiration
+    permission open = opener
+}
+"""
+
+
+def test_expiry_near_snapshot_epoch_is_not_eternal():
+    # an expiry within 1s of the snapshot epoch must NOT collide with the
+    # 0 = "no expiration" sentinel
+    epoch_us = 1_700_000_000_000_000
+    cs = compile_schema(parse_schema(SCHEMA))
+    exp = dt.datetime.fromtimestamp((epoch_us + 500_000) / 1e6, tz=dt.timezone.utc)
+    r = rel.must_from_triple("door:d", "opener", "user:u").with_expiration(exp)
+    snap = build_snapshot(1, cs, Interner(), [r], epoch_us=epoch_us)
+    # int32 column is not the sentinel
+    assert int(snap.e_exp[0]) != 0
+    # an hour later the edge is dead in host reads
+    later = epoch_us + 3600_000_000
+    assert list(snap.iter_relationships(None, now_us=later)) == []
+
+
+def test_exact_expiration_round_trips_through_decode():
+    epoch_us = 1_700_000_000_000_000
+    cs = compile_schema(parse_schema(SCHEMA))
+    exp = dt.datetime.fromtimestamp(
+        (epoch_us + 10_600_000) / 1e6, tz=dt.timezone.utc
+    )  # epoch + 10.6s
+    r = rel.must_from_triple("door:d", "opener", "user:u").with_expiration(exp)
+    snap = build_snapshot(1, cs, Interner(), [r], epoch_us=epoch_us)
+    decoded = snap.decode_edge(0)
+    assert decoded.expiration == exp  # exact micros, no second-flooring
+
+
+def test_oracle_uses_wall_clock_when_not_pinned():
+    cs = compile_schema(parse_schema(SCHEMA))
+    soon = dt.datetime.now(dt.timezone.utc) + dt.timedelta(milliseconds=50)
+    r = rel.must_from_triple("door:d", "opener", "user:u").with_expiration(soon)
+    o = Oracle(cs, [r])  # no pinned now_us
+    assert o.check("door", "d", "open", "user", "u") == T
+    time.sleep(0.08)
+    # the same cached oracle must see the expiry pass
+    assert o.check("door", "d", "open", "user", "u") == F
+
+
+def test_unknown_subject_relation_is_false_on_device():
+    ctx = background()
+    c = new_tpu_evaluator()
+    c.write_schema(
+        ctx,
+        "definition user {}\ndefinition doc { relation viewer: user"
+        " permission view = viewer }",
+    )
+    txn = rel.Txn()
+    txn.create(rel.must_from_triple("doc:d", "viewer", "user:u"))
+    c.write(ctx, txn)
+    assert c.check_one(
+        ctx, consistency.full(), rel.must_from_triple("doc:d", "view", "user:u")
+    )
+    # same subject with a bogus subject relation must be False, not aliased
+    # to the direct subject
+    assert not c.check_one(
+        ctx, consistency.full(),
+        rel.must_from_tuple("doc:d#view", "user:u#bogus"),
+    )
+
+
+def test_watch_unblocks_on_cancel_without_writes():
+    ctx = background()
+    c = new_tpu_evaluator()
+    c.write_schema(ctx, "definition user {}\ndefinition doc { relation v: user }")
+    wctx = ctx.with_cancel()
+    done = threading.Event()
+
+    def consume():
+        for _ in c.updates(wctx, rel.UpdateFilter()):
+            pass
+        done.set()
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.15)  # let it block waiting for writes
+    wctx.cancel()
+    assert done.wait(timeout=2.0), "watch did not unblock on cancellation"
+    t.join(timeout=1)

@@ -255,18 +255,19 @@ def test_snapshot_columnar_views():
     snap = s.snapshot_for(consistency.full())
 
     assert snap.num_edges == 5
-    # sorted keys
-    assert np.all(np.diff(snap.e_k1) >= 0)
+    # sorted lex by (rel, res)
+    k = snap.e_rel.astype(np.int64) * snap.num_nodes + snap.e_res
+    assert np.all(np.diff(k) >= 0)
     # two userset edges (all#member@eng#member, sup#member@all#member)
-    assert snap.us_k1.shape[0] == 2
+    assert snap.us_rel.shape[0] == 2
     # membership seed: user:amy ∈ group:eng#member ((eng,member) is used as
     # a subject).  Propagation: the group:all edge targets (all,member),
     # which is itself used as a subject (by the group:sup edge); the
     # group:sup edge targets (sup,member), which nothing references → pruned.
     assert snap.ms_subj.shape[0] == 1
-    assert snap.mp_skey.shape[0] == 1
+    assert snap.mp_subj.shape[0] == 1
     # arrow edge: folder:sub --parent--> folder:root
-    assert snap.ar_k1.shape[0] == 1
+    assert snap.ar_rel.shape[0] == 1
     child_type, child_id = snap.interner.key_of(int(snap.ar_child[0]))
     assert (child_type, child_id) == ("folder", "root")
     # round-trip decode
