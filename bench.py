@@ -1,0 +1,162 @@
+"""Benchmark: BASELINE config 2 — GitHub-style RBAC, 10k repos x 1k users,
+2-hop org→team→repo rewrites, 100k-check batches on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "checks/sec/chip", "vs_baseline": N}
+
+``vs_baseline`` is the fraction of the BASELINE.json north-star target
+(10M checks/sec/chip); the reference itself publishes no numbers
+(BASELINE.md), so the target is the denominator.
+
+Methodology: the graph is materialized once (columnar bulk path), queries
+are lowered to int32 arrays once, and the steady-state jitted check is
+timed over several repetitions with blocking.  Host-side query lowering is
+excluded, matching how the reference's client-side proto building is not
+part of SpiceDB's evaluation numbers.
+"""
+
+import json
+import random
+import sys
+import time
+
+import numpy as np
+
+
+def build_world(n_repos=10_000, n_users=1_000, n_teams=100, n_orgs=10, seed=11):
+    from gochugaru_tpu import rel  # noqa: F401
+    from gochugaru_tpu.schema import compile_schema, parse_schema
+    from gochugaru_tpu.store.interner import Interner
+    from gochugaru_tpu.store.snapshot import build_snapshot_from_columns
+
+    schema = """
+    definition user {}
+    definition team { relation member: user }
+    definition org {
+        relation admin: user
+        relation member: user | team#member
+    }
+    definition repo {
+        relation org: org
+        relation maintainer: user | team#member
+        relation reader: user
+        permission admin = org->admin + maintainer
+        permission read = reader + admin + org->member
+    }
+    """
+    cs = compile_schema(parse_schema(schema))
+    interner = Interner()
+    rng = np.random.default_rng(seed)
+
+    users = np.array([interner.node("user", f"u{i}") for i in range(n_users)], np.int64)
+    teams = np.array([interner.node("team", f"t{i}") for i in range(n_teams)], np.int64)
+    orgs = np.array([interner.node("org", f"o{i}") for i in range(n_orgs)], np.int64)
+    repos = np.array([interner.node("repo", f"r{i}") for i in range(n_repos)], np.int64)
+
+    slot = cs.slot_of_name
+    member, admin, org_rel = slot["member"], slot["admin"], slot["org"]
+    maintainer, reader = slot["maintainer"], slot["reader"]
+
+    res, rel_s, subj, srel = [], [], [], []
+
+    def add(r, rl, s, sr):
+        res.append(r); rel_s.append(rl); subj.append(s); srel.append(sr)
+
+    # team members: each team gets n_users/10 members
+    per_team = max(2, n_users // 10)
+    for t in teams:
+        for u in rng.choice(users, per_team, replace=False):
+            add(t, member, u, -1)
+    # orgs: admins + team usersets + direct members
+    for o in orgs:
+        add(o, admin, rng.choice(users), -1)
+        for t in rng.choice(teams, 2, replace=False):
+            add(o, member, t, member)
+        for u in rng.choice(users, 5, replace=False):
+            add(o, member, u, -1)
+    # repos: org edge + maintainer team + direct readers (vectorized)
+    repo_orgs = rng.choice(orgs, n_repos)
+    repo_teams = rng.choice(teams, n_repos)
+    res.extend(repos); rel_s.extend([org_rel] * n_repos)
+    subj.extend(repo_orgs); srel.extend([-1] * n_repos)
+    res.extend(repos); rel_s.extend([maintainer] * n_repos)
+    subj.extend(repo_teams); srel.extend([member] * n_repos)
+    for k in range(2):
+        res.extend(repos); rel_s.extend([reader] * n_repos)
+        subj.extend(rng.choice(users, n_repos)); srel.extend([-1] * n_repos)
+
+    snap = build_snapshot_from_columns(
+        1, cs, interner,
+        res=np.asarray(res, np.int64), rel=np.asarray(rel_s, np.int64),
+        subj=np.asarray(subj, np.int64), srel=np.asarray(srel, np.int64),
+        epoch_us=1_700_000_000_000_000,
+    )
+    return cs, snap, users, repos, slot
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from gochugaru_tpu.engine.device import DeviceEngine
+
+    batch = 100_000
+    cs, snap, users, repos, slot = build_world()
+    engine = DeviceEngine(cs)
+    dsnap = engine.prepare(snap)
+
+    rng = np.random.default_rng(5)
+    B = 1 << (batch - 1).bit_length()
+    q_res = rng.choice(repos, B).astype(np.int32)
+    q_perm = rng.choice(
+        np.array([slot["read"], slot["admin"]], np.int32), B
+    )
+    q_subj = rng.choice(users, B).astype(np.int32)
+    q_srel = np.full(B, -1, np.int32)
+    q_wc = np.full(B, -1, np.int32)
+    q_self = np.zeros(B, bool)
+    uniq, q_row = np.unique(q_subj, return_inverse=True)
+    UP = 1 << (len(uniq) - 1).bit_length()
+    u_subj = np.full(UP, -1, np.int32)
+    u_subj[: len(uniq)] = uniq
+    u_other = np.full(UP, -1, np.int32)
+
+    now = jnp.int32(snap.now_rel32(1_700_000_000_000_000))
+    args = (
+        dsnap.arrays, dsnap.tid_map, now,
+        jnp.asarray(u_subj), jnp.asarray(u_other), jnp.asarray(u_other),
+        jnp.asarray(q_res), jnp.asarray(q_perm), jnp.asarray(q_subj),
+        jnp.asarray(q_srel), jnp.asarray(q_wc),
+        jnp.asarray(q_row.astype(np.int32)), jnp.asarray(q_self),
+    )
+
+    # compile + warm
+    d, p, ovf = engine._fn(*args)
+    jax.block_until_ready((d, p, ovf))
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = engine._fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    rate = B / dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "rbac_2hop_bulk_check_throughput",
+                "value": round(rate, 1),
+                "unit": "checks/sec/chip",
+                "vs_baseline": round(rate / 10_000_000, 4),
+            }
+        )
+    )
+    print(
+        f"# batch={B} reps={reps} step={dt*1000:.1f}ms granted={int(np.asarray(d).sum())}"
+        f" overflow={int(np.asarray(ovf).sum())} edges={snap.num_edges}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -322,7 +322,10 @@ class Client:
                 "UpdateFilter.object_types and relationship_filters are mutually"
                 " exclusive"
             )
-        since = parse_revision(revision) if revision else 0
+        # no cursor → subscribe from the current head, exactly like Watch
+        # with no OptionalStartCursor (client/client.go:379-387); a cursor
+        # replays everything after it
+        since = parse_revision(revision) if revision else self._store.head_revision
         stop = threading.Event()
 
         def watch() -> Iterator[Update]:

@@ -112,6 +112,23 @@ def _lex_contains2(c1, c2, q1, q2):
     return (c1[posc] == q1) & (c2[posc] == q2)
 
 
+def _pany(x, axis: Optional[str]):
+    """OR-reduce across the edge-shard mesh axis (identity off-mesh).
+    This is the all-reduce(OR) closing reachability across shards that
+    SURVEY.md §2.5/§5 calls for — XLA lowers it onto ICI."""
+    if axis is None:
+        return x
+    return lax.psum(x.astype(jnp.int32), axis) > 0
+
+
+def _agather(x, axis: Optional[str]):
+    """Gather shard-local candidate blocks from every edge shard along the
+    mesh axis, concatenated on a new leading axis (identity off-mesh)."""
+    if axis is None:
+        return x[None]
+    return lax.all_gather(x, axis)
+
+
 def _gate(cav, exp, now, plane: str):
     """Edge admissibility: expired edges grant nothing; caveated edges are
     possible-but-not-definite until the on-device caveat VM evaluates them
@@ -146,7 +163,10 @@ def _dedup_truncate(n: jnp.ndarray, r: jnp.ndarray, C: int):
 # ---------------------------------------------------------------------------
 
 
-def _closure_one(arrs, cfg: EngineConfig, plane: str, now, u_subj, u_srel, u_wc):
+def _closure_one(
+    arrs, cfg: EngineConfig, plane: str, now, u_subj, u_srel, u_wc,
+    axis: Optional[str] = None,
+):
     C, SC, P = cfg.closure_size, cfg.seed_cap, cfg.prop_cap
     ms_subj, ms_res, ms_rel = arrs["ms_subj"], arrs["ms_res"], arrs["ms_rel"]
     ms_cav, ms_exp = arrs["ms_caveat"], arrs["ms_exp"]
@@ -171,8 +191,9 @@ def _closure_one(arrs, cfg: EngineConfig, plane: str, now, u_subj, u_srel, u_wc)
         valid = (idx < hi) & (src >= 0)
         idxc = jnp.clip(idx, 0, last)
         keep = valid & _gate(ms_cav[idxc], ms_exp[idxc], now, plane)
-        bufs_n.append(jnp.where(keep, ms_res[idxc], I32_MAX))
-        bufs_r.append(jnp.where(keep, ms_rel[idxc], I32_MAX))
+        # each edge shard contributes its local seeds; gather + dedup merges
+        bufs_n.append(_agather(jnp.where(keep, ms_res[idxc], I32_MAX), axis).ravel())
+        bufs_r.append(_agather(jnp.where(keep, ms_rel[idxc], I32_MAX), axis).ravel())
     c_n, c_r, ovf = _dedup_truncate(
         jnp.concatenate(bufs_n), jnp.concatenate(bufs_r), C
     )
@@ -181,7 +202,8 @@ def _closure_one(arrs, cfg: EngineConfig, plane: str, now, u_subj, u_srel, u_wc)
     lastp = max(mp_subj.shape[0] - 1, 0)
     lex_lo = jax.vmap(lambda a, b: _lex_search((mp_subj, mp_srel), (a, b), "left"))
     lex_hi = jax.vmap(lambda a, b: _lex_search((mp_subj, mp_srel), (a, b), "right"))
-    for _ in range(cfg.closure_hops):
+
+    def hop(c_n, c_r, overflow):
         lo = lex_lo(c_n, c_r)
         hi = lex_hi(c_n, c_r)
         overflow |= jnp.any((hi - lo) > P)
@@ -189,13 +211,24 @@ def _closure_one(arrs, cfg: EngineConfig, plane: str, now, u_subj, u_srel, u_wc)
         valid = (idx < hi[:, None]) & (c_n[:, None] < I32_MAX)
         idxc = jnp.clip(idx, 0, lastp)
         keep = valid & _gate(mp_cav[idxc], mp_exp[idxc], now, plane)
-        cand_n = jnp.where(keep, mp_res[idxc], I32_MAX).ravel()
-        cand_r = jnp.where(keep, mp_rel[idxc], I32_MAX).ravel()
+        cand_n = _agather(jnp.where(keep, mp_res[idxc], I32_MAX).ravel(), axis).ravel()
+        cand_r = _agather(jnp.where(keep, mp_rel[idxc], I32_MAX).ravel(), axis).ravel()
         c_n, c_r, ovf = _dedup_truncate(
             jnp.concatenate([c_n, cand_n]), jnp.concatenate([c_r, cand_r]), C
         )
-        overflow |= ovf
-    return c_n, c_r, overflow
+        return c_n, c_r, overflow | ovf
+
+    for _ in range(cfg.closure_hops):
+        c_n, c_r, overflow = hop(c_n, c_r, overflow)
+    if cfg.closure_hops > 0:
+        # detection pass: if one more hop still grows the closure, the hop
+        # cap was insufficient (nesting deeper than closure_hops) — flag it
+        # so the caller falls back to the host oracle instead of silently
+        # missing memberships
+        size_before = jnp.sum(c_n < I32_MAX)
+        c_n, c_r, overflow = hop(c_n, c_r, overflow)
+        overflow |= jnp.sum(c_n < I32_MAX) > size_before
+    return c_n, c_r, _pany(overflow, axis)
 
 
 # ---------------------------------------------------------------------------
@@ -211,6 +244,7 @@ def _query_one(
     tid_map,  # int32[num_schema_types] → interner type id
     Cd_n, Cd_r, Cp_n, Cp_r,  # [U, C] closures
     q_res, q_perm, q_subj, q_srel, q_wc, q_row, q_self,
+    axis: Optional[str] = None,
 ):
     N = cfg.subgraph_nodes
     TS = len(plan.ts_slots)
@@ -238,15 +272,22 @@ def _query_one(
     nodes = jnp.full(N, -1, jnp.int32).at[0].set(q_res)
     count = jnp.where(q_res >= 0, jnp.int32(1), jnp.int32(0))
     TSax = max(TS, 1)
-    child_slot = jnp.full((N, TSax, K), -1, jnp.int32)
-    child_gd = jnp.zeros((N, TSax, K), bool)
-    child_gp = jnp.zeros((N, TSax, K), bool)
+    # with edge sharding, every shard contributes up to K children per
+    # (node, tupleset relation); gathered fanout is M*K
+    M = 1 if axis is None else lax.axis_size(axis)
+    KE = K * M
+    child_slot = jnp.full((N, TSax, KE), -1, jnp.int32)
+    child_gd = jnp.zeros((N, TSax, KE), bool)
+    child_gp = jnp.zeros((N, TSax, KE), bool)
 
     if TS > 0:
         last_ar = max(ar_rel.shape[0] - 1, 0)
         lo_f = jax.vmap(lambda a, b: _lex_search((ar_rel, ar_res), (a, b), "left"))
         hi_f = jax.vmap(lambda a, b: _lex_search((ar_rel, ar_res), (a, b), "right"))
-        for _hop in range(max(N - 1, 1)):
+        # N-1 hops discover a chain of N nodes; the +1 detection hop scans
+        # the last-discovered nodes' children so a subgraph deeper than the
+        # cap trips the count>=N overflow instead of silently truncating
+        for _hop in range(max(N - 1, 1) + 1):
             cand_children = []
             cand_gd = []
             cand_gp = []
@@ -267,6 +308,13 @@ def _query_one(
             cc = jnp.stack(cand_children)  # [TS, N, K]
             cgd = jnp.stack(cand_gd)
             cgp = jnp.stack(cand_gp)
+            if axis is not None:
+                # merge every shard's local candidates: [M, TS, N, K] →
+                # [TS, N, M*K]; identical on all shards afterwards, so the
+                # slot assignment below is replicated deterministically
+                cc = _agather(cc, axis).transpose(1, 2, 0, 3).reshape(TS, N, KE)
+                cgd = _agather(cgd, axis).transpose(1, 2, 0, 3).reshape(TS, N, KE)
+                cgp = _agather(cgp, axis).transpose(1, 2, 0, 3).reshape(TS, N, KE)
 
             def assign(carry, c):
                 nodes_, count_, ovf_ = carry
@@ -290,7 +338,7 @@ def _query_one(
                 assign, (nodes, count, jnp.bool_(False)), cc.ravel()
             )
             overflow |= ovf
-            child_slot = slots.reshape(TS, N, K).transpose(1, 0, 2)
+            child_slot = slots.reshape(TS, N, KE).transpose(1, 0, 2)
             child_gd = cgd.transpose(1, 0, 2)
             child_gp = cgp.transpose(1, 0, 2)
 
@@ -357,6 +405,10 @@ def _query_one(
     leaf_d, leaf_p, leaf_ovf = jax.vmap(
         lambda n: jax.vmap(lambda r: leaf(n, r))(rs)
     )(nodes)
+    # merge shard-local leaf hits: a direct/wildcard/userset grant may live
+    # on any edge shard
+    leaf_d = _pany(leaf_d, axis)
+    leaf_p = _pany(leaf_p, axis)
     overflow |= jnp.any(leaf_ovf & (nodes >= 0)[:, None])
 
     V_d = jnp.zeros((N, SLOTS), bool)
@@ -424,7 +476,7 @@ def _query_one(
     perm_c = jnp.clip(q_perm, 0, SLOTS - 1)
     d = (V_d[0, perm_c] & valid_q) | q_self
     p = (V_p[0, perm_c] & valid_q) | q_self
-    return d, p, overflow
+    return d, p, _pany(overflow, axis)
 
 
 # ---------------------------------------------------------------------------
@@ -432,16 +484,21 @@ def _query_one(
 # ---------------------------------------------------------------------------
 
 
-def _make_check_fn(plan: DevicePlan, cfg: EngineConfig):
+def _make_check_fn(plan: DevicePlan, cfg: EngineConfig,
+                   axis: Optional[str] = None, jit: bool = True):
+    """Build the whole-batch check function.  With ``axis`` set, the
+    function is written for shard_map over that mesh axis: edge arrays are
+    shard-local and collectives merge at every gather/test point."""
+
     def fn(arrs, tid_map, now, u_subj, u_srel, u_wc,
            q_res, q_perm, q_subj, q_srel, q_wc, q_row, q_self):
         close_p = jax.vmap(
-            lambda s, r, w: _closure_one(arrs, cfg, "p", now, s, r, w)
+            lambda s, r, w: _closure_one(arrs, cfg, "p", now, s, r, w, axis)
         )
         Cp_n, Cp_r, ovf_p = close_p(u_subj, u_srel, u_wc)
         if plan.two_plane:
             close_d = jax.vmap(
-                lambda s, r, w: _closure_one(arrs, cfg, "d", now, s, r, w)
+                lambda s, r, w: _closure_one(arrs, cfg, "d", now, s, r, w, axis)
             )
             Cd_n, Cd_r, ovf_d = close_d(u_subj, u_srel, u_wc)
         else:
@@ -452,13 +509,14 @@ def _make_check_fn(plan: DevicePlan, cfg: EngineConfig):
                 arrs, plan, cfg, now, tid_map,
                 Cd_n, Cd_r, Cp_n, Cp_r,
                 a, b, c, d_, e, f, g,
+                axis,
             )
         )
         d, p, ovf_q = per_query(q_res, q_perm, q_subj, q_srel, q_wc, q_row, q_self)
         u_ovf = ovf_d | ovf_p
         return d, p, ovf_q | u_ovf[q_row]
 
-    return jax.jit(fn)
+    return jax.jit(fn) if jit else fn
 
 
 # ---------------------------------------------------------------------------

@@ -28,7 +28,7 @@ from ..schema.ast import (
     RelationRef,
     Union,
 )
-from ..schema.compiler import CompiledSchema
+from ..schema.compiler import CompiledSchema, _expr_refs
 
 # Expression IR: nested tuples, all leaves static ints.
 #   ("ref", slot) ("arrow", ts_idx, right_slot) ("union", (c...))
@@ -56,62 +56,124 @@ class EngineConfig:
     def for_schema(compiled: CompiledSchema, **overrides) -> "EngineConfig":
         cfg = EngineConfig()
         userset_depth = _userset_depth(compiled)
-        has_arrows = bool(compiled.tupleset_pairs)
+        arrow_depth = _arrow_depth(compiled)
         if userset_depth == 0:
             cfg = replace(cfg, closure_hops=0)
         elif userset_depth > 0:
             cfg = replace(cfg, closure_hops=min(userset_depth, cfg.closure_hops))
         # -1 (cyclic): keep the default cap.
-        if not has_arrows:
-            cfg = replace(cfg, subgraph_nodes=1, eval_iters=1)
-        elif not compiled.is_recursive:
-            # acyclic arrows: the subgraph is as deep as the longest arrow
-            # chain; one topo-ordered iteration resolves everything.
-            cfg = replace(
-                cfg,
-                subgraph_nodes=max(2, min(2 ** (compiled.depth), 32)),
-                eval_iters=1,
-            )
+        if arrow_depth == 0:
+            cfg = replace(cfg, subgraph_nodes=1)
+        elif arrow_depth > 0:
+            # acyclic arrows: the subgraph is as deep as the longest
+            # type-level arrow chain (fanout beyond the cap overflows to the
+            # host).
+            cfg = replace(cfg, subgraph_nodes=max(2, min(1 + 2 * arrow_depth, 32)))
+        # else keep the default subgraph cap (recursive hierarchies).
+        # Fixpoint iterations: one topo-ordered pass resolves any acyclic
+        # rewrite system; cycles through *evaluation* dependencies (mutually
+        # recursive permissions, recursive arrows) propagate one dependency
+        # step per iteration, so the bound must cover the cycle length AND
+        # the subgraph chain length.  Userset (group) recursion is the
+        # closure phase's job and does not force iterations here.
+        rec = _eval_recursion_bound(compiled)
+        if rec == 0:
+            cfg = replace(cfg, eval_iters=1)
         else:
-            # recursion through arrows (e.g. folder parent->view): value
-            # flows one node per iteration along the recursive chain.
-            cfg = replace(cfg, eval_iters=cfg.subgraph_nodes)
+            cfg = replace(
+                cfg, eval_iters=min(32, max(cfg.subgraph_nodes, rec + 1))
+            )
         return replace(cfg, **overrides)
+
+
+def _longest_path(edges: Dict) -> Tuple[int, set]:
+    """Longest path length over an adjacency dict {node: iterable(node)}.
+    Returns (depth, cyclic_nodes): depth is -1 if cyclic; cyclic_nodes are
+    the nodes observed on a cycle."""
+    if not edges:
+        return 0, set()
+    memo: Dict = {}
+    stack: List = []
+    on_stack: set = set()
+    cyclic_nodes: set = set()
+
+    def depth(node) -> int:
+        if node in memo:
+            return memo[node]
+        if node in on_stack:
+            # every node from the first occurrence onward is on the cycle
+            i = stack.index(node)
+            cyclic_nodes.update(stack[i:])
+            return 0
+        stack.append(node)
+        on_stack.add(node)
+        d = 0
+        for nxt in edges.get(node, ()):  # noqa: B905
+            d = max(d, 1 + depth(nxt))
+        stack.pop()
+        on_stack.discard(node)
+        memo[node] = d
+        return d
+
+    m = max(depth(n) for n in list(edges))
+    return (-1 if cyclic_nodes else m), cyclic_nodes
 
 
 def _userset_depth(compiled: CompiledSchema) -> int:
     """Nesting depth of the relation-userset graph: 0 = no relation admits
     userset subjects; -1 = cyclic (groups-in-groups); else the max depth."""
-    schema = compiled.schema
     edges: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
-    for tname, d in schema.definitions.items():
+    for tname, d in compiled.schema.definitions.items():
         for rname, relation in d.relations.items():
             for a in relation.allowed:
                 if a.relation:
                     edges.setdefault((tname, rname), []).append((a.type, a.relation))
-    if not edges:
+    depth, _ = _longest_path(edges)
+    return depth
+
+
+def _arrow_depth(compiled: CompiledSchema) -> int:
+    """Longest type-level chain of arrow (tupleset) traversals: 0 = no
+    arrows, -1 = cyclic (recursive hierarchies), else the max chain length.
+    This bounds the resource-subgraph BFS, which only walks arrow edges —
+    far tighter than the full item-dependency depth."""
+    edges: Dict[str, set] = {}
+    for tname, d in compiled.schema.definitions.items():
+        for perm in d.permissions.values():
+            for ref in _expr_refs(perm.expr):
+                if isinstance(ref, Arrow):
+                    for a in d.relations[ref.left].allowed:
+                        if not a.wildcard:
+                            edges.setdefault(tname, set()).add(a.type)
+    depth, _ = _longest_path(edges)
+    return depth
+
+
+def _eval_recursion_bound(compiled: CompiledSchema) -> int:
+    """Cycle bound for the fixpoint ITERATION (not the closure): build the
+    evaluation-dependency graph over (type, item) where permissions depend
+    on same-type references and arrow targets, and relations are leaves
+    (their userset indirection is resolved by the closure phase, not the
+    fixpoint).  Returns 0 if acyclic, else the number of nodes observed on
+    cycles — an upper bound on the extra propagation steps a cycle needs."""
+    schema = compiled.schema
+    edges: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
+    for tname, d in schema.definitions.items():
+        for pname, perm in d.permissions.items():
+            deps: List[Tuple[str, str]] = []
+            for ref in _expr_refs(perm.expr):
+                if isinstance(ref, RelationRef):
+                    deps.append((tname, ref.name))
+                elif isinstance(ref, Arrow):
+                    for a in d.relations[ref.left].allowed:
+                        if not a.wildcard and schema.definitions[a.type].item(ref.right):
+                            deps.append((a.type, ref.right))
+            edges[(tname, pname)] = deps
+    # relations are leaves: drop their outgoing edges entirely
+    depth, cyclic_nodes = _longest_path(edges)
+    if depth >= 0:
         return 0
-    memo: Dict[Tuple[str, str], int] = {}
-    stack: set = set()
-    cyclic = False
-
-    def depth(node: Tuple[str, str]) -> int:
-        nonlocal cyclic
-        if node in memo:
-            return memo[node]
-        if node in stack:
-            cyclic = True
-            return 0
-        stack.add(node)
-        d = 0
-        for nxt in edges.get(node, ()):  # noqa: B905
-            d = max(d, 1 + depth(nxt))
-        stack.discard(node)
-        memo[node] = d
-        return d
-
-    m = max(depth(n) for n in list(edges))
-    return -1 if cyclic else m
+    return max(1, len(cyclic_nodes))
 
 
 @dataclass(frozen=True)

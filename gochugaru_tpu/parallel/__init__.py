@@ -1,0 +1,17 @@
+"""Multi-chip scaling: mesh construction and the sharded bulk-check engine.
+
+The reference's only distribution machinery is a gRPC channel plus
+client-side batching (SURVEY.md §2.5); here the same roles are played by a
+``jax.sharding.Mesh`` with two axes:
+
+- ``data``  — queries partitioned across devices (throughput scaling; the
+  batch axis of ``CheckBulkPermissions`` spread over chips);
+- ``model`` — the sorted edge columns partitioned across devices
+  (capacity scaling for graphs beyond one chip's HBM), with per-hop
+  all-gather/all-reduce(OR) collectives riding ICI.
+"""
+
+from .mesh import default_mesh, make_mesh
+from .sharded import ShardedEngine
+
+__all__ = ["make_mesh", "default_mesh", "ShardedEngine"]

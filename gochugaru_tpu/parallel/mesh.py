@@ -1,0 +1,38 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    data: int = 1,
+    model: int = 1,
+    *,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a (data × model) device mesh.  ``data`` shards the query
+    batch; ``model`` shards the edge columns."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = data * model
+    if len(devices) < need:
+        raise ValueError(f"mesh {data}x{model} needs {need} devices, have {len(devices)}")
+    arr = np.asarray(devices[:need]).reshape(data, model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def default_mesh(model: int = 1) -> Mesh:
+    """All available devices, with ``model`` of them dedicated to edge
+    sharding and the rest to data parallelism."""
+    n = len(jax.devices())
+    if n % model != 0:
+        raise ValueError(f"{n} devices not divisible by model={model}")
+    return make_mesh(n // model, model)

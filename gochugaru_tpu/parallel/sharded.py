@@ -1,0 +1,200 @@
+"""The sharded bulk-check engine: shard_map over a (data × model) mesh.
+
+Queries are partitioned along ``data`` (each device row evaluates its own
+slice of the batch), the sorted edge columns along ``model`` (each device
+column holds a contiguous, still-sorted block of every view).  The engine
+body is exactly the single-chip two-phase evaluation with collectives at
+the merge points (``engine.device`` with ``axis=MODEL_AXIS``):
+
+- closure seed/propagation gathers all-gather shard-local candidates;
+- leaf tests OR-reduce shard-local hits (all-reduce over ICI);
+- the arrow BFS all-gathers shard-local children, then assigns node slots
+  deterministically so every shard holds the identical subgraph.
+
+This is the SPMD replacement for what a multi-node SpiceDB does with its
+dispatch cluster (SURVEY.md §2.5): one XLA program, collectives riding
+ICI, no RPC fan-out.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax ≥ 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..engine.device import (
+    DeviceEngine,
+    DeviceSnapshot,
+    _ceil_pow2,
+    _make_check_fn,
+    _pad_payload,
+    _pad_sorted,
+)
+from ..engine.plan import EngineConfig
+from ..rel.relationship import Relationship
+from ..schema.compiler import CompiledSchema
+from ..store.snapshot import Snapshot
+from .mesh import DATA_AXIS, MODEL_AXIS
+
+
+class ShardedEngine(DeviceEngine):
+    """A DeviceEngine whose batched check runs shard_mapped over a mesh."""
+
+    def __init__(
+        self,
+        compiled: CompiledSchema,
+        mesh: Mesh,
+        config: Optional[EngineConfig] = None,
+    ) -> None:
+        super().__init__(compiled, config)
+        self.mesh = mesh
+        self.data_size = mesh.shape[DATA_AXIS]
+        self.model_size = mesh.shape[MODEL_AXIS]
+        raw = _make_check_fn(self.plan, self.config, axis=MODEL_AXIS, jit=False)
+
+        arr_spec = {k: P(MODEL_AXIS) for k in self._ARRAY_KEYS}
+        # node_type and tid_map are lookup tables, replicated everywhere
+        arr_spec["node_type"] = P()
+        in_specs = (
+            arr_spec, P(), P(),  # arrays, tid_map, now
+            P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),  # u_subj, u_srel, u_wc
+            P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),  # q_res, q_perm, q_subj
+            P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),  # srel, wc, row, self
+        )
+        out_specs = (P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS))
+        self._fn = jax.jit(
+            shard_map(
+                raw, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            )
+        )
+
+    _ARRAY_KEYS = (
+        "e_rel", "e_res", "e_subj", "e_srel1", "e_caveat", "e_exp",
+        "us_rel", "us_res", "us_subj", "us_srel", "us_caveat", "us_exp",
+        "ms_subj", "ms_res", "ms_rel", "ms_caveat", "ms_exp",
+        "mp_subj", "mp_srel", "mp_res", "mp_rel", "mp_caveat", "mp_exp",
+        "ar_rel", "ar_res", "ar_child", "ar_caveat", "ar_exp",
+        "node_type",
+    )
+
+    # -- snapshot preparation: pad every view to a multiple of model_size --
+    def prepare(self, snap: Snapshot) -> DeviceSnapshot:
+        def bucket(n: int) -> int:
+            return _ceil_pow2(max(n, 1), max(8, self.model_size))
+
+        E = bucket(snap.e_rel.shape[0])
+        US = bucket(snap.us_rel.shape[0])
+        MS = bucket(snap.ms_subj.shape[0])
+        MP = bucket(snap.mp_subj.shape[0])
+        AR = bucket(snap.ar_rel.shape[0])
+        NN = _ceil_pow2(snap.num_nodes)
+        host = {
+            "e_rel": _pad_sorted(snap.e_rel, E),
+            "e_res": _pad_sorted(snap.e_res, E),
+            "e_subj": _pad_sorted(snap.e_subj, E),
+            "e_srel1": _pad_sorted(snap.e_srel1, E),
+            "e_caveat": _pad_payload(snap.e_caveat, E),
+            "e_exp": _pad_payload(snap.e_exp, E),
+            "us_rel": _pad_sorted(snap.us_rel, US),
+            "us_res": _pad_sorted(snap.us_res, US),
+            "us_subj": _pad_payload(snap.us_subj, US, -1),
+            "us_srel": _pad_payload(snap.us_srel, US, -1),
+            "us_caveat": _pad_payload(snap.us_caveat, US),
+            "us_exp": _pad_payload(snap.us_exp, US),
+            "ms_subj": _pad_sorted(snap.ms_subj, MS),
+            "ms_res": _pad_payload(snap.ms_res, MS, -1),
+            "ms_rel": _pad_payload(snap.ms_rel, MS, -1),
+            "ms_caveat": _pad_payload(snap.ms_caveat, MS),
+            "ms_exp": _pad_payload(snap.ms_exp, MS),
+            "mp_subj": _pad_sorted(snap.mp_subj, MP),
+            "mp_srel": _pad_sorted(snap.mp_srel, MP),
+            "mp_res": _pad_payload(snap.mp_res, MP, -1),
+            "mp_rel": _pad_payload(snap.mp_rel, MP, -1),
+            "mp_caveat": _pad_payload(snap.mp_caveat, MP),
+            "mp_exp": _pad_payload(snap.mp_exp, MP),
+            "ar_rel": _pad_sorted(snap.ar_rel, AR),
+            "ar_res": _pad_sorted(snap.ar_res, AR),
+            "ar_child": _pad_payload(snap.ar_child, AR, -1),
+            "ar_caveat": _pad_payload(snap.ar_caveat, AR),
+            "ar_exp": _pad_payload(snap.ar_exp, AR),
+            "node_type": _pad_payload(snap.node_type, NN, -1),
+        }
+        arrays = {}
+        for k, v in host.items():
+            spec = P() if k == "node_type" else P(MODEL_AXIS)
+            arrays[k] = jax.device_put(v, NamedSharding(self.mesh, spec))
+        tid_map = np.full(max(self.plan.num_schema_types, 1), -1, dtype=np.int32)
+        for tname, tid in self.compiled.type_ids.items():
+            tid_map[tid] = snap.interner.type_lookup(tname)
+        return DeviceSnapshot(
+            revision=snap.revision,
+            arrays=arrays,
+            tid_map=jnp.asarray(tid_map),
+            snapshot=snap,
+        )
+
+    # -- batched check: queries partitioned per data-shard ----------------
+    def check_batch(
+        self,
+        dsnap: DeviceSnapshot,
+        rels: Sequence[Relationship],
+        *,
+        now_us: Optional[int] = None,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        if not rels:
+            z = np.zeros(0, bool)
+            return z, z, z
+        snap = dsnap.snapshot
+        D = self.data_size
+        B = len(rels)
+        per = _ceil_pow2(-(-B // D), self.config.batch_bucket_min)
+        BP = per * D
+
+        queries, _ = self._lower_queries(snap, rels)
+        # per-data-shard unique subjects (each shard computes closures only
+        # for its own slice of the batch)
+        q = {k: np.full(BP, -1 if v.dtype != bool else 0, v.dtype) for k, v in queries.items()}
+        for k, v in queries.items():
+            q[k][:B] = v
+        subj_key = np.stack([q["q_subj"], q["q_srel"], q["q_wc"]], axis=1)
+        ulists = []
+        rows = np.zeros(BP, np.int32)
+        for s in range(D):
+            blk = slice(s * per, (s + 1) * per)
+            uniq, inv = np.unique(subj_key[blk], axis=0, return_inverse=True)
+            ulists.append(uniq)
+            rows[blk] = inv.astype(np.int32)
+        UP = _ceil_pow2(max(u.shape[0] for u in ulists), self.config.batch_bucket_min)
+        u_subj = np.full(D * UP, -1, np.int32)
+        u_srel = np.full(D * UP, -1, np.int32)
+        u_wc = np.full(D * UP, -1, np.int32)
+        for s, uniq in enumerate(ulists):
+            n = uniq.shape[0]
+            u_subj[s * UP : s * UP + n] = uniq[:, 0]
+            u_srel[s * UP : s * UP + n] = uniq[:, 1]
+            u_wc[s * UP : s * UP + n] = uniq[:, 2]
+        q["q_row"] = rows
+
+        now = jnp.int32(snap.now_rel32(now_us))
+        dsh = NamedSharding(self.mesh, P(DATA_AXIS))
+
+        def put(a):
+            return jax.device_put(a, dsh)
+
+        d, p, ovf = self._fn(
+            dsnap.arrays, dsnap.tid_map, now,
+            put(u_subj), put(u_srel), put(u_wc),
+            put(q["q_res"]), put(q["q_perm"]), put(q["q_subj"]),
+            put(q["q_srel"]), put(q["q_wc"]), put(q["q_row"]), put(q["q_self"]),
+        )
+        return (np.asarray(d)[:B], np.asarray(p)[:B], np.asarray(ovf)[:B])

@@ -1,13 +1,24 @@
 """Test bootstrap: force JAX onto CPU with 8 virtual devices so mesh/sharding
 logic is exercised without TPU hardware — the moral equivalent of the
-reference's `spicedb serve-testing` in-memory server (SURVEY.md §4)."""
+reference's `spicedb serve-testing` in-memory server (SURVEY.md §4).
+
+NOTE: the environment's sitecustomize pins JAX_PLATFORMS=axon (the real TPU
+tunnel); tests must override it, not setdefault, or the whole suite runs on
+one TPU chip with per-shape XLA compiles.  Set GOCHUGARU_TEST_TPU=1 to
+deliberately run the suite against the real chip."""
 
 import os
 
-# Must run before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+if os.environ.get("GOCHUGARU_TEST_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    # the axon sitecustomize pre-imports jax, so the env var alone is not
+    # honored — force the platform through the live config too (the backend
+    # itself initializes lazily, so XLA_FLAGS still takes effect)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -293,6 +293,8 @@ def test_updates_stream_and_resume():
     wctx = ctx.with_cancel()
 
     def consume():
+        # subscribes at head: the historical CREATE must NOT replay
+        # (Watch with no cursor starts at head, client/client.go:379-387)
         for u in c.updates(wctx, rel.UpdateFilter()):
             seen.append(u)
             if len(seen) >= 2:
@@ -300,20 +302,27 @@ def test_updates_stream_and_resume():
 
     t = threading.Thread(target=consume)
     t.start()
-    time.sleep(0.05)
+    time.sleep(0.1)
     t2 = rel.Txn()
     t2.delete(rel.must_from_triple("document:a", "reader", "user:amy"))
     c.write(ctx, t2)
+    t3 = rel.Txn()
+    t3.touch(rel.must_from_triple("document:b", "reader", "user:amy"))
+    c.write(ctx, t3)
     t.join(timeout=5)
     assert not t.is_alive()
-    assert [u.update_type for u in seen] == [rel.UpdateType.CREATE, rel.UpdateType.DELETE]
+    assert [u.update_type for u in seen] == [rel.UpdateType.DELETE, rel.UpdateType.TOUCH]
 
-    # resume from rev1: only the delete replays
+    # resume from rev1: the historical delete+touch replay in order
     resumed = []
     for u in c.updates_since_revision(wctx, rel.UpdateFilter(), rev1):
         resumed.append(u)
-        break
-    assert resumed[0].update_type == rel.UpdateType.DELETE
+        if len(resumed) >= 2:
+            break
+    assert [u.update_type for u in resumed] == [
+        rel.UpdateType.DELETE,
+        rel.UpdateType.TOUCH,
+    ]
 
     # cancellation ends the stream
     wctx.cancel()
@@ -327,6 +336,7 @@ def test_updates_filters():
         "definition user {}\ndefinition doc { relation viewer: user }\n"
         "definition folder { relation viewer: user }",
     )
+    _, rev0 = c.read_schema(ctx)
     txn = rel.Txn()
     txn.create(rel.must_from_triple("doc:a", "viewer", "user:amy"))
     txn.create(rel.must_from_triple("folder:f", "viewer", "user:amy"))
@@ -335,7 +345,7 @@ def test_updates_filters():
     wctx = ctx.with_cancel()
     got = []
     f = rel.UpdateFilter(object_types=["doc"])
-    for u in c.updates(wctx, f):
+    for u in c.updates_since_revision(wctx, f, rev0):
         got.append(u)
         break
     assert [u.relationship.resource_type for u in got] == ["doc"]

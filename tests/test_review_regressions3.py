@@ -1,0 +1,137 @@
+"""Regression tests for code-review findings (round 1, batch 4): the
+device engine must never return a silently wrong answer — caps trip the
+overflow flag (→ host fallback) instead."""
+
+import pytest
+
+from gochugaru_tpu import consistency, rel
+from gochugaru_tpu.client import new_tpu_evaluator, new_with_opts, with_host_only_evaluation, with_store
+from gochugaru_tpu.engine.device import DeviceEngine
+from gochugaru_tpu.engine.oracle import T, Oracle
+from gochugaru_tpu.engine.plan import EngineConfig
+from gochugaru_tpu.schema import compile_schema, parse_schema
+from gochugaru_tpu.store.interner import Interner
+from gochugaru_tpu.store.snapshot import build_snapshot
+from gochugaru_tpu.utils.context import background
+
+NOW = 1_700_000_000_000_000
+
+
+def test_mutually_recursive_permissions_with_acyclic_arrows():
+    # eval_iters must cover permission cycles even when arrows are acyclic
+    schema = """
+    definition user {}
+    definition folder { relation owner: user permission view = owner }
+    definition doc {
+        relation parent: folder
+        relation r1: user
+        relation r2: user
+        permission a = r1 + b
+        permission b = r2 + a + parent->view
+    }
+    """
+    ctx = background()
+    c = new_tpu_evaluator()
+    c.write_schema(ctx, schema)
+    txn = rel.Txn()
+    txn.create(rel.must_from_triple("doc:d", "r1", "user:amy"))
+    c.write(ctx, txn)
+    # amy has r1 → a → b must be granted through the cycle
+    assert c.check_one(
+        ctx, consistency.full(), rel.must_from_triple("doc:d", "b", "user:amy")
+    )
+    h = new_with_opts(with_host_only_evaluation(), with_store(c.store))
+    assert h.check_one(
+        ctx, consistency.full(), rel.must_from_triple("doc:d", "b", "user:amy")
+    )
+
+
+def _folder_chain(depth):
+    schema = """
+    definition user {}
+    definition folder {
+        relation parent: folder
+        relation reader: user
+        permission view = reader + parent->view
+    }
+    """
+    # reader sits at the root f0; f_i's parent is f_{i-1}, so a query on
+    # the deep end f_{depth-1} walks depth-1 arrow hops up to the root
+    triples = [("folder:f0#reader", "user:amy")]
+    for i in range(1, depth):
+        triples.append((f"folder:f{i}#parent", f"folder:f{i-1}"))
+    rels = [rel.must_from_tuple(*t) for t in triples]
+    cs = compile_schema(parse_schema(schema))
+    snap = build_snapshot(1, cs, Interner(), rels, epoch_us=NOW)
+    return cs, snap, rels
+
+
+def test_chain_deeper_than_subgraph_overflows_not_wrong():
+    cs, snap, rels = _folder_chain(12)
+    engine = DeviceEngine(cs, EngineConfig.for_schema(cs, subgraph_nodes=8))
+    dsnap = engine.prepare(snap)
+    oracle = Oracle(cs, rels, now_us=NOW)
+    # query from the deep end: needs an 11-hop walk, subgraph capped at 8
+    q = rel.must_from_triple("folder:f11", "view", "user:amy")
+    assert oracle.check_relationship(q) == T
+    d, p, ovf = engine.check_batch(dsnap, [q], now_us=NOW)
+    assert ovf[0] or d[0], "deep chain must overflow (or resolve), never silently deny"
+    assert ovf[0], "subgraph deeper than the cap must trip overflow"
+
+
+def test_chain_deeper_than_cap_correct_via_client_fallback():
+    ctx = background()
+    c = new_tpu_evaluator()
+    c.write_schema(
+        ctx,
+        """
+        definition user {}
+        definition folder {
+            relation parent: folder
+            relation reader: user
+            permission view = reader + parent->view
+        }
+        """,
+    )
+    txn = rel.Txn()
+    depth = 12
+    txn.create(rel.must_from_triple("folder:f0", "reader", "user:amy"))
+    for i in range(1, depth):
+        txn.create(rel.must_from_triple(f"folder:f{i}", "parent", f"folder:f{i-1}"))
+    c.write(ctx, txn)
+    assert c.check_one(
+        ctx, consistency.full(),
+        rel.must_from_triple(f"folder:f{depth-1}", "view", "user:amy"),
+    )
+
+
+def test_nesting_deeper_than_closure_hops_overflows_not_wrong():
+    schema = """
+    definition user {}
+    definition group { relation member: user | group#member }
+    definition doc { relation viewer: group#member permission view = viewer }
+    """
+    depth = 12
+    triples = [("group:g0#member", "user:amy")]
+    for i in range(1, depth):
+        triples.append((f"group:g{i}#member", f"group:g{i-1}#member"))
+    triples.append((f"doc:d#viewer", f"group:g{depth-1}#member"))
+    rels = [rel.must_from_tuple(*t) for t in triples]
+    cs = compile_schema(parse_schema(schema))
+    snap = build_snapshot(1, cs, Interner(), rels, epoch_us=NOW)
+    oracle = Oracle(cs, rels, now_us=NOW)
+    engine = DeviceEngine(cs, EngineConfig.for_schema(cs, closure_hops=8))
+    dsnap = engine.prepare(snap)
+    q = rel.must_from_triple("doc:d", "view", "user:amy")
+    assert oracle.check_relationship(q) == T
+    d, p, ovf = engine.check_batch(dsnap, [q], now_us=NOW)
+    assert d[0] or ovf[0], "deep nesting must overflow (or resolve), never silently deny"
+    # and through the client the fallback resolves it correctly
+    ctx = background()
+    c = new_tpu_evaluator()
+    c.write_schema(ctx, schema)
+    txn = rel.Txn()
+    for t in triples:
+        txn.create(rel.must_from_tuple(*t))
+    c.write(ctx, txn)
+    assert c.check_one(ctx, consistency.full(), q)
