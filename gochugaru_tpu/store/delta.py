@@ -1,0 +1,232 @@
+"""Incremental snapshot materialization (Watch-driven re-index).
+
+A full rebuild (`build_snapshot`) walks every live relationship through
+Python objects, re-interns, and re-sorts — O(E log E) with a Python-loop
+constant.  That is fine at write-schema time, but BASELINE config 5
+(Leopard-scale Watch-driven re-index) needs each new revision to cost
+O(E + D log D) for a delta of D updates against an E-edge graph, with no
+per-old-edge Python work.
+
+`apply_delta` takes the previous revision's Snapshot plus the collapsed
+delta (last-writer-wins per tuple key) and produces the next Snapshot by:
+
+1. lowering only the delta's relationships to int32 columns (interning at
+   most O(D) new strings),
+2. locating the delta keys in the previous primary order with a two-level
+   packed-int64 binary search ((rel,res) run, then (subj,srel1) inside the
+   run — the primary sort is lex (rel, res, subj, srel1) so both levels
+   are sorted),
+3. tombstoning replaced/deleted rows and merging the surviving rows with
+   the sorted additions in one O(E + D) pass, and
+4. re-deriving the secondary views (userset / membership / arrow) through
+   the same `finish_snapshot` used by the full build, so delta and full
+   materialization produce identical snapshots by construction.
+
+The derived views are O(E) vectorized work with small constants; the
+expensive parts of a full rebuild (per-edge Python, global lexsort,
+re-interning) are all avoided.  Reference semantics being reproduced:
+the Watch feed is the ordered update log (client/client.go:364-413) and a
+revision is a consistent snapshot of it (consistency/consistency.go).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..rel.relationship import Relationship, expiration_micros
+from ..schema.compiler import CompiledSchema
+from .interner import Interner
+from .snapshot import Snapshot, _exp_to_rel32, finish_snapshot
+
+# (rel, res) packed: rel < 2**15 slots, res < 2**31 nodes → 46 bits.
+_RES_BITS = 31
+# (subj, srel1) packed: subj < 2**31, srel1 < 2**16 → 47 bits.
+_SREL_BITS = 16
+
+
+def _pack_rr(rel: np.ndarray, res: np.ndarray) -> np.ndarray:
+    return (rel.astype(np.int64) << _RES_BITS) | res.astype(np.int64)
+
+
+def _pack_ss(subj: np.ndarray, srel1: np.ndarray) -> np.ndarray:
+    return (subj.astype(np.int64) << _SREL_BITS) | srel1.astype(np.int64)
+
+
+def _grouped(inverse: np.ndarray) -> "list[np.ndarray]":
+    """Index arrays of each group in ``inverse`` (np.unique's inverse),
+    in group order — argsort+split so grouping is O(D log D) total, not
+    O(runs × D)."""
+    order = np.argsort(inverse, kind="stable")
+    counts = np.bincount(inverse)
+    return np.split(order, np.cumsum(counts)[:-1])
+
+
+def _locate(
+    prev: Snapshot, rel: np.ndarray, res: np.ndarray,
+    subj: np.ndarray, srel1: np.ndarray,
+) -> np.ndarray:
+    """Row index in prev's primary arrays of each (rel,res,subj,srel1)
+    identity, or -1 when absent.  Two-level search, vectorized over the
+    (rel,res) runs the queries land in."""
+    D = rel.shape[0]
+    out = np.full(D, -1, dtype=np.int64)
+    if D == 0 or prev.e_rel.shape[0] == 0:
+        return out
+    prev_rr = _pack_rr(prev.e_rel, prev.e_res)
+    prev_ss = _pack_ss(prev.e_subj, prev.e_srel1)
+    q_rr = _pack_rr(rel, res)
+    q_ss = _pack_ss(subj, srel1)
+    lo = np.searchsorted(prev_rr, q_rr, side="left")
+    hi = np.searchsorted(prev_rr, q_rr, side="right")
+    # group queries by run so each run's slice is searched once
+    nonempty = hi > lo
+    runs, inverse = np.unique(lo[nonempty], return_inverse=True)
+    idx_nonempty = np.nonzero(nonempty)[0]
+    for run_lo, group in zip(runs, _grouped(inverse)):
+        members = idx_nonempty[group]
+        run_hi = hi[members[0]]
+        seg = prev_ss[run_lo:run_hi]
+        pos = np.searchsorted(seg, q_ss[members], side="left")
+        ok = (pos < seg.shape[0]) & (seg[np.minimum(pos, seg.shape[0] - 1)] == q_ss[members])
+        out[members[ok]] = run_lo + pos[ok]
+    return out
+
+
+def _lower_delta(
+    compiled: CompiledSchema,
+    interner: Interner,
+    rels: Sequence[Relationship],
+    contexts: List[Mapping[str, Any]],
+) -> Tuple[np.ndarray, ...]:
+    """Relationship objects → unsorted int columns (interning new strings),
+    appending any caveat contexts to ``contexts`` in place."""
+    D = len(rels)
+    res = np.empty(D, dtype=np.int64)
+    rel_s = np.empty(D, dtype=np.int64)
+    subj = np.empty(D, dtype=np.int64)
+    srel1 = np.empty(D, dtype=np.int64)
+    cav = np.zeros(D, dtype=np.int32)
+    ctx = np.full(D, -1, dtype=np.int32)
+    exp_us = np.zeros(D, dtype=np.int64)
+    slot_of = compiled.slot_of_name
+    caveat_ids = compiled.caveat_ids
+    for i, r in enumerate(rels):
+        res[i] = interner.node(r.resource_type, r.resource_id)
+        rel_s[i] = slot_of[r.resource_relation]
+        subj[i] = interner.node(r.subject_type, r.subject_id)
+        srel1[i] = slot_of[r.subject_relation] + 1 if r.subject_relation else 0
+        if r.caveat_name:
+            cav[i] = caveat_ids[r.caveat_name]
+            if r.caveat_context:
+                ctx[i] = len(contexts)
+                contexts.append(r.caveat_context)
+        exp_us[i] = expiration_micros(r.expiration) if r.has_expiration() else 0
+    return res, rel_s, subj, srel1, cav, ctx, exp_us
+
+
+def apply_delta(
+    prev: Snapshot,
+    revision: int,
+    adds: Sequence[Relationship],
+    deletes: Sequence[Relationship],
+    *,
+    interner: Optional[Interner] = None,
+) -> Snapshot:
+    """Next-revision Snapshot from the previous one plus a collapsed delta.
+
+    ``adds`` are upserts (CREATE/TOUCH both replace any existing row with
+    the same tuple key, matching the store's keyed ``_live`` dict);
+    ``deletes`` are tuple keys to remove (extra keys not present are
+    ignored, matching DELETE semantics).  A key must not appear in both —
+    the store collapses the delta last-writer-wins before calling this.
+    """
+    interner = interner if interner is not None else prev.interner
+    compiled = prev.compiled
+    contexts = list(prev.contexts)
+
+    a_res, a_rel, a_subj, a_srel1, a_cav, a_ctx, a_exp_us = _lower_delta(
+        compiled, interner, adds, contexts
+    )
+    d_contexts: List[Mapping[str, Any]] = []
+    d_res, d_rel, d_subj, d_srel1, _, _, _ = _lower_delta(
+        compiled, interner, deletes, d_contexts
+    )
+
+    # tombstone every row whose identity is re-added or deleted
+    gone = np.concatenate([
+        _locate(prev, a_rel, a_res, a_subj, a_srel1),
+        _locate(prev, d_rel, d_res, d_subj, d_srel1),
+    ]) if (len(adds) + len(deletes)) else np.empty(0, np.int64)
+    keep = np.ones(prev.e_rel.shape[0], dtype=bool)
+    keep[gone[gone >= 0]] = False
+
+    # sort the additions by the primary order
+    a_order = np.lexsort((a_srel1, a_subj, a_res, a_rel))
+    a_exp32 = _exp_to_rel32(a_exp_us, prev.epoch_us)
+
+    # merge positions: surviving old rows and sorted additions interleaved
+    # by (rel,res | subj,srel1); computed on the packed keys so the merge
+    # itself is one argsort-free scatter.
+    old_rr = _pack_rr(prev.e_rel, prev.e_res)[keep]
+    old_ss = _pack_ss(prev.e_subj, prev.e_srel1)[keep]
+    new_rr = _pack_rr(a_rel, a_res)[a_order]
+    new_ss = _pack_ss(a_subj, a_srel1)[a_order]
+    E0, A = old_rr.shape[0], new_rr.shape[0]
+
+    # insertion index of each addition among surviving old rows (two-level:
+    # run by (rel,res), then (subj,srel1) within the run)
+    ins = np.searchsorted(old_rr, new_rr, side="left")
+    hi = np.searchsorted(old_rr, new_rr, side="right")
+    run = hi > ins
+    if np.any(run):
+        runs, inverse = np.unique(ins[run], return_inverse=True)
+        idx_run = np.nonzero(run)[0]
+        for run_lo, group in zip(runs, _grouped(inverse)):
+            members = idx_run[group]
+            run_hi = hi[members[0]]
+            seg = old_ss[run_lo:run_hi]
+            ins[members] = run_lo + np.searchsorted(seg, new_ss[members], side="left")
+
+    # final position of old row i: i + (#additions inserted before it);
+    # of addition j (sorted): ins[j] + j (stable: adds after equal olds —
+    # identities are unique so ties cannot occur anyway)
+    add_before = np.zeros(E0 + 1, dtype=np.int64)
+    np.add.at(add_before, ins, 1)
+    add_before = np.cumsum(add_before)[:E0 + 1]
+    pos_old = np.arange(E0, dtype=np.int64) + add_before[:E0]
+    pos_new = ins + np.arange(A, dtype=np.int64)
+
+    def interleave(old: np.ndarray, new: np.ndarray) -> np.ndarray:
+        out = np.empty(E0 + A, dtype=old.dtype)
+        out[pos_old] = old[keep]
+        out[pos_new] = new
+        return out
+
+    e_rel = interleave(prev.e_rel, a_rel[a_order].astype(np.int32))
+    e_res = interleave(prev.e_res, a_res[a_order].astype(np.int32))
+    e_subj = interleave(prev.e_subj, a_subj[a_order].astype(np.int32))
+    e_srel1 = interleave(prev.e_srel1, a_srel1[a_order].astype(np.int32))
+    e_cav = interleave(prev.e_caveat, a_cav[a_order])
+    e_ctx = interleave(prev.e_ctx, a_ctx[a_order])
+    e_exp = interleave(prev.e_exp, a_exp32[a_order])
+    e_exp_us = interleave(prev.e_exp_us, a_exp_us[a_order])
+
+    # compact contexts: tombstoned rows' dicts would otherwise accumulate
+    # forever across chained deltas (each snapshot copies the list)
+    used = e_ctx >= 0
+    if np.any(used):
+        live_ctx, inv = np.unique(e_ctx[used], return_inverse=True)
+        contexts = [contexts[i] for i in live_ctx]
+        e_ctx = e_ctx.copy()
+        e_ctx[used] = inv.astype(np.int32)
+    else:
+        contexts = []
+
+    return finish_snapshot(
+        revision, compiled, interner,
+        e_rel=e_rel, e_res=e_res, e_subj=e_subj, e_srel1=e_srel1,
+        e_caveat=e_cav, e_ctx=e_ctx, e_exp=e_exp, e_exp_us=e_exp_us,
+        contexts=contexts, epoch_us=prev.epoch_us,
+    )

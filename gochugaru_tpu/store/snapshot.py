@@ -311,11 +311,51 @@ def build_snapshot_from_columns(
     srel = srel.astype(np.int64)
     exp32 = _exp_to_rel32(exp_us.astype(np.int64), epoch_us)
 
-    node_type = interner.node_type_array()
-    num_nodes = max(len(interner), 1)
     num_slots = max(compiled.num_slots, 1)
     if num_slots > 2**15:
         raise ValueError("schemas with >32768 relation/permission names unsupported")
+
+    srel1 = srel + 1
+
+    order = np.lexsort((srel1, subj, res, rel))
+    return finish_snapshot(
+        revision, compiled, interner,
+        e_rel=rel[order].astype(np.int32),
+        e_res=res[order].astype(np.int32),
+        e_subj=subj[order].astype(np.int32),
+        e_srel1=srel1[order].astype(np.int32),
+        e_caveat=caveat[order],
+        e_ctx=ctx[order],
+        e_exp=exp32[order],
+        e_exp_us=exp_us.astype(np.int64)[order],
+        contexts=contexts,
+        epoch_us=epoch_us,
+    )
+
+
+def finish_snapshot(
+    revision: int,
+    compiled: CompiledSchema,
+    interner: Interner,
+    *,
+    e_rel: np.ndarray,
+    e_res: np.ndarray,
+    e_subj: np.ndarray,
+    e_srel1: np.ndarray,
+    e_caveat: np.ndarray,
+    e_ctx: np.ndarray,
+    e_exp: np.ndarray,
+    e_exp_us: np.ndarray,
+    contexts: List[Mapping[str, Any]],
+    epoch_us: int,
+) -> Snapshot:
+    """Derive every secondary view from primary columns already sorted lex
+    by (rel, res, subj, srel1).  Shared by the full build above and the
+    incremental delta path (store/delta.py), so both produce identical
+    snapshots by construction."""
+    node_type = interner.node_type_array()
+    num_nodes = max(len(interner), 1)
+    num_slots = max(compiled.num_slots, 1)
 
     wc = np.full(max(interner.num_types, 1), -1, dtype=np.int32)
     for tname in compiled.type_ids:
@@ -323,19 +363,11 @@ def build_snapshot_from_columns(
         if n >= 0:
             wc[interner.type_lookup(tname)] = n
 
-    srel1 = srel + 1
-
-    order = np.lexsort((srel1, subj, res, rel))
-    e_rel = rel[order].astype(np.int32)
-    e_res = res[order].astype(np.int32)
-    e_subj = subj[order].astype(np.int32)
-    e_srel1 = srel1[order].astype(np.int32)
-    e_cav = caveat[order]
-    e_ctx = ctx[order]
-    e_exp = exp32[order]
-    e_exp_us = exp_us.astype(np.int64)[order]
-
-    res_o, rel_o, subj_o, srel_o = res[order], rel[order], subj[order], srel[order]
+    e_cav = e_caveat
+    rel_o = e_rel.astype(np.int64)
+    res_o = e_res.astype(np.int64)
+    subj_o = e_subj.astype(np.int64)
+    srel_o = e_srel1.astype(np.int64) - 1
 
     # userset view (sorted by rel, res — inherited from the primary order)
     is_us = srel_o >= 0

@@ -19,6 +19,7 @@ one lock with RCU-style snapshot swaps).  Semantics enforced:
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from dataclasses import dataclass
@@ -288,14 +289,45 @@ class Store:
             return self._head_rev
 
     def _materialize_locked(self, rev: int) -> Snapshot:
-        snap = build_snapshot(
-            rev, self._require_schema(), self.interner, list(self._live.values())
-        )
+        snap = self._delta_materialize_locked(rev)
+        if snap is None:
+            snap = build_snapshot(
+                rev, self._require_schema(), self.interner, list(self._live.values())
+            )
         self._snapshots[rev] = snap
         if len(self._snapshots) > self._keep_generations:
             for old in sorted(self._snapshots)[: len(self._snapshots) - self._keep_generations]:
                 del self._snapshots[old]
         return snap
+
+    def _delta_materialize_locked(self, rev: int) -> Optional[Snapshot]:
+        """Incremental path: advance the newest materialized snapshot to
+        ``rev`` by replaying the update log through store/delta.py's sorted
+        merge — the Watch-driven re-index of BASELINE config 5.  Returns
+        None when a full rebuild is required (no usable base, schema
+        changed since the base, or the delta rivals the graph in size)."""
+        if not self._snapshots:
+            return None
+        base_rev = max(self._snapshots)
+        base = self._snapshots[base_rev]
+        if base_rev >= rev or base.compiled is not self._compiled:
+            return None
+        collapsed: Dict[_Key, Tuple[bool, Relationship]] = {}
+        start = bisect.bisect_right(self._log, base_rev, key=lambda e: e.revision)
+        for entry in self._log[start:]:
+            if entry.revision > rev:
+                break
+            for u in entry.updates:
+                key = u.relationship.key()
+                is_add = u.update_type in (UpdateType.CREATE, UpdateType.TOUCH)
+                collapsed[key] = (is_add, u.relationship)
+        if len(collapsed) > max(1024, base.num_edges // 4):
+            return None
+        adds = [r for is_add, r in collapsed.values() if is_add]
+        deletes = [r for is_add, r in collapsed.values() if not is_add]
+        from .delta import apply_delta
+
+        return apply_delta(base, rev, adds, deletes, interner=self.interner)
 
     def snapshot_for(self, strategy: Strategy) -> Snapshot:
         """Select (materializing if needed) the snapshot generation a

@@ -1,0 +1,223 @@
+"""Incremental (delta) materialization == full rebuild, by construction.
+
+BASELINE config 5's Watch-driven re-index: each new revision advances the
+previous snapshot via store/delta.py's sorted merge.  These tests drive a
+randomized update stream through the Store twice — once forcing full
+rebuilds, once through the delta path — and require the primary and
+derived columns to be bit-identical (contexts are index-mapped, so e_ctx
+is compared through the decoded relationships instead)."""
+
+import dataclasses
+import datetime as dt
+import random
+
+import numpy as np
+import pytest
+
+from gochugaru_tpu import consistency, rel
+from gochugaru_tpu.rel.filter import Filter, PreconditionedFilter
+from gochugaru_tpu.rel.txn import Txn
+from gochugaru_tpu.store.delta import apply_delta
+from gochugaru_tpu.store.snapshot import build_snapshot
+from gochugaru_tpu.store.store import Store
+
+SCHEMA = """
+caveat ip_ok(allowed int) { allowed == 1 }
+definition user {}
+definition team { relation member: user | team#member }
+definition doc {
+    relation owner: team
+    relation reader: user | user with ip_ok | user:* | team#member
+    permission view = reader + owner->member
+}
+"""
+
+COLS = [
+    "e_rel", "e_res", "e_subj", "e_srel1", "e_caveat", "e_exp", "e_exp_us",
+    "us_rel", "us_res", "us_subj", "us_srel", "us_caveat", "us_exp",
+    "ms_subj", "ms_res", "ms_rel", "ms_caveat", "ms_exp",
+    "mp_subj", "mp_srel", "mp_res", "mp_rel", "mp_caveat", "mp_exp",
+    "ar_rel", "ar_res", "ar_child", "ar_caveat", "ar_exp",
+]
+
+
+def _assert_snapshots_equal(got, want):
+    for c in COLS:
+        np.testing.assert_array_equal(
+            getattr(got, c), getattr(want, c), err_msg=f"column {c}"
+        )
+    got_rels = sorted(str(got.decode_edge(i)) for i in range(got.num_edges))
+    want_rels = sorted(str(want.decode_edge(i)) for i in range(want.num_edges))
+    assert got_rels == want_rels
+
+
+def _random_rel(rng, with_caveat=True):
+    r = rel.must_from_tuple(
+        f"doc:d{rng.randrange(20)}#{rng.choice(['owner', 'reader'])}",
+        rng.choice(
+            [
+                f"user:u{rng.randrange(30)}",
+                f"team:t{rng.randrange(5)}#member",
+                "user:*",
+            ]
+        ),
+    )
+    if r.resource_relation == "owner":
+        r = rel.must_from_tuple(
+            f"doc:{r.resource_id}#owner", f"team:t{rng.randrange(5)}"
+        )
+    elif (
+        with_caveat
+        and r.subject_type == "user"
+        and not r.subject_relation
+        and r.subject_id != "*"
+        and rng.random() < 0.4
+    ):
+        r = r.with_caveat("ip_ok", {"allowed": rng.randrange(2)})
+    if rng.random() < 0.2:
+        r = r.with_expiration(
+            dt.datetime(2030, 1, 1, tzinfo=dt.timezone.utc)
+            + dt.timedelta(days=rng.randrange(100))
+        )
+    return r
+
+
+def test_apply_delta_matches_full_build():
+    rng = random.Random(3)
+    store = Store()
+    store.write_schema(SCHEMA)
+    base_rels = [_random_rel(rng) for _ in range(60)]
+    txn = Txn()
+    seen = set()
+    for r in base_rels:
+        if r.key() not in seen:
+            txn.touch(r)
+            seen.add(r.key())
+    store.write(txn)
+    full = consistency.full()
+    base = store.snapshot_for(full)  # first materialization: full build
+
+    # a batch of touches (some replacing), creates, and deletes
+    live = store.live_relationships()
+    adds = [_random_rel(rng) for _ in range(25)]
+    dels = rng.sample(live, 10)
+    add_keys = {r.key() for r in adds}
+    dels = [r for r in dels if r.key() not in add_keys]
+    t2 = Txn()
+    done = set()
+    for r in adds:
+        if r.key() not in done:
+            t2.touch(r)
+            done.add(r.key())
+    for r in dels:
+        t2.delete(r)
+    store.write(t2)
+
+    got = store.snapshot_for(full)
+    assert got.revision > base.revision
+    want = build_snapshot(
+        got.revision,
+        store.compiled_schema,
+        store.interner,
+        store.live_relationships(),
+        epoch_us=got.epoch_us,
+    )
+    _assert_snapshots_equal(got, want)
+
+
+def test_delta_stream_many_revisions():
+    rng = random.Random(11)
+    store = Store()
+    store.write_schema(SCHEMA)
+    full = consistency.full()
+    for step in range(12):
+        t = Txn()
+        done = set()
+        for _ in range(rng.randrange(1, 12)):
+            r = _random_rel(rng)
+            if r.key() in done:
+                continue
+            done.add(r.key())
+            if rng.random() < 0.25:
+                t.delete(r)
+            else:
+                t.touch(r)
+        store.write(t)
+        if rng.random() < 0.3:
+            store.delete_by_filter(
+                PreconditionedFilter(Filter("doc", f"d{rng.randrange(20)}", ""))
+            )
+        got = store.snapshot_for(full)
+        want = build_snapshot(
+            got.revision,
+            store.compiled_schema,
+            store.interner,
+            store.live_relationships(),
+            epoch_us=got.epoch_us,
+        )
+        _assert_snapshots_equal(got, want)
+
+
+def test_delta_contexts_do_not_accumulate():
+    """Touching the same caveated tuple revision after revision must not
+    grow the snapshot's contexts list (tombstoned rows' dicts are
+    compacted away in the delta merge)."""
+    store = Store()
+    store.write_schema(SCHEMA)
+    full = consistency.full()
+    r = rel.must_from_tuple("doc:d0#reader", "user:u0")
+    for i in range(30):
+        store.write(Txn().touch(r.with_caveat("ip_ok", {"allowed": i % 2})))
+        snap = store.snapshot_for(full)
+    assert snap.num_edges == 1
+    assert len(snap.contexts) == 1
+    assert snap.decode_edge(0).caveat_context == {"allowed": 1}
+
+
+def test_delta_checks_agree_with_oracle():
+    """End-to-end: checks evaluated on a delta-materialized snapshot match
+    the host oracle built from the live set."""
+    from gochugaru_tpu.engine.device import DeviceEngine
+    from gochugaru_tpu.engine.oracle import Oracle, T
+
+    rng = random.Random(7)
+    store = Store()
+    store.write_schema(SCHEMA)
+    full = consistency.full()
+    t = Txn()
+    done = set()
+    for _ in range(40):
+        r = _random_rel(rng, with_caveat=False)
+        if r.key() not in done:
+            t.touch(r)
+            done.add(r.key())
+    store.write(t)
+    store.snapshot_for(full)
+    t2 = Txn()
+    done2 = set()
+    for _ in range(15):
+        r = _random_rel(rng, with_caveat=False)
+        if r.key() not in done2:
+            t2.touch(r)
+            done2.add(r.key())
+    store.write(t2)
+    snap = store.snapshot_for(full)
+
+    now_us = 1_700_000_000_000_000
+    from gochugaru_tpu.engine.plan import EngineConfig
+
+    cfg = EngineConfig.for_schema(snap.compiled)
+    # a doc may own several teams; widen the arrow subgraph past the
+    # schema-depth default so no query needs the host-fallback path here
+    cfg = dataclasses.replace(cfg, subgraph_nodes=16, arrow_fanout=8)
+    engine = DeviceEngine(snap.compiled, cfg)
+    dsnap = engine.prepare(snap)
+    oracle = Oracle(snap.compiled, store.live_relationships(), now_us=now_us)
+    checks = [
+        rel.must_from_triple(f"doc:d{rng.randrange(20)}", "view", f"user:u{rng.randrange(30)}")
+        for _ in range(48)
+    ]
+    d, p, ovf = engine.check_batch(dsnap, checks, now_us=now_us)
+    assert not ovf.any()
+    for i, q in enumerate(checks):
+        assert bool(d[i]) == (oracle.check_relationship(q) == T), str(q)
