@@ -1,0 +1,66 @@
+"""BASELINE config 1 — the README "founders" CheckAll example
+(/root/reference/README.md:64-89) through the full Client path.
+
+This measures the *ergonomic* end-to-end surface (parse → intern → device
+dispatch → reduction), not raw device throughput: the reference example is
+3 direct-relation triples, so the interesting number is round-trip latency
+of a tiny CheckAll — the reference's equivalent round-trips a gRPC
+CheckBulkPermissions to a SpiceDB container.
+"""
+
+import time
+
+import numpy as np
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+from benchmarks.common import NORTH_STAR_P99_MS, emit, note
+
+from gochugaru_tpu import consistency, rel
+from gochugaru_tpu.client import new_tpu_evaluator
+from gochugaru_tpu.rel.txn import Txn
+from gochugaru_tpu.utils.context import background
+
+SCHEMA = """
+definition user {}
+definition document {
+    relation founder: user
+    permission view = founder
+}
+"""
+
+
+def main() -> None:
+    client = new_tpu_evaluator()
+    ctx = background()
+    client.write_schema(ctx, SCHEMA)
+    txn = Txn()
+    founders = []
+    for name in ("jake", "joey", "jimmy"):
+        r = rel.must_from_triple("document:readme", "founder", f"user:{name}")
+        txn.touch(r)
+        founders.append(rel.must_from_triple("document:readme", "view", f"user:{name}"))
+    client.write(ctx, txn)
+
+    cs = consistency.min_latency()
+    assert client.check_all(ctx, cs, *founders)
+
+    # warm, then time individual CheckAll round trips
+    for _ in range(3):
+        client.check_all(ctx, cs, *founders)
+    ts = []
+    for _ in range(200):
+        t0 = time.perf_counter()
+        client.check_all(ctx, cs, *founders)
+        ts.append((time.perf_counter() - t0) * 1000)
+    a = np.asarray(ts)
+    p50, p99 = float(np.percentile(a, 50)), float(np.percentile(a, 99))
+    emit("founders_checkall_p99_latency", p99, "ms", NORTH_STAR_P99_MS / max(p99, 1e-9))
+    note(f"p50={p50:.3f}ms p99={p99:.3f}ms mean={a.mean():.3f}ms n=200")
+
+
+if __name__ == "__main__":
+    main()

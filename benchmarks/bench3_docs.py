@@ -1,0 +1,163 @@
+"""BASELINE config 3 — Google-Docs-style nested groups: 1M docs / 10M
+edges, 5-hop recursive userset rewrites (folder trees + nested groups),
+100k-check batches on one chip.
+
+Recursion exercised: ``folder#view = viewer + parent->view`` is a
+self-recursive arrow (SpiceDB's recursive hierarchy pattern) and
+``group#member`` nests 5 deep — both the closure walk and the subgraph
+fixpoint must iterate (SURVEY.md §7 "recursive/unbounded rewrites").
+"""
+
+import numpy as np
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+from benchmarks.common import (
+    NORTH_STAR_P99_MS,
+    NORTH_STAR_RATE,
+    emit,
+    latency_percentiles,
+    note,
+    time_steady,
+)
+
+SCHEMA = """
+definition user {}
+definition group { relation member: user | group#member }
+definition folder {
+    relation parent: folder
+    relation viewer: user | group#member
+    permission view = viewer + parent->view
+}
+definition document {
+    relation folder: folder
+    relation viewer: user | group#member
+    permission view = viewer + folder->view
+}
+"""
+
+N_USERS = 100_000
+N_GROUPS = 10_000
+N_FOLDERS = 50_000
+N_DOCS = 1_000_000
+BATCH = 100_000
+SEED = 23
+EPOCH = 1_700_000_000_000_000
+
+
+def build_world():
+    from gochugaru_tpu.schema import compile_schema, parse_schema
+    from gochugaru_tpu.store.interner import Interner
+    from gochugaru_tpu.store.snapshot import build_snapshot_from_columns
+
+    cs = compile_schema(parse_schema(SCHEMA))
+    interner = Interner()
+    rng = np.random.default_rng(SEED)
+
+    users = np.array(
+        [interner.node("user", f"u{i}") for i in range(N_USERS)], np.int64
+    )
+    groups = np.array(
+        [interner.node("group", f"g{i}") for i in range(N_GROUPS)], np.int64
+    )
+    folders = np.array(
+        [interner.node("folder", f"f{i}") for i in range(N_FOLDERS)], np.int64
+    )
+    docs = np.array(
+        [interner.node("document", f"d{i}") for i in range(N_DOCS)], np.int64
+    )
+    slot = cs.slot_of_name
+    member, parent, viewer, folder_rel = (
+        slot["member"], slot["parent"], slot["viewer"], slot["folder"],
+    )
+
+    res, rel, subj, srel = [], [], [], []
+
+    def bulk(r, rl, s, sr):
+        res.append(np.asarray(r, np.int64))
+        rel.append(np.full(len(r), rl, np.int64))
+        subj.append(np.asarray(s, np.int64))
+        srel.append(np.full(len(r), sr, np.int64))
+
+    # group nesting: chains of depth 5 (g[i] contains g[i+1]#member);
+    # leaves get direct user members
+    chain_mask = np.arange(N_GROUPS - 1)
+    deep = chain_mask[(chain_mask % 5) != 4]  # break chains every 5 groups
+    bulk(groups[deep], member, groups[deep + 1], member)
+    per_group = 6
+    gm_res = np.repeat(groups, per_group)
+    bulk(gm_res, member, rng.choice(users, gm_res.shape[0]), -1)
+
+    # folder trees: arity-16 forest → depth ≤ ⌈log16(50k)⌉ = 4, so a doc
+    # check traverses ≤ 5 arrows (doc → folder → … → root)
+    f_idx = np.arange(1, N_FOLDERS)
+    parents = (f_idx - 1) // 16
+    bulk(folders[f_idx], parent, folders[parents], -1)
+    # folder viewers: mostly groups (userset), some direct
+    fv = rng.random(N_FOLDERS) < 0.5
+    bulk(folders[fv], viewer, rng.choice(groups, int(fv.sum())), member)
+    bulk(folders[~fv], viewer, rng.choice(users, int((~fv).sum())), -1)
+
+    # documents: every doc in a folder; ~20% also have direct viewers
+    bulk(docs, folder_rel, rng.choice(folders, N_DOCS), -1)
+    extra = rng.random(N_DOCS) < 0.2
+    bulk(docs[extra], viewer, rng.choice(users, int(extra.sum())), -1)
+    # top up with group-viewer docs to reach ~10M edges
+    cur = sum(a.shape[0] for a in res)
+    want = 10_000_000
+    if cur < want:
+        k = want - cur
+        bulk(rng.choice(docs, k), viewer, rng.choice(groups, k), member)
+
+    snap = build_snapshot_from_columns(
+        1, cs, interner,
+        res=np.concatenate(res), rel=np.concatenate(rel),
+        subj=np.concatenate(subj), srel=np.concatenate(srel),
+        epoch_us=EPOCH,
+    )
+    return cs, snap, users, docs, slot
+
+
+def main() -> None:
+    from gochugaru_tpu.engine.device import DeviceEngine
+
+    cs, snap, users, docs, slot = build_world()
+    note(f"edges={snap.num_edges} nodes={snap.num_nodes}")
+    engine = DeviceEngine(cs)
+    dsnap = engine.prepare(snap)
+
+    rng = np.random.default_rng(7)
+    B = 1 << (BATCH - 1).bit_length()
+    q_res = rng.choice(docs, B).astype(np.int32)
+    q_perm = np.full(B, slot["view"], np.int32)
+    q_subj = rng.choice(users, B).astype(np.int32)
+
+    def dispatch():  # pipelined device dispatch, no per-call readback
+        return engine.check_columns(
+            dsnap, q_res, q_perm, q_subj, now_us=EPOCH, fetch=False
+        )
+
+    def roundtrip():  # end-to-end including the device→host fetch
+        return engine.check_columns(dsnap, q_res, q_perm, q_subj, now_us=EPOCH)
+
+    dt = time_steady(dispatch, reps=5)
+    rate = B / dt
+    d, p, ovf = roundtrip()
+    note(
+        f"batch={B} step={dt*1000:.1f}ms granted={int(d.sum())}"
+        f" overflow={int(ovf.sum())}"
+    )
+    emit(
+        "docs_5hop_bulk_check_throughput", rate, "checks/sec/chip",
+        rate / NORTH_STAR_RATE,
+    )
+    p50, p99, mean = latency_percentiles(roundtrip, reps=20)
+    emit("docs_5hop_batch_p99_latency", p99, "ms", NORTH_STAR_P99_MS / max(p99, 1e-9))
+    note(f"p50={p50:.2f}ms p99={p99:.2f}ms mean={mean:.2f}ms")
+
+
+if __name__ == "__main__":
+    main()

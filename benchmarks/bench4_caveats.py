@@ -1,0 +1,178 @@
+"""BASELINE config 4 — multi-tenant SaaS with caveats at 100M edges:
+on-device CEL caveat predicate evaluation (caveats/device.py).
+
+Every grant edge carries a ``same_tenant`` caveat whose stored context
+pins the edge's tenant; the query context supplies the caller's tenant.
+The predicate (string equality + int tier comparison) runs inside the
+jitted check — zero host fallbacks is part of the assertion.
+
+Size note: 100M edges ≈ 3.4 GB of padded int32 columns on device.  Use
+``--edges`` to scale down on small hosts; the driver-facing headline
+(bench.py) stays config 2.
+"""
+
+import argparse
+
+import numpy as np
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+from benchmarks.common import (
+    NORTH_STAR_P99_MS,
+    NORTH_STAR_RATE,
+    emit,
+    latency_percentiles,
+    note,
+    time_steady,
+)
+
+SCHEMA = """
+caveat same_tenant(tenant string, edge_tenant string, tier int) {
+    tenant == edge_tenant && tier >= 1
+}
+definition user {}
+definition org { relation admin: user }
+definition item {
+    relation org: org
+    relation holder: user with same_tenant
+    permission access = holder + org->admin
+}
+"""
+
+EPOCH = 1_700_000_000_000_000
+
+
+def build_world(n_edges: int, n_tenants: int = 4096):
+    from gochugaru_tpu.schema import compile_schema, parse_schema
+    from gochugaru_tpu.store.interner import Interner
+    from gochugaru_tpu.store.snapshot import build_snapshot_from_columns
+
+    cs = compile_schema(parse_schema(SCHEMA))
+    interner = Interner()
+    rng = np.random.default_rng(31)
+
+    n_users = 200_000
+    n_items = max(n_edges // 10, 1000)
+    n_orgs = 2000
+    users = np.array([interner.node("user", f"u{i}") for i in range(n_users)], np.int64)
+    orgs = np.array([interner.node("org", f"o{i}") for i in range(n_orgs)], np.int64)
+    items = np.array([interner.node("item", f"i{i}") for i in range(n_items)], np.int64)
+    slot = cs.slot_of_name
+    cid = cs.caveat_ids["same_tenant"]
+
+    # shared stored-context rows: one per tenant (contexts are deduped by
+    # construction — 100M edges share n_tenants dicts)
+    contexts = [{"edge_tenant": f"t{t}", "tier": 2} for t in range(n_tenants)]
+
+    n_holder = n_edges - n_items - n_orgs
+    res = np.concatenate([
+        rng.choice(items, n_holder),
+        items,  # org edge per item
+        orgs,  # admin per org
+    ])
+    rel = np.concatenate([
+        np.full(n_holder, slot["holder"], np.int64),
+        np.full(n_items, slot["org"], np.int64),
+        np.full(n_orgs, slot["admin"], np.int64),
+    ])
+    subj = np.concatenate([
+        rng.choice(users, n_holder),
+        rng.choice(orgs, n_items),
+        rng.choice(users, n_orgs),
+    ])
+    srel = np.full(res.shape[0], -1, np.int64)
+    caveat = np.concatenate([
+        np.full(n_holder, cid, np.int32),
+        np.zeros(n_items + n_orgs, np.int32),
+    ])
+    ctx = np.concatenate([
+        rng.integers(0, n_tenants, n_holder).astype(np.int32),
+        np.full(n_items + n_orgs, -1, np.int32),
+    ])
+
+    snap = build_snapshot_from_columns(
+        1, cs, interner,
+        res=res, rel=rel, subj=subj, srel=srel,
+        caveat=caveat, ctx=ctx, contexts=contexts,
+        epoch_us=EPOCH,
+    )
+    return cs, snap, users, items, slot, n_tenants
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--edges", type=int, default=100_000_000)
+    ap.add_argument("--batch", type=int, default=100_000)
+    args = ap.parse_args()
+
+    from gochugaru_tpu.engine.device import DeviceEngine
+
+    cs, snap, users, items, slot, n_tenants = build_world(args.edges)
+    note(f"edges={snap.num_edges} contexts={len(snap.contexts)}")
+    engine = DeviceEngine(cs)
+    assert not engine.caveat_plan.host_only[cs.caveat_ids["same_tenant"]]
+    dsnap = engine.prepare(snap)
+
+    rng = np.random.default_rng(3)
+    B = 1 << (args.batch - 1).bit_length()
+    # half the queries target real holder edges (the caveat predicate must
+    # actually run: right tenant → grant, wrong tenant → definite deny);
+    # the other half are random misses
+    holder_rows = np.nonzero(snap.e_rel == slot["holder"])[0]
+    hit_rows = rng.choice(holder_rows, B // 2)
+    q_res = np.concatenate([
+        snap.e_res[hit_rows], rng.choice(items, B - B // 2).astype(np.int32),
+    ])
+    q_subj = np.concatenate([
+        snap.e_subj[hit_rows], rng.choice(users, B - B // 2).astype(np.int32),
+    ])
+    q_perm = np.full(B, slot["access"], np.int32)
+    # each query carries its caller's tenant + tier in request context;
+    # for the edge-hitting half, 50% use the edge's own tenant (→ True)
+    qctx_rows = [{"tenant": f"t{t}", "tier": 2} for t in range(n_tenants)]
+    edge_tenant = snap.e_ctx[hit_rows].astype(np.int64)
+    match = rng.random(B // 2) < 0.5
+    hit_tenants = np.where(
+        match, edge_tenant, (edge_tenant + 1) % n_tenants
+    )
+    q_ctx = np.concatenate([
+        hit_tenants, rng.integers(0, n_tenants, B - B // 2),
+    ]).astype(np.int32)
+
+    def dispatch():  # pipelined device dispatch, no per-call readback
+        return engine.check_columns(
+            dsnap, q_res, q_perm, q_subj,
+            q_ctx=q_ctx, qctx_rows=qctx_rows, now_us=EPOCH, fetch=False,
+        )
+
+    def roundtrip():  # end-to-end including the device→host fetch
+        return engine.check_columns(
+            dsnap, q_res, q_perm, q_subj,
+            q_ctx=q_ctx, qctx_rows=qctx_rows, now_us=EPOCH,
+        )
+
+    dt = time_steady(dispatch, reps=5)
+    rate = B / dt
+    d, p, ovf = roundtrip()
+    conditional = int((p & ~d).sum())
+    note(
+        f"batch={B} step={dt*1000:.1f}ms granted={int(d.sum())}"
+        f" conditional(host-fallback)={conditional} overflow={int(ovf.sum())}"
+    )
+    emit(
+        "caveated_100m_bulk_check_throughput", rate, "checks/sec/chip",
+        rate / NORTH_STAR_RATE,
+    )
+    p50, p99, mean = latency_percentiles(roundtrip, reps=20)
+    emit(
+        "caveated_100m_batch_p99_latency", p99, "ms",
+        NORTH_STAR_P99_MS / max(p99, 1e-9),
+    )
+    note(f"p50={p50:.2f}ms p99={p99:.2f}ms mean={mean:.2f}ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,140 @@
+"""BASELINE config 5 — Leopard-scale Watch-driven incremental re-index.
+
+Measures the path that keeps a live index fresh: a stream of relationship
+updates (the Watch feed, client/client.go:364-413) is folded into the
+current snapshot via O(E + D log D) delta materialization
+(store/delta.py) and re-shipped to the device, and a check on the touched
+edges must observe the new revision immediately.
+
+Metrics: delta re-index latency (materialize + device upload) and
+sustained updates/sec, at a base graph scaled by ``--edges`` (the full
+config is 1B edges on v5e-16; one chip holds the 100M-class slice —
+sharded, each host applies the same delta to its row shard).
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+from benchmarks.common import emit, note
+
+SCHEMA = """
+definition user {}
+definition team { relation member: user }
+definition repo {
+    relation maintainer: user | team#member
+    relation reader: user
+    permission read = reader + maintainer
+}
+"""
+
+EPOCH = 1_700_000_000_000_000
+
+
+def build_base(n_edges: int):
+    from gochugaru_tpu.schema import compile_schema, parse_schema
+    from gochugaru_tpu.store.interner import Interner
+    from gochugaru_tpu.store.snapshot import build_snapshot_from_columns
+
+    cs = compile_schema(parse_schema(SCHEMA))
+    interner = Interner()
+    rng = np.random.default_rng(17)
+    n_users = 100_000
+    n_teams = 1000
+    n_repos = max(n_edges // 20, 1000)
+    users = np.array([interner.node("user", f"u{i}") for i in range(n_users)], np.int64)
+    teams = np.array([interner.node("team", f"t{i}") for i in range(n_teams)], np.int64)
+    repos = np.array([interner.node("repo", f"r{i}") for i in range(n_repos)], np.int64)
+    slot = cs.slot_of_name
+
+    n_member = n_teams * 50
+    n_maint = n_repos
+    n_reader = n_edges - n_member - n_maint
+    res = np.concatenate([
+        np.repeat(teams, 50), repos, rng.choice(repos, n_reader),
+    ])
+    rel = np.concatenate([
+        np.full(n_member, slot["member"], np.int64),
+        np.full(n_maint, slot["maintainer"], np.int64),
+        np.full(n_reader, slot["reader"], np.int64),
+    ])
+    subj = np.concatenate([
+        rng.choice(users, n_member),
+        rng.choice(teams, n_maint),
+        rng.choice(users, n_reader),
+    ])
+    srel = np.concatenate([
+        np.full(n_member, -1, np.int64),
+        np.full(n_maint, slot["member"], np.int64),
+        np.full(n_reader, -1, np.int64),
+    ])
+    snap = build_snapshot_from_columns(
+        1, cs, interner,
+        res=res, rel=rel, subj=subj, srel=srel, epoch_us=EPOCH,
+    )
+    return cs, snap, interner, slot
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--edges", type=int, default=10_000_000)
+    ap.add_argument("--delta", type=int, default=1000)
+    ap.add_argument("--rounds", type=int, default=10)
+    args = ap.parse_args()
+
+    from gochugaru_tpu import rel as relmod
+    from gochugaru_tpu.engine.device import DeviceEngine
+    from gochugaru_tpu.store.delta import apply_delta
+
+    cs, snap, interner, slot = build_base(args.edges)
+    note(f"base edges={snap.num_edges}")
+    engine = DeviceEngine(cs)
+    dsnap = engine.prepare(snap)
+
+    rng = np.random.default_rng(5)
+    lat_mat, lat_ship = [], []
+    for rnd in range(args.rounds):
+        adds = [
+            relmod.must_from_triple(
+                f"repo:r{rng.integers(0, 1000)}", "reader",
+                f"user:fresh_{rnd}_{i}",
+            )
+            for i in range(args.delta)
+        ]
+        deletes = []
+        t0 = time.perf_counter()
+        snap = apply_delta(snap, snap.revision + 1, adds, deletes, interner=interner)
+        t1 = time.perf_counter()
+        dsnap = engine.prepare(snap)
+        # freshness probe: a just-added edge must be visible at the new
+        # revision
+        probe = relmod.must_from_triple(
+            f"{adds[0].resource_type}:{adds[0].resource_id}",
+            "read",
+            f"{adds[0].subject_type}:{adds[0].subject_id}",
+        )
+        d, p, ovf = engine.check_batch(dsnap, [probe], now_us=EPOCH)
+        t2 = time.perf_counter()
+        assert bool(d[0]), "freshness probe failed: delta not visible"
+        lat_mat.append((t1 - t0) * 1000)
+        lat_ship.append((t2 - t1) * 1000)
+
+    mat = np.asarray(lat_mat[1:]) if len(lat_mat) > 1 else np.asarray(lat_mat)
+    ship = np.asarray(lat_ship[1:]) if len(lat_ship) > 1 else np.asarray(lat_ship)
+    total_ms = mat.mean() + ship.mean()
+    rate = args.delta / (total_ms / 1000)
+    emit("watch_reindex_updates_per_sec", rate, "updates/sec", rate / 1_000_000)
+    note(
+        f"delta={args.delta} materialize={mat.mean():.1f}ms "
+        f"ship+probe={ship.mean():.1f}ms total={total_ms:.1f}ms/delta"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,177 @@
+"""Shared benchmark harness utilities.
+
+Every benchmark prints one JSON line per metric:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline`` is the fraction of the BASELINE.json north-star target
+(10M checks/sec/chip or 2 ms p99) — the reference itself publishes no
+numbers (BASELINE.md), so the target is the denominator.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Callable, Sequence
+
+import numpy as np
+
+NORTH_STAR_RATE = 10_000_000  # checks/sec/chip
+NORTH_STAR_P99_MS = 2.0
+
+
+def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(float(value), 4),
+                "unit": unit,
+                "vs_baseline": round(float(vs_baseline), 4),
+            }
+        )
+    )
+
+
+def note(msg: str) -> None:
+    print(f"# {msg}", file=sys.stderr)
+
+
+def time_steady(fn: Callable[[], object], reps: int = 5) -> float:
+    """Steady-state seconds/call: warm once (compile), force the platform
+    into synchronous execution with a real device→host fetch, then average
+    individually-completed calls.
+
+    Why the fetch: on remote-attached TPU platforms (axon tunnel),
+    ``block_until_ready`` does NOT wait until the process has performed its
+    first device→host transfer — timing enqueue-only loops reports fantasy
+    numbers.  One fetch switches the stream to synchronous mode; after it,
+    blocked timings are real (at the cost of a per-dispatch round trip,
+    which ``repeat_harness`` amortizes away for throughput numbers)."""
+    import jax
+
+    out = fn()
+    jax.block_until_ready(out)
+    _force_sync_mode(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def _force_sync_mode(out) -> None:
+    """Fetch one full (unsliced) leaf of a jit output so subsequent
+    blocked timings measure real execution."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(out)
+    if leaves:
+        jax.device_get(leaves[0])
+
+
+def repeat_harness(engine, iters: int):
+    """Build a jitted fn running the engine's whole-batch check ``iters``
+    times inside one ``lax.fori_loop`` dispatch, rotating the resource
+    column every iteration (so XLA cannot hoist the loop body) and
+    XOR/OR-accumulating the outputs (so it cannot dead-code them).
+
+    Timing recipe: t(2K) - t(K) cancels the fixed per-dispatch round trip,
+    leaving K × the true batch evaluation time.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from gochugaru_tpu.engine.device import _make_check_fn
+
+    raw = _make_check_fn(
+        engine.plan, engine.config, jit=False, caveat_plan=engine.caveat_plan
+    )
+
+    def fn(arrs, tid_map, now, u_subj, u_srel, u_wc, u_qctx,
+           q_res, q_perm, q_subj, q_srel, q_wc, q_row, q_self, q_ctx, qctx):
+        def body(i, carry):
+            d0, p0, o0 = carry
+            d, p, o = raw(
+                arrs, tid_map, now, u_subj, u_srel, u_wc, u_qctx,
+                jnp.roll(q_res, i), q_perm, q_subj, q_srel, q_wc,
+                q_row, q_self, q_ctx, qctx,
+            )
+            return d0 ^ d, p0 ^ p, o0 | o
+        z = jnp.zeros(q_res.shape[0], bool)
+        return lax.fori_loop(0, iters, body, (z, z, z))
+
+    return jax.jit(fn)
+
+
+def sync_rate(full_fn, null_fn, args, B: int, reps: int = 7):
+    """True checks/sec on platforms where only synchronous-mode timing is
+    real: force sync mode with one fetch, then time blocked executions of
+    the real program and of a null program with identical input/output
+    signature; the difference cancels the fixed per-dispatch round trip.
+    Use a batch large enough that the true step dominates the ~2 ms timing
+    noise on the fixed overhead.  Returns (rate, step_seconds,
+    overhead_seconds)."""
+    import jax
+
+    out = full_fn(*args)
+    jax.block_until_ready(out)
+    jax.block_until_ready(null_fn(*args))
+    _force_sync_mode(out)
+
+    def med(f):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(*args))
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts))
+
+    t_null = med(null_fn)
+    t_full = med(full_fn)
+    step = max(t_full - t_null, 1e-9)
+    return B / step, step, t_null
+
+
+def measured_rate(engine, dsnap, B: int, args, iters: int = 16) -> float:
+    """True checks/sec via the repeat harness: rate = iters·B / (t2 - t1)
+    with t1 = one dispatch of `iters` loops, t2 = one of 2·iters."""
+    import jax
+
+    f1 = repeat_harness(engine, iters)
+    f2 = repeat_harness(engine, 2 * iters)
+    out = f1(*args)
+    jax.block_until_ready(out)
+    jax.block_until_ready(f2(*args))
+    _force_sync_mode(out)
+
+    def timed(f):
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t1 = timed(f1)
+    t2 = timed(f2)
+    dt = max(t2 - t1, 1e-9)
+    return iters * B / dt
+
+
+def latency_percentiles(
+    fn: Callable[[], object], reps: int = 50
+) -> tuple[float, float, float]:
+    """(p50, p99, mean) milliseconds over individually-timed calls."""
+    import jax
+
+    jax.block_until_ready(fn())
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        ts.append((time.perf_counter() - t0) * 1000)
+    a = np.asarray(ts)
+    return float(np.percentile(a, 50)), float(np.percentile(a, 99)), float(a.mean())

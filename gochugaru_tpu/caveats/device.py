@@ -1,0 +1,613 @@
+"""On-device CEL caveat evaluation (BASELINE config 4).
+
+The host compiler (``cel.py``) gives each caveat a typed AST.  This module
+lowers the *device-eligible* subset to straight-line JAX ops so caveated
+edges resolve to definite permissionship inside the jitted check instead of
+falling back to the host oracle.  The reference delegates caveat evaluation
+to SpiceDB's server-side CEL interpreter (context travels in the
+CheckBulkPermissions items, client/client.go:241-259); here the "server" is
+the TPU, so the predicate itself must vectorize.
+
+Design:
+
+- **Static typing.**  CEL is dynamically typed, but caveat declarations
+  carry parameter types (``caveat c(a int, b string)``), so the whole tree
+  types statically: int/uint → i32, bool → tri-state i32, double → f32,
+  string → interned i32 id.  Anything outside that (timestamps, lists,
+  maps, ``any``, member access) marks the caveat host-only.
+
+- **Tri-state Kleene logic.**  Results are 0=FALSE, 1=UNKNOWN, 2=TRUE in
+  i32; ``or``=max, ``and``=min, ``not``=2-x — the same encoding the host
+  oracle uses (engine/oracle.py).  A missing context parameter is UNKNOWN,
+  which the caller maps to CONDITIONAL → host resolution.
+
+- **Exactness over coverage.**  The device only evaluates what it can
+  evaluate *bit-exactly* against the host oracle: int arithmetic is bounded
+  by interval analysis so i32 can never overflow (rows with larger values
+  get a per-(row, caveat) host flag); doubles must round-trip through f32;
+  unknown-at-build strings get fresh negative ids so they compare equal
+  only to themselves.  Rows that violate a bound fall back to the host —
+  coverage shrinks, correctness never does.
+
+- **Merge semantics.**  Stored (edge) context wins over query context
+  per-parameter, exactly as the oracle merges (oracle.py:120-122).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..schema.compiler import CompiledSchema
+from .cel import CelCompileError, CelProgram, compile_cel
+
+F, U, T = 0, 1, 2
+I32_MAX = 2**31 - 1
+#: ints exactly representable in f32
+F32_EXACT_INT = 2**24
+
+
+class _HostOnly(Exception):
+    """Raised during lowering when a construct can't run on device."""
+
+
+# device value representation:
+#   bool  → tri i32 (0/1/2)
+#   int   → (i32 value, bool known)
+#   double→ (f32 value, bool known)
+#   string→ (i32 id, bool known)
+_VALUE_KINDS = ("int", "double", "string")
+
+
+@dataclass
+class ContextTable:
+    """Encoded context rows: [N, P] typed values + per-(row, caveat) host
+    flags.  N is always ≥ 1 so clipped gathers on index -1 stay in range."""
+
+    vi: np.ndarray  # int32[N, P] int/bool/string-id values
+    vf: np.ndarray  # float32[N, P] double values
+    present: np.ndarray  # bool[N, P]
+    host: np.ndarray  # bool[N, C+1] needs-host flag per caveat id
+
+
+@dataclass
+class CaveatDevicePlan:
+    """Static, schema-derived caveat lowering shared by every snapshot."""
+
+    num_params: int  # P: global param slots across caveats
+    num_caveats: int  # C (ids are 1-based; 0 = no caveat)
+    #: (caveat_name, param_name) → global slot
+    slot_of: Dict[Tuple[str, str], int]
+    #: per slot: declared device type ('int' | 'double' | 'bool' | 'string')
+    slot_type: List[str]
+    #: param name → [(caveat_id, slot)] for query-context fan-out
+    slots_of_param: Dict[str, List[Tuple[int, int]]]
+    #: per caveat id: True → always host-evaluated
+    host_only: np.ndarray  # bool[C+1]
+    #: per caveat id: max |int| context value evaluable on device
+    int_bound: np.ndarray  # int64[C+1]
+    #: caveat id → traced (vi, vf, present) → tri; operates on [..., P]
+    programs: Dict[int, Callable]
+    #: string literal pool (extended by snapshot contexts)
+    base_strings: Dict[str, int]
+    caveat_params: Dict[str, Mapping[str, str]]  # name → declared params
+    name_of_id: Dict[int, str]
+
+    @property
+    def has_device_programs(self) -> bool:
+        return bool(self.programs)
+
+
+_DEVICE_PARAM_TYPES = {"int": "int", "uint": "int", "double": "double",
+                       "bool": "bool", "string": "string"}
+
+
+def _base_type(ptype: str) -> str:
+    return ptype.split("<", 1)[0].strip()
+
+
+# ---------------------------------------------------------------------------
+# interval analysis: can i32 arithmetic overflow with |var| ≤ B?
+# ---------------------------------------------------------------------------
+
+
+def _arith_safe(ast, types: Dict[str, str], bound: int) -> bool:
+    """True if no int-typed arithmetic node can exceed i32 with every int
+    context value bounded by ``bound`` in magnitude."""
+
+    ok = True
+
+    def walk(node) -> int:
+        """Max |value| of an int-typed node; 0 for non-value nodes."""
+        nonlocal ok
+        op = node[0]
+        if op == "lit":
+            v = node[1]
+            return abs(v) if isinstance(v, int) and not isinstance(v, bool) else 0
+        if op == "var":
+            return bound if types.get(node[1]) == "int" else 0
+        if op == "neg":
+            return walk(node[1])
+        if op == "arith":
+            a, b = walk(node[2]), walk(node[3])
+            o = node[1]
+            if o in ("+", "-"):
+                m = a + b
+            elif o == "*":
+                m = a * b
+            elif o == "/":
+                m = a
+            else:  # %
+                m = min(a, b)
+            if m >= I32_MAX:
+                ok = False
+            return m
+        if op == "cond":
+            walk(node[1])
+            return max(walk(node[2]), walk(node[3]))
+        if op in ("not",):
+            walk(node[1])
+            return 0
+        if op in ("or", "and", "in"):
+            walk(node[1]); walk(node[2])
+            return 0
+        if op == "cmp":
+            walk(node[2]); walk(node[3])
+            return 0
+        if op == "list":
+            for it in node[1]:
+                walk(it)
+            return 0
+        if op == "member":
+            return 0
+        return 0
+
+    walk(ast)
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# AST → JAX lowering
+# ---------------------------------------------------------------------------
+
+
+def _lower_program(
+    prog: CelProgram,
+    slot_of: Dict[Tuple[str, str], int],
+    strings: Dict[str, int],
+) -> Callable:
+    """Lower one caveat AST to ``fn(vi, vf, present) → tri`` over [..., P]
+    arrays.  Raises _HostOnly for unsupported constructs."""
+    import jax.numpy as jnp
+
+    types: Dict[str, str] = {}
+    for pname, ptype in prog.params.items():
+        dt = _DEVICE_PARAM_TYPES.get(_base_type(ptype))
+        if dt is None:
+            raise _HostOnly(f"param type {ptype}")
+        types[pname] = dt
+
+    def intern(s: str) -> int:
+        if s not in strings:
+            strings[s] = len(strings) + 1
+        return strings[s]
+
+    # Each lowered node is (kind, emit).  For kind 'bool', emit(vi,vf,pr)
+    # returns tri; for value kinds it returns (value, known).
+    def lower(node):
+        op = node[0]
+        if op == "lit":
+            v = node[1]
+            if isinstance(v, bool):
+                return "bool", lambda vi, vf, pr, t=(T if v else F): jnp.int32(t)
+            if isinstance(v, int):
+                if abs(v) >= I32_MAX:
+                    raise _HostOnly("int literal out of i32 range")
+                return "int", lambda vi, vf, pr, c=v: (
+                    jnp.int32(c), jnp.bool_(True))
+            if isinstance(v, float):
+                if float(np.float32(v)) != v:
+                    raise _HostOnly("double literal not f32-exact")
+                return "double", lambda vi, vf, pr, c=v: (
+                    jnp.float32(c), jnp.bool_(True))
+            if isinstance(v, str):
+                return "string", lambda vi, vf, pr, c=intern(v): (
+                    jnp.int32(c), jnp.bool_(True))
+            raise _HostOnly(f"literal {v!r}")
+        if op == "var":
+            name = node[1]
+            kind = types[name]
+            s = slot_of[(prog.name, name)]
+            if kind == "bool":
+                def emit_b(vi, vf, pr, s=s):
+                    known = pr[..., s]
+                    return jnp.where(
+                        known, jnp.where(vi[..., s] != 0, T, F), U
+                    ).astype(jnp.int32)
+                return "bool", emit_b
+            if kind == "double":
+                return "double", lambda vi, vf, pr, s=s: (vf[..., s], pr[..., s])
+            return kind, lambda vi, vf, pr, s=s: (vi[..., s], pr[..., s])
+        if op == "not":
+            k, e = lower(node[1])
+            if k != "bool":
+                raise _HostOnly("! on non-bool")
+            return "bool", lambda vi, vf, pr: jnp.int32(2) - e(vi, vf, pr)
+        if op == "neg":
+            k, e = lower(node[1])
+            if k == "int":
+                return "int", lambda vi, vf, pr: (
+                    lambda v: (-v[0], v[1]))(e(vi, vf, pr))
+            if k == "double":
+                return "double", lambda vi, vf, pr: (
+                    lambda v: (-v[0], v[1]))(e(vi, vf, pr))
+            raise _HostOnly("unary - on non-numeric")
+        if op in ("or", "and"):
+            ka, ea = lower(node[1])
+            kb, eb = lower(node[2])
+            if ka != "bool" or kb != "bool":
+                raise _HostOnly(f"{op} on non-bool")
+            red = jnp.maximum if op == "or" else jnp.minimum
+            return "bool", lambda vi, vf, pr: red(ea(vi, vf, pr), eb(vi, vf, pr))
+        if op == "cond":
+            kc, ec = lower(node[1])
+            if kc != "bool":
+                raise _HostOnly("?: condition not bool")
+            kt, et = lower(node[2])
+            kf, ef = lower(node[3])
+            if kt != kf:
+                raise _HostOnly("?: branches differ in type")
+            if kt == "bool":
+                def emit_cb(vi, vf, pr):
+                    c = ec(vi, vf, pr)
+                    return jnp.where(
+                        c == U, U, jnp.where(c == T, et(vi, vf, pr), ef(vi, vf, pr))
+                    ).astype(jnp.int32)
+                return "bool", emit_cb
+
+            def emit_cv(vi, vf, pr):
+                c = ec(vi, vf, pr)
+                tv, tk = et(vi, vf, pr)
+                fv, fk = ef(vi, vf, pr)
+                val = jnp.where(c == T, tv, fv)
+                known = (c != U) & jnp.where(c == T, tk, fk)
+                return val, known
+            return kt, emit_cv
+        if op == "cmp":
+            o = node[1]
+            ka, ea = lower(node[2])
+            kb, eb = lower(node[3])
+            if ka == "bool" and kb == "bool":
+                if o not in ("==", "!="):
+                    raise _HostOnly("ordered comparison on bools")
+
+                def emit_bb(vi, vf, pr, neq=(o == "!=")):
+                    a = ea(vi, vf, pr)
+                    b = eb(vi, vf, pr)
+                    eq = (a == b) ^ neq
+                    unknown = (a == U) | (b == U)
+                    return jnp.where(
+                        unknown, U, jnp.where(eq, T, F)
+                    ).astype(jnp.int32)
+                return "bool", emit_bb
+            if ka == "bool" or kb == "bool":
+                raise _HostOnly("comparison mixes bool and value")
+            if ka == "string" or kb == "string":
+                if ka != kb:
+                    raise _HostOnly("comparison mixes string and numeric")
+                if o not in ("==", "!="):
+                    raise _HostOnly("ordered comparison on strings")
+            promote = "double" if "double" in (ka, kb) else ka
+            if promote == "double":
+                _check_promotable(node[2], ka)
+                _check_promotable(node[3], kb)
+
+            def emit_cmp(vi, vf, pr, o=o, promote=promote):
+                av, akn = ea(vi, vf, pr)
+                bv, bkn = eb(vi, vf, pr)
+                if promote == "double":
+                    av = av.astype(jnp.float32) if hasattr(av, "astype") else jnp.float32(av)
+                    bv = bv.astype(jnp.float32) if hasattr(bv, "astype") else jnp.float32(bv)
+                if o == "==":
+                    raw = av == bv
+                elif o == "!=":
+                    raw = av != bv
+                elif o == "<":
+                    raw = av < bv
+                elif o == "<=":
+                    raw = av <= bv
+                elif o == ">":
+                    raw = av > bv
+                else:
+                    raw = av >= bv
+                return jnp.where(
+                    akn & bkn, jnp.where(raw, T, F), U
+                ).astype(jnp.int32)
+            return "bool", emit_cmp
+        if op == "arith":
+            o = node[1]
+            ka, ea = lower(node[2])
+            kb, eb = lower(node[3])
+            if ka != "int" or kb != "int":
+                # device arithmetic is int-only; float arithmetic would
+                # round differently from the host's f64
+                raise _HostOnly("non-int arithmetic")
+
+            def emit_ar(vi, vf, pr, o=o):
+                av, akn = ea(vi, vf, pr)
+                bv, bkn = eb(vi, vf, pr)
+                known = akn & bkn
+                if o == "+":
+                    return av + bv, known
+                if o == "-":
+                    return av - bv, known
+                if o == "*":
+                    return av * bv, known
+                # CEL integer / and % truncate toward zero; divide-by-zero
+                # is a host-side error → UNKNOWN here
+                bz = bv == 0
+                safe_b = jnp.where(bz, 1, bv)
+                q = jnp.sign(av) * jnp.sign(safe_b) * (
+                    jnp.abs(av) // jnp.abs(safe_b))
+                q = q.astype(jnp.int32)
+                known = known & ~bz
+                if o == "/":
+                    return q, known
+                return av - q * bv, known
+            return "int", emit_ar
+        if op == "in":
+            ka, ea = lower(node[1])
+            if ka not in _VALUE_KINDS:
+                raise _HostOnly("'in' on non-value")
+            if node[2][0] != "list":
+                raise _HostOnly("'in' target not a list literal")
+            elems = [lower(it) for it in node[2][1]]
+            for ke, _ in elems:
+                if ke != ka and not (ka == "double" and ke == "int"):
+                    raise _HostOnly("'in' list element type mismatch")
+
+            def emit_in(vi, vf, pr):
+                av, akn = ea(vi, vf, pr)
+                hit = jnp.bool_(False)
+                kn = akn
+                for _, ee in elems:
+                    ev, ekn = ee(vi, vf, pr)
+                    if ka == "double":
+                        ev = jnp.asarray(ev).astype(jnp.float32)
+                    hit = hit | (av == ev)
+                    kn = kn & ekn
+                return jnp.where(kn, jnp.where(hit, T, F), U).astype(jnp.int32)
+            return "bool", emit_in
+        raise _HostOnly(f"construct {op!r}")
+
+    def _check_promotable(node, kind: str) -> None:
+        """Int literals promoted to f32 must be exactly representable."""
+        if kind != "int":
+            return
+        if node[0] == "lit" and abs(node[1]) > F32_EXACT_INT:
+            raise _HostOnly("int literal not f32-exact in double comparison")
+        # int *vars* are covered by the bound analysis (int_bound ≤ 2^20
+        # whenever the program mixes doubles, enforced in build).
+
+    kind, emit = lower(prog.ast)
+    if kind != "bool":
+        raise _HostOnly("caveat does not evaluate to bool")
+
+    def run(vi, vf, pr):
+        shape = vi.shape[:-1]
+        return jnp.broadcast_to(emit(vi, vf, pr), shape).astype(jnp.int32)
+
+    return run, types
+
+
+# ---------------------------------------------------------------------------
+# plan construction
+# ---------------------------------------------------------------------------
+
+_INT_BOUNDS = (2**30, 2**20, 2**16, 2**12, 2**8, 2**4)
+
+
+def build_caveat_plan(compiled: CompiledSchema) -> CaveatDevicePlan:
+    """Assign global param slots and lower every device-eligible caveat.
+    Caveats that fail lowering stay host-only — same behavior as before
+    this module existed, just scoped per-caveat instead of per-schema."""
+    caveats = compiled.schema.caveats
+    C = len(compiled.caveat_ids)
+    slot_of: Dict[Tuple[str, str], int] = {}
+    slot_type: List[str] = []
+    slots_of_param: Dict[str, List[Tuple[int, int]]] = {}
+    caveat_params: Dict[str, Mapping[str, str]] = {}
+    name_of_id = {cid: name for name, cid in compiled.caveat_ids.items()}
+
+    for name in sorted(caveats):
+        decl = caveats[name]
+        cid = compiled.caveat_ids[name]
+        caveat_params[name] = dict(decl.params)
+        for pname in sorted(decl.params):
+            dt = _DEVICE_PARAM_TYPES.get(_base_type(decl.params[pname]), "int")
+            slot = len(slot_type)
+            slot_of[(name, pname)] = slot
+            slot_type.append(dt)
+            slots_of_param.setdefault(pname, []).append((cid, slot))
+
+    host_only = np.zeros(C + 1, bool)
+    int_bound = np.full(C + 1, I32_MAX - 1, np.int64)
+    programs: Dict[int, Callable] = {}
+    base_strings: Dict[str, int] = {}
+
+    for name in sorted(caveats):
+        decl = caveats[name]
+        cid = compiled.caveat_ids[name]
+        try:
+            prog = compile_cel(name, decl.params, decl.expression)
+            fn, types = _lower_program(prog, slot_of, base_strings)
+        except (_HostOnly, CelCompileError):
+            host_only[cid] = True
+            continue
+        # pick the largest int bound that provably cannot overflow i32
+        has_double = "double" in types.values() or _ast_has_double_literal(prog.ast)
+        chosen = None
+        for b in _INT_BOUNDS:
+            if has_double and b > 2**20:
+                continue  # ints beyond 2^20 lose headroom in f32 compares
+            if _arith_safe(prog.ast, types, b):
+                chosen = b
+                break
+        if chosen is None:
+            host_only[cid] = True
+            continue
+        if not _ast_has_arith(prog.ast) and not has_double:
+            chosen = I32_MAX - 1
+        int_bound[cid] = chosen
+        programs[cid] = fn
+
+    return CaveatDevicePlan(
+        num_params=len(slot_type),
+        num_caveats=C,
+        slot_of=slot_of,
+        slot_type=slot_type,
+        slots_of_param=slots_of_param,
+        host_only=host_only,
+        int_bound=int_bound,
+        programs=programs,
+        base_strings=base_strings,
+        caveat_params=caveat_params,
+        name_of_id=name_of_id,
+    )
+
+
+def _ast_has_arith(ast) -> bool:
+    if ast[0] == "arith":
+        return True
+    return any(
+        _ast_has_arith(c)
+        for c in ast[1:]
+        if isinstance(c, tuple)
+    ) or (ast[0] == "list" and any(_ast_has_arith(it) for it in ast[1]))
+
+
+def _ast_has_double_literal(ast) -> bool:
+    if ast[0] == "lit" and isinstance(ast[1], float):
+        return True
+    return any(
+        _ast_has_double_literal(c)
+        for c in ast[1:]
+        if isinstance(c, tuple)
+    ) or (ast[0] == "list" and any(_ast_has_double_literal(it) for it in ast[1]))
+
+
+# ---------------------------------------------------------------------------
+# context encoding
+# ---------------------------------------------------------------------------
+
+
+def encode_contexts(
+    plan: CaveatDevicePlan,
+    rows: Sequence[Mapping[str, Any]],
+    strings: Dict[str, int],
+    *,
+    extra_strings: Optional[Dict[str, int]] = None,
+) -> ContextTable:
+    """Encode context maps into typed [N, P] columns.
+
+    ``strings`` is the shared pool (literals + snapshot strings); when
+    ``extra_strings`` is given (query-time), unknown strings get fresh
+    *negative* ids there instead of growing the pool — equal unknown
+    strings still compare equal, but never collide with stored ids.
+
+    A value a slot can't hold exactly (wrong type, out of the caveat's int
+    bound, not f32-exact) sets the (row, caveat) host flag; that caveat's
+    probes on the row fall back to the host oracle.
+    """
+    N = max(len(rows), 1)
+    P = max(plan.num_params, 1)
+    vi = np.zeros((N, P), np.int32)
+    vf = np.zeros((N, P), np.float32)
+    present = np.zeros((N, P), bool)
+    host = np.zeros((N, plan.num_caveats + 1), bool)
+
+    def string_id(s: str) -> int:
+        sid = strings.get(s)
+        if sid is not None:
+            return sid
+        if extra_strings is None:
+            sid = len(strings) + 1
+            strings[s] = sid
+            return sid
+        sid = extra_strings.get(s)
+        if sid is None:
+            sid = -2 - len(extra_strings)
+            extra_strings[s] = sid
+        return sid
+
+    for i, ctx in enumerate(rows):
+        for pname, value in ctx.items():
+            for cid, slot in plan.slots_of_param.get(pname, ()):  # noqa: B905
+                st = plan.slot_type[slot]
+                if st == "int":
+                    if isinstance(value, bool) or not isinstance(value, int):
+                        host[i, cid] = True
+                        continue
+                    if abs(value) > plan.int_bound[cid]:
+                        host[i, cid] = True
+                        continue
+                    vi[i, slot] = value
+                elif st == "double":
+                    if isinstance(value, bool) or not isinstance(value, (int, float)):
+                        host[i, cid] = True
+                        continue
+                    f = float(value)
+                    if float(np.float32(f)) != f:
+                        host[i, cid] = True
+                        continue
+                    vf[i, slot] = f
+                elif st == "bool":
+                    if not isinstance(value, bool):
+                        host[i, cid] = True
+                        continue
+                    vi[i, slot] = int(value)
+                else:  # string
+                    if not isinstance(value, str):
+                        host[i, cid] = True
+                        continue
+                    vi[i, slot] = string_id(value)
+                present[i, slot] = True
+    return ContextTable(vi=vi, vf=vf, present=present, host=host)
+
+
+def make_tri_fn(plan: CaveatDevicePlan):
+    """Build the traced tri-state gate:
+
+    ``tri(cav, ctx_idx, qctx_idx, tables) → i32`` over any batch shape,
+    where ``tables`` holds ectx_* / qctx_* arrays.  Caveat 0 → TRUE;
+    host-only caveats and host-flagged rows → UNKNOWN.
+    """
+    import jax.numpy as jnp
+
+    host_only = np.asarray(plan.host_only)
+
+    def tri(cav, ctx_idx, qctx_idx, tables):
+        e = jnp.clip(ctx_idx, 0)
+        has_e = ctx_idx >= 0
+        q = jnp.clip(qctx_idx, 0)
+        has_q = qctx_idx >= 0
+        ep = tables["ectx_pr"][e] & has_e[..., None]
+        qp = tables["qctx_pr"][q] & has_q[..., None]
+        vi = jnp.where(ep, tables["ectx_vi"][e], tables["qctx_vi"][q])
+        vf = jnp.where(ep, tables["ectx_vf"][e], tables["qctx_vf"][q])
+        pr = ep | qp
+        cavc = jnp.clip(cav, 0, plan.num_caveats)
+        row_host = (
+            (tables["ectx_host"][e, cavc] & has_e)
+            | (tables["qctx_host"][q, cavc] & has_q)
+        )
+        out = jnp.full(jnp.shape(cav), U, jnp.int32)
+        for cid, fn in plan.programs.items():
+            out = jnp.where(cav == cid, fn(vi, vf, pr), out)
+        hostish = jnp.asarray(host_only)[cavc] | row_host
+        out = jnp.where(hostish, U, out)
+        return jnp.where(cav == 0, T, out).astype(jnp.int32)
+
+    return tri

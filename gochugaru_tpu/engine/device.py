@@ -41,6 +41,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..caveats.device import (
+    CaveatDevicePlan,
+    build_caveat_plan,
+    encode_contexts,
+    make_tri_fn,
+)
 from ..rel.relationship import Relationship, WILDCARD_ID
 from ..schema.compiler import CompiledSchema
 from ..store.snapshot import Snapshot
@@ -129,14 +135,22 @@ def _agather(x, axis: Optional[str]):
     return lax.all_gather(x, axis)
 
 
-def _gate(cav, exp, now, plane: str):
-    """Edge admissibility: expired edges grant nothing; caveated edges are
-    possible-but-not-definite until the on-device caveat VM evaluates them
-    (conditional queries fall back to the host oracle)."""
+def _gate(cav, ctx, exp, now, plane: str, qctx=None, tri=None, tables=None):
+    """Edge admissibility: expired edges grant nothing; caveated edges run
+    the on-device CEL VM (caveats/device.py) against stored-over-query
+    merged context.  Definite plane requires tri==TRUE; possible plane
+    admits tri>=UNKNOWN (conditional → host oracle resolution).  Without a
+    tri fn (schema has no caveats) this degrades to the expiry mask."""
     live = (exp == 0) | (exp > now)
+    if tri is None:
+        if plane == "p":
+            return live
+        return live & (cav == 0)
+    q = jnp.broadcast_to(qctx, jnp.shape(cav)) if jnp.shape(cav) else qctx
+    t = tri(cav, ctx, q, tables)
     if plane == "p":
-        return live
-    return live & (cav == 0)
+        return live & (t >= 1)
+    return live & (t == 2)
 
 
 def _dedup_truncate(n: jnp.ndarray, r: jnp.ndarray, C: int):
@@ -165,14 +179,17 @@ def _dedup_truncate(n: jnp.ndarray, r: jnp.ndarray, C: int):
 
 def _closure_one(
     arrs, cfg: EngineConfig, plane: str, now, u_subj, u_srel, u_wc,
+    u_qctx=-1, tri=None, tables=None,
     axis: Optional[str] = None,
 ):
     C, SC, P = cfg.closure_size, cfg.seed_cap, cfg.prop_cap
     ms_subj, ms_res, ms_rel = arrs["ms_subj"], arrs["ms_res"], arrs["ms_rel"]
     ms_cav, ms_exp = arrs["ms_caveat"], arrs["ms_exp"]
+    ms_ctx = arrs["ms_ctx"]
     mp_subj, mp_srel = arrs["mp_subj"], arrs["mp_srel"]
     mp_res, mp_rel = arrs["mp_res"], arrs["mp_rel"]
     mp_cav, mp_exp = arrs["mp_caveat"], arrs["mp_exp"]
+    mp_ctx = arrs["mp_ctx"]
 
     overflow = jnp.bool_(False)
     # own key: a userset subject is a member of itself
@@ -190,7 +207,10 @@ def _closure_one(
         idx = lo + jnp.arange(SC, dtype=jnp.int32)
         valid = (idx < hi) & (src >= 0)
         idxc = jnp.clip(idx, 0, last)
-        keep = valid & _gate(ms_cav[idxc], ms_exp[idxc], now, plane)
+        keep = valid & _gate(
+            ms_cav[idxc], ms_ctx[idxc], ms_exp[idxc], now, plane,
+            u_qctx, tri, tables,
+        )
         # each edge shard contributes its local seeds; gather + dedup merges
         bufs_n.append(_agather(jnp.where(keep, ms_res[idxc], I32_MAX), axis).ravel())
         bufs_r.append(_agather(jnp.where(keep, ms_rel[idxc], I32_MAX), axis).ravel())
@@ -210,7 +230,10 @@ def _closure_one(
         idx = lo[:, None] + jnp.arange(P, dtype=jnp.int32)[None, :]
         valid = (idx < hi[:, None]) & (c_n[:, None] < I32_MAX)
         idxc = jnp.clip(idx, 0, lastp)
-        keep = valid & _gate(mp_cav[idxc], mp_exp[idxc], now, plane)
+        keep = valid & _gate(
+            mp_cav[idxc], mp_ctx[idxc], mp_exp[idxc], now, plane,
+            u_qctx, tri, tables,
+        )
         cand_n = _agather(jnp.where(keep, mp_res[idxc], I32_MAX).ravel(), axis).ravel()
         cand_r = _agather(jnp.where(keep, mp_rel[idxc], I32_MAX).ravel(), axis).ravel()
         c_n, c_r, ovf = _dedup_truncate(
@@ -244,6 +267,7 @@ def _query_one(
     tid_map,  # int32[num_schema_types] → interner type id
     Cd_n, Cd_r, Cp_n, Cp_r,  # [U, C] closures
     q_res, q_perm, q_subj, q_srel, q_wc, q_row, q_self,
+    q_ctx=-1, tri=None, tables=None,
     axis: Optional[str] = None,
 ):
     N = cfg.subgraph_nodes
@@ -254,13 +278,13 @@ def _query_one(
 
     e_rel, e_res = arrs["e_rel"], arrs["e_res"]
     e_subj, e_srel1 = arrs["e_subj"], arrs["e_srel1"]
-    e_cav, e_exp = arrs["e_caveat"], arrs["e_exp"]
+    e_cav, e_exp, e_ctx = arrs["e_caveat"], arrs["e_exp"], arrs["e_ctx"]
     us_rel, us_res = arrs["us_rel"], arrs["us_res"]
     us_subj, us_srel = arrs["us_subj"], arrs["us_srel"]
-    us_cav, us_exp = arrs["us_caveat"], arrs["us_exp"]
+    us_cav, us_exp, us_ctx = arrs["us_caveat"], arrs["us_exp"], arrs["us_ctx"]
     ar_rel, ar_res = arrs["ar_rel"], arrs["ar_res"]
     ar_child = arrs["ar_child"]
-    ar_cav, ar_exp = arrs["ar_caveat"], arrs["ar_exp"]
+    ar_cav, ar_exp, ar_ctx = arrs["ar_caveat"], arrs["ar_exp"], arrs["ar_ctx"]
     node_type = arrs["node_type"]
 
     my_cd_n, my_cd_r = Cd_n[q_row], Cd_r[q_row]
@@ -300,8 +324,14 @@ def _query_one(
                 idx = lo[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]
                 valid = (idx < hi[:, None]) & (nodes >= 0)[:, None]
                 idxc = jnp.clip(idx, 0, last_ar)
-                gd = valid & _gate(ar_cav[idxc], ar_exp[idxc], now, "d")
-                gp = valid & _gate(ar_cav[idxc], ar_exp[idxc], now, "p")
+                gd = valid & _gate(
+                    ar_cav[idxc], ar_ctx[idxc], ar_exp[idxc], now, "d",
+                    q_ctx, tri, tables,
+                )
+                gp = valid & _gate(
+                    ar_cav[idxc], ar_ctx[idxc], ar_exp[idxc], now, "p",
+                    q_ctx, tri, tables,
+                )
                 cand_children.append(jnp.where(valid, ar_child[idxc], -1))
                 cand_gd.append(gd)
                 cand_gp.append(gp)
@@ -365,8 +395,12 @@ def _query_one(
             & (e_subj[posc] == q_subj)
             & (e_srel1[posc] == q_srel + 1)
         )
-        d = hit & _gate(e_cav[posc], e_exp[posc], now, "d")
-        p = hit & _gate(e_cav[posc], e_exp[posc], now, "p")
+        d = hit & _gate(
+            e_cav[posc], e_ctx[posc], e_exp[posc], now, "d", q_ctx, tri, tables
+        )
+        p = hit & _gate(
+            e_cav[posc], e_ctx[posc], e_exp[posc], now, "p", q_ctx, tri, tables
+        )
         # wildcard (only grants direct-object subject queries)
         wq = jnp.where((q_wc >= 0) & (q_srel < 0), q_wc, I32_MAX)
         wpos = _lex_search(
@@ -381,8 +415,12 @@ def _query_one(
             & (e_subj[wposc] == wq)
             & (e_srel1[wposc] == 0)
         )
-        d |= whit & _gate(e_cav[wposc], e_exp[wposc], now, "d")
-        p |= whit & _gate(e_cav[wposc], e_exp[wposc], now, "p")
+        d |= whit & _gate(
+            e_cav[wposc], e_ctx[wposc], e_exp[wposc], now, "d", q_ctx, tri, tables
+        )
+        p |= whit & _gate(
+            e_cav[wposc], e_ctx[wposc], e_exp[wposc], now, "p", q_ctx, tri, tables
+        )
         # userset grants probed against the subject closure
         lo, hi = _lex_range2(us_rel, us_res, rel_slot, node_k)
         ovf = (hi - lo) > KU
@@ -395,8 +433,12 @@ def _query_one(
         in_p = jax.vmap(
             lambda s, r: _lex_contains2(my_cp_n, my_cp_r, s, r)
         )(us_subj[idxc], us_srel[idxc])
-        d |= jnp.any(valid & in_d & _gate(us_cav[idxc], us_exp[idxc], now, "d"))
-        p |= jnp.any(valid & in_p & _gate(us_cav[idxc], us_exp[idxc], now, "p"))
+        d |= jnp.any(valid & in_d & _gate(
+            us_cav[idxc], us_ctx[idxc], us_exp[idxc], now, "d", q_ctx, tri, tables
+        ))
+        p |= jnp.any(valid & in_p & _gate(
+            us_cav[idxc], us_ctx[idxc], us_exp[idxc], now, "p", q_ctx, tri, tables
+        ))
         return d, p, ovf
 
     rs = jnp.asarray(plan.rel_leaf_slots, dtype=jnp.int32)
@@ -485,34 +527,55 @@ def _query_one(
 
 
 def _make_check_fn(plan: DevicePlan, cfg: EngineConfig,
-                   axis: Optional[str] = None, jit: bool = True):
+                   axis: Optional[str] = None, jit: bool = True,
+                   caveat_plan: Optional[CaveatDevicePlan] = None):
     """Build the whole-batch check function.  With ``axis`` set, the
     function is written for shard_map over that mesh axis: edge arrays are
-    shard-local and collectives merge at every gather/test point."""
+    shard-local and collectives merge at every gather/test point.  With a
+    caveat plan, the on-device CEL VM gates caveated edges against merged
+    stored/query context (qctx tables ride along as batch inputs)."""
 
-    def fn(arrs, tid_map, now, u_subj, u_srel, u_wc,
-           q_res, q_perm, q_subj, q_srel, q_wc, q_row, q_self):
+    tri = make_tri_fn(caveat_plan) if caveat_plan is not None else None
+
+    def fn(arrs, tid_map, now, u_subj, u_srel, u_wc, u_qctx,
+           q_res, q_perm, q_subj, q_srel, q_wc, q_row, q_self, q_ctx, qctx):
+        if tri is not None:
+            tables = {
+                "ectx_vi": arrs["ectx_vi"], "ectx_vf": arrs["ectx_vf"],
+                "ectx_pr": arrs["ectx_pr"], "ectx_host": arrs["ectx_host"],
+                "qctx_vi": qctx["vi"], "qctx_vf": qctx["vf"],
+                "qctx_pr": qctx["pr"], "qctx_host": qctx["host"],
+            }
+        else:
+            tables = None
         close_p = jax.vmap(
-            lambda s, r, w: _closure_one(arrs, cfg, "p", now, s, r, w, axis)
+            lambda s, r, w, qc: _closure_one(
+                arrs, cfg, "p", now, s, r, w, qc, tri, tables, axis
+            )
         )
-        Cp_n, Cp_r, ovf_p = close_p(u_subj, u_srel, u_wc)
+        Cp_n, Cp_r, ovf_p = close_p(u_subj, u_srel, u_wc, u_qctx)
         if plan.two_plane:
             close_d = jax.vmap(
-                lambda s, r, w: _closure_one(arrs, cfg, "d", now, s, r, w, axis)
+                lambda s, r, w, qc: _closure_one(
+                    arrs, cfg, "d", now, s, r, w, qc, tri, tables, axis
+                )
             )
-            Cd_n, Cd_r, ovf_d = close_d(u_subj, u_srel, u_wc)
+            Cd_n, Cd_r, ovf_d = close_d(u_subj, u_srel, u_wc, u_qctx)
         else:
             Cd_n, Cd_r, ovf_d = Cp_n, Cp_r, ovf_p
 
         per_query = jax.vmap(
-            lambda a, b, c, d_, e, f, g: _query_one(
+            lambda a, b, c, d_, e, f, g, qc: _query_one(
                 arrs, plan, cfg, now, tid_map,
                 Cd_n, Cd_r, Cp_n, Cp_r,
                 a, b, c, d_, e, f, g,
+                qc, tri, tables,
                 axis,
             )
         )
-        d, p, ovf_q = per_query(q_res, q_perm, q_subj, q_srel, q_wc, q_row, q_self)
+        d, p, ovf_q = per_query(
+            q_res, q_perm, q_subj, q_srel, q_wc, q_row, q_self, q_ctx
+        )
         u_ovf = ovf_d | ovf_p
         return d, p, ovf_q | u_ovf[q_row]
 
@@ -533,6 +596,9 @@ class DeviceSnapshot:
     arrays: Dict[str, jnp.ndarray]
     tid_map: jnp.ndarray  # int32[num_schema_types] → interner type id
     snapshot: Snapshot
+    #: string-intern pool for caveat context values (literals + stored
+    #: context strings); query-time strings outside it get negative ids
+    strings: Optional[Dict[str, int]] = None
 
 
 class DeviceEngine:
@@ -545,47 +611,100 @@ class DeviceEngine:
         self.compiled = compiled
         self.plan = build_plan(compiled)
         self.config = config or EngineConfig.for_schema(compiled)
-        self._fn = _make_check_fn(self.plan, self.config)
+        self.caveat_plan = (
+            build_caveat_plan(compiled) if self.plan.two_plane else None
+        )
+        self._fn = _make_check_fn(
+            self.plan, self.config, caveat_plan=self.caveat_plan
+        )
+
+    #: every per-edge/lookup column _host_arrays emits (the sharded engine
+    #: derives its shard_map specs from this — keep in lockstep, enforced
+    #: by test_sharded.py's key-parity test)
+    ARRAY_COLUMN_KEYS = (
+        "e_rel", "e_res", "e_subj", "e_srel1", "e_caveat", "e_ctx", "e_exp",
+        "us_rel", "us_res", "us_subj", "us_srel", "us_caveat", "us_ctx",
+        "us_exp",
+        "ms_subj", "ms_res", "ms_rel", "ms_caveat", "ms_ctx", "ms_exp",
+        "mp_subj", "mp_srel", "mp_res", "mp_rel", "mp_caveat", "mp_ctx",
+        "mp_exp",
+        "ar_rel", "ar_res", "ar_child", "ar_caveat", "ar_ctx", "ar_exp",
+        "node_type",
+    )
 
     # -- snapshot preparation -------------------------------------------
-    def prepare(self, snap: Snapshot) -> DeviceSnapshot:
+    def _host_arrays(self, snap: Snapshot) -> Dict[str, np.ndarray]:
+        """Padded host-side columns (shared by single-chip and sharded
+        prepare paths)."""
         E = _ceil_pow2(snap.e_rel.shape[0])
         US = _ceil_pow2(snap.us_rel.shape[0])
         MS = _ceil_pow2(snap.ms_subj.shape[0])
         MP = _ceil_pow2(snap.mp_subj.shape[0])
         AR = _ceil_pow2(snap.ar_rel.shape[0])
         NN = _ceil_pow2(snap.num_nodes)
-        arrays = {
+        return {
             "e_rel": _pad_sorted(snap.e_rel, E),
             "e_res": _pad_sorted(snap.e_res, E),
             "e_subj": _pad_sorted(snap.e_subj, E),
             "e_srel1": _pad_sorted(snap.e_srel1, E),
             "e_caveat": _pad_payload(snap.e_caveat, E),
+            "e_ctx": _pad_payload(snap.e_ctx, E, -1),
             "e_exp": _pad_payload(snap.e_exp, E),
             "us_rel": _pad_sorted(snap.us_rel, US),
             "us_res": _pad_sorted(snap.us_res, US),
             "us_subj": _pad_payload(snap.us_subj, US, -1),
             "us_srel": _pad_payload(snap.us_srel, US, -1),
             "us_caveat": _pad_payload(snap.us_caveat, US),
+            "us_ctx": _pad_payload(snap.us_ctx, US, -1),
             "us_exp": _pad_payload(snap.us_exp, US),
             "ms_subj": _pad_sorted(snap.ms_subj, MS),
             "ms_res": _pad_payload(snap.ms_res, MS, -1),
             "ms_rel": _pad_payload(snap.ms_rel, MS, -1),
             "ms_caveat": _pad_payload(snap.ms_caveat, MS),
+            "ms_ctx": _pad_payload(snap.ms_ctx, MS, -1),
             "ms_exp": _pad_payload(snap.ms_exp, MS),
             "mp_subj": _pad_sorted(snap.mp_subj, MP),
             "mp_srel": _pad_sorted(snap.mp_srel, MP),
             "mp_res": _pad_payload(snap.mp_res, MP, -1),
             "mp_rel": _pad_payload(snap.mp_rel, MP, -1),
             "mp_caveat": _pad_payload(snap.mp_caveat, MP),
+            "mp_ctx": _pad_payload(snap.mp_ctx, MP, -1),
             "mp_exp": _pad_payload(snap.mp_exp, MP),
             "ar_rel": _pad_sorted(snap.ar_rel, AR),
             "ar_res": _pad_sorted(snap.ar_res, AR),
             "ar_child": _pad_payload(snap.ar_child, AR, -1),
             "ar_caveat": _pad_payload(snap.ar_caveat, AR),
+            "ar_ctx": _pad_payload(snap.ar_ctx, AR, -1),
             "ar_exp": _pad_payload(snap.ar_exp, AR),
             "node_type": _pad_payload(snap.node_type, NN, -1),
         }
+
+    def _ectx_tables(
+        self, snap: Snapshot
+    ) -> Tuple[Dict[str, np.ndarray], Optional[Dict[str, int]]]:
+        """Encode stored caveat contexts into padded device tables."""
+        if self.caveat_plan is None:
+            return {}, None
+        strings = dict(self.caveat_plan.base_strings)
+        table = encode_contexts(self.caveat_plan, snap.contexts, strings)
+        NC = _ceil_pow2(table.vi.shape[0], 1)
+
+        def padrows(a: np.ndarray, fill=0) -> np.ndarray:
+            out = np.full((NC,) + a.shape[1:], fill, a.dtype)
+            out[: a.shape[0]] = a
+            return out
+
+        return {
+            "ectx_vi": padrows(table.vi),
+            "ectx_vf": padrows(table.vf),
+            "ectx_pr": padrows(table.present),
+            "ectx_host": padrows(table.host),
+        }, strings
+
+    def prepare(self, snap: Snapshot) -> DeviceSnapshot:
+        arrays = self._host_arrays(snap)
+        ectx, strings = self._ectx_tables(snap)
+        arrays.update(ectx)
         arrays = {k: jnp.asarray(v) for k, v in arrays.items()}
         tid_map = np.full(max(self.plan.num_schema_types, 1), -1, dtype=np.int32)
         for tname, tid in self.compiled.type_ids.items():
@@ -595,12 +714,14 @@ class DeviceEngine:
             arrays=arrays,
             tid_map=jnp.asarray(tid_map),
             snapshot=snap,
+            strings=strings,
         )
 
     # -- query lowering --------------------------------------------------
     def _lower_queries(
-        self, snap: Snapshot, rels: Sequence[Relationship]
-    ) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+        self, snap: Snapshot, rels: Sequence[Relationship],
+        strings: Optional[Dict[str, int]] = None,
+    ) -> Tuple[Dict[str, np.ndarray], np.ndarray, Dict[str, np.ndarray]]:
         B = len(rels)
         interner = snap.interner
         slot_of = self.compiled.slot_of_name
@@ -611,7 +732,24 @@ class DeviceEngine:
         q_subj = np.full(B, -1, np.int32)
         q_srel = np.full(B, -1, np.int32)
         q_wc = np.full(B, -1, np.int32)
+        q_ctx = np.full(B, -1, np.int32)
         q_self = np.zeros(B, bool)
+
+        # dedup request contexts (the caveat_context of the query
+        # relationship IS the request context, client/client.go:241-259)
+        ctx_rows: List[Mapping] = []
+        ctx_index: Dict[str, int] = {}
+        if self.caveat_plan is not None:
+            for i, r in enumerate(rels):
+                if r.caveat_context:
+                    key = repr(sorted(r.caveat_context.items(), key=lambda kv: kv[0]))
+                    at = ctx_index.get(key)
+                    if at is None:
+                        at = len(ctx_rows)
+                        ctx_index[key] = at
+                        ctx_rows.append(r.caveat_context)
+                    q_ctx[i] = at
+
         for i, r in enumerate(rels):
             q_res[i] = interner.lookup(r.resource_type, r.resource_id)
             q_perm[i] = slot_of.get(r.resource_relation, -1)
@@ -637,15 +775,48 @@ class DeviceEngine:
                 and r.subject_relation != ""
             )
 
-        # unique subjects for Phase A
-        subj_key = np.stack([q_subj, q_srel, q_wc], axis=1)
+        # unique (subject, query-context) rows for Phase A — context is part
+        # of the key because caveat gates make closures context-dependent
+        subj_key = np.stack([q_subj, q_srel, q_wc, q_ctx], axis=1)
         uniq, q_row = np.unique(subj_key, axis=0, return_inverse=True)
         queries = {
             "q_res": q_res, "q_perm": q_perm, "q_subj": q_subj,
-            "q_srel": q_srel, "q_wc": q_wc,
+            "q_srel": q_srel, "q_wc": q_wc, "q_ctx": q_ctx,
             "q_row": q_row.astype(np.int32), "q_self": q_self,
         }
-        return queries, uniq.astype(np.int32)
+        qctx_tables = self._encode_query_contexts(ctx_rows, strings)
+        return queries, uniq.astype(np.int32), qctx_tables
+
+    def _encode_query_contexts(
+        self, ctx_rows: List[Mapping], strings: Optional[Dict[str, int]]
+    ) -> Dict[str, np.ndarray]:
+        """Encode deduped request contexts into padded qctx tables."""
+        if self.caveat_plan is None:
+            P = 1
+            return {
+                "vi": np.zeros((1, P), np.int32),
+                "vf": np.zeros((1, P), np.float32),
+                "pr": np.zeros((1, P), bool),
+                "host": np.zeros((1, 1), bool),
+            }
+        table = encode_contexts(
+            self.caveat_plan, ctx_rows,
+            strings if strings is not None else dict(self.caveat_plan.base_strings),
+            extra_strings={},
+        )
+        NQ = _ceil_pow2(table.vi.shape[0], 1)
+
+        def padrows(a: np.ndarray) -> np.ndarray:
+            out = np.zeros((NQ,) + a.shape[1:], a.dtype)
+            out[: a.shape[0]] = a
+            return out
+
+        return {
+            "vi": padrows(table.vi),
+            "vf": padrows(table.vf),
+            "pr": padrows(table.present),
+            "host": padrows(table.host),
+        }
 
     # -- the batched check ----------------------------------------------
     def check_batch(
@@ -665,7 +836,7 @@ class DeviceEngine:
             z = np.zeros(0, bool)
             return z, z, z
         snap = dsnap.snapshot
-        queries, uniq = self._lower_queries(snap, rels)
+        queries, uniq, qctx = self._lower_queries(snap, rels, dsnap.strings)
         B = len(rels)
         BP = _ceil_pow2(B, self.config.batch_bucket_min)
         U = uniq.shape[0]
@@ -679,20 +850,133 @@ class DeviceEngine:
         u_subj = np.full(UP, -1, np.int32)
         u_srel = np.full(UP, -1, np.int32)
         u_wc = np.full(UP, -1, np.int32)
+        u_qctx = np.full(UP, -1, np.int32)
         u_subj[:U] = uniq[:, 0]
         u_srel[:U] = uniq[:, 1]
         u_wc[:U] = uniq[:, 2]
+        u_qctx[:U] = uniq[:, 3]
 
         now = jnp.int32(snap.now_rel32(now_us))
         d, p, ovf = self._fn(
             dsnap.arrays, dsnap.tid_map, now,
             jnp.asarray(u_subj), jnp.asarray(u_srel), jnp.asarray(u_wc),
+            jnp.asarray(u_qctx),
             padq(queries["q_res"], -1), padq(queries["q_perm"], -1),
             padq(queries["q_subj"], -1), padq(queries["q_srel"], -1),
             padq(queries["q_wc"], -1), padq(queries["q_row"], 0),
-            padq(queries["q_self"], False),
+            padq(queries["q_self"], False), padq(queries["q_ctx"], -1),
+            {k: jnp.asarray(v) for k, v in qctx.items()},
         )
-        d = np.asarray(d)[:B]
-        p = np.asarray(p)[:B]
-        ovf = np.asarray(ovf)[:B]
-        return d, p, ovf
+        # one device→host fetch for all three planes: separate np.asarray
+        # calls round-trip the dispatch boundary once each, which dominates
+        # small-batch latency on remote-attached TPUs
+        d, p, ovf = jax.device_get((d, p, ovf))
+        return d[:B], p[:B], ovf[:B]
+
+    # -- columnar bulk check ---------------------------------------------
+    def _columns_preamble(
+        self,
+        dsnap: DeviceSnapshot,
+        q_res: np.ndarray,
+        q_perm: np.ndarray,
+        q_subj: np.ndarray,
+        q_srel: Optional[np.ndarray],
+        q_wc: Optional[np.ndarray],
+        q_ctx: Optional[np.ndarray],
+        qctx_rows,
+    ) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
+        """Shared columnar-check preamble: optional-column defaulting,
+        query-context encoding, and the reflexive-self derivation — one
+        definition so the single-chip and sharded paths cannot drift."""
+        B = q_res.shape[0]
+        if q_srel is None:
+            q_srel = np.full(B, -1, np.int32)
+        if q_wc is None:
+            q_wc = np.full(B, -1, np.int32)
+        if q_ctx is None:
+            q_ctx = np.full(B, -1, np.int32)
+        qctx = self._encode_query_contexts(list(qctx_rows or []), dsnap.strings)
+        queries = {
+            "q_res": np.ascontiguousarray(q_res, np.int32),
+            "q_perm": np.ascontiguousarray(q_perm, np.int32),
+            "q_subj": np.ascontiguousarray(q_subj, np.int32),
+            "q_srel": np.ascontiguousarray(q_srel, np.int32),
+            "q_wc": np.ascontiguousarray(q_wc, np.int32),
+            "q_ctx": np.ascontiguousarray(q_ctx, np.int32),
+            # reflexive userset identity (a userset is a member of itself),
+            # same semantics as _lower_queries' q_self: slots are shared
+            # between q_perm and q_srel, and equal interned nodes mean
+            # equal (type, id)
+            "q_self": (q_res == q_subj) & (q_srel >= 0) & (q_perm == q_srel),
+        }
+        return queries, qctx
+
+    def check_columns(
+        self,
+        dsnap: DeviceSnapshot,
+        q_res: np.ndarray,
+        q_perm: np.ndarray,
+        q_subj: np.ndarray,
+        *,
+        q_srel: Optional[np.ndarray] = None,
+        q_wc: Optional[np.ndarray] = None,
+        q_ctx: Optional[np.ndarray] = None,
+        qctx_rows: Optional[Sequence[Mapping[str, Any]]] = None,
+        now_us: Optional[int] = None,
+        fetch: bool = True,
+    ):
+        """Bulk check straight from pre-interned int32 columns — the fast
+        path for 100k+-item batches, where per-item Relationship objects
+        would dominate (the analogue of the reference's chunked iterator
+        APIs, client/client.go:164-180).
+
+        With ``fetch`` (default) returns (definite, possible, overflow)
+        numpy arrays trimmed to the batch length, fetched in ONE
+        device→host transfer.  With ``fetch=False`` returns the raw padded
+        device outputs (length = pow2 bucket ≥ B) for pipelined dispatch
+        loops; fetch them with ``jax.device_get`` on the full arrays —
+        materializing *sliced* views of jit outputs degrades every
+        subsequent dispatch on remote-attached platforms.
+        """
+        snap = dsnap.snapshot
+        B = q_res.shape[0]
+        BP = _ceil_pow2(B, self.config.batch_bucket_min)
+        if q_srel is None:
+            q_srel = np.full(B, -1, np.int32)
+        if q_wc is None:
+            q_wc = np.full(B, -1, np.int32)
+        if q_ctx is None:
+            q_ctx = np.full(B, -1, np.int32)
+        qctx = self._encode_query_contexts(list(qctx_rows or []), dsnap.strings)
+        # reflexive userset identity (a userset is a member of itself),
+        # same as _lower_queries' q_self: slots are shared between q_perm
+        # and q_srel, and equal interned nodes mean equal (type, id)
+        q_self = (q_res == q_subj) & (q_srel >= 0) & (q_perm == q_srel)
+
+        subj_key = np.stack([q_subj, q_srel, q_wc, q_ctx], axis=1)
+        uniq, q_row = np.unique(subj_key, axis=0, return_inverse=True)
+        U = uniq.shape[0]
+        UP = _ceil_pow2(U, self.config.batch_bucket_min)
+        u = np.full((UP, 4), -1, np.int32)
+        u[:U] = uniq
+
+        def padq(a, fill):
+            out = np.full(BP, fill, np.asarray(a).dtype)
+            out[:B] = a
+            return jnp.asarray(out)
+
+        now = jnp.int32(snap.now_rel32(now_us))
+        d, p, ovf = self._fn(
+            dsnap.arrays, dsnap.tid_map, now,
+            jnp.asarray(u[:, 0]), jnp.asarray(u[:, 1]), jnp.asarray(u[:, 2]),
+            jnp.asarray(u[:, 3]),
+            padq(q_res, -1), padq(q_perm, -1), padq(q_subj, -1),
+            padq(q_srel, -1), padq(q_wc, -1),
+            padq(q_row.astype(np.int32), 0),
+            padq(q_self, False), padq(q_ctx, -1),
+            {k: jnp.asarray(v) for k, v in qctx.items()},
+        )
+        if not fetch:
+            return d, p, ovf
+        d, p, ovf = jax.device_get((d, p, ovf))
+        return d[:B], p[:B], ovf[:B]

@@ -1,0 +1,104 @@
+"""Native runtime layer (C++ via ctypes).
+
+The reference delegates all heavy lifting to a server; here the host-side
+ingest pipeline is part of the framework, and its hot paths — bulk string
+interning and the primary-order lexsort feeding the device's binary-search
+layout — are implemented in C++ (``ingest.cpp``) and loaded through a C
+ABI.  Everything degrades gracefully: if the shared library can't be
+built/loaded (no compiler, exotic platform), ``available()`` is False and
+callers fall back to the pure-numpy/python paths with identical results.
+
+The library is compiled on first use with g++ (the image has no pybind11;
+ctypes needs only a .so), cached next to this file, and rebuilt whenever
+``ingest.cpp`` is newer than the cached binary.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "ingest.cpp")
+_SO = os.path.join(_HERE, "libgochugaru_ingest.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    cmds = [
+        ["g++", "-O3", "-shared", "-fPIC", "-fopenmp", "-std=c++17",
+         _SRC, "-o", _SO],
+        # no-OpenMP fallback (serial sort)
+        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO],
+    ]
+    for cmd in cmds:
+        try:
+            r = subprocess.run(cmd, capture_output=True, timeout=120)
+            if r.returncode == 0:
+                return True
+        except (OSError, subprocess.TimeoutExpired):
+            return False
+    return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            need_build = not os.path.exists(_SO) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+            )
+            if need_build and not _build():
+                return None
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        c = ctypes
+        lib.gi_new.restype = c.c_void_p
+        lib.gi_free.argtypes = [c.c_void_p]
+        lib.gi_size.argtypes = [c.c_void_p]
+        lib.gi_size.restype = c.c_int64
+        lib.gi_intern_batch.argtypes = [
+            c.c_void_p, c.c_char_p, c.POINTER(c.c_int64), c.c_int64,
+            c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+        ]
+        lib.gi_lookup_batch.argtypes = lib.gi_intern_batch.argtypes
+        lib.gi_node_types.argtypes = [c.c_void_p, c.POINTER(c.c_int32), c.c_int64]
+        lib.gi_key.argtypes = [
+            c.c_void_p, c.c_int64, c.c_char_p, c.c_int64, c.POINTER(c.c_int32),
+        ]
+        lib.gi_key.restype = c.c_int64
+        for name in ("gi_lexsort4",):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+                c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+                c.c_int64, c.POINTER(c.c_int64),
+            ]
+        lib.gi_lexsort2.argtypes = [
+            c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+            c.c_int64, c.POINTER(c.c_int64),
+        ]
+        lib.gi_argsort1.argtypes = [
+            c.POINTER(c.c_int32), c.c_int64, c.POINTER(c.c_int64),
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    return _load()

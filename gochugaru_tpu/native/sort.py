@@ -1,0 +1,70 @@
+"""Sorting front-ends over the native library, numpy fallback included.
+
+``lexsort4`` is the snapshot primary order (rel, res, subj, srel1) — the
+layout every device binary search assumes (store/snapshot.py).  At 100M
+rows numpy's single-threaded lexsort is tens of seconds; the native
+OpenMP sort over packed 64-bit key pairs is the difference between
+"rebuild is interactive" and "rebuild is a coffee break" (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from . import lib
+
+
+def _i32ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def lexsort4(a: np.ndarray, b: np.ndarray, c: np.ndarray, d: np.ndarray) -> np.ndarray:
+    """Permutation sorting rows by (a, b, c, d), ints.  Equivalent to
+    ``np.lexsort((d, c, b, a))``."""
+    L = lib()
+    n = a.shape[0]
+    if L is None or n < (1 << 16):
+        return np.lexsort((d, c, b, a))
+    a32 = np.ascontiguousarray(a, np.int32)
+    b32 = np.ascontiguousarray(b, np.int32)
+    c32 = np.ascontiguousarray(c, np.int32)
+    d32 = np.ascontiguousarray(d, np.int32)
+    out = np.empty(n, np.int64)
+    L.gi_lexsort4(
+        _i32ptr(a32), _i32ptr(b32), _i32ptr(c32), _i32ptr(d32),
+        ctypes.c_int64(n), out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out
+
+
+def lexsort2(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Stable permutation by (a, b) — ``np.lexsort((b, a))``."""
+    L = lib()
+    n = a.shape[0]
+    if L is None or n < (1 << 16):
+        return np.lexsort((b, a))
+    a32 = np.ascontiguousarray(a, np.int32)
+    b32 = np.ascontiguousarray(b, np.int32)
+    out = np.empty(n, np.int64)
+    L.gi_lexsort2(
+        _i32ptr(a32), _i32ptr(b32), ctypes.c_int64(n),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out
+
+
+def argsort1(a: np.ndarray) -> np.ndarray:
+    """Stable argsort of one int column — ``np.argsort(a, kind='stable')``."""
+    L = lib()
+    n = a.shape[0]
+    if L is None or n < (1 << 16):
+        return np.argsort(a, kind="stable")
+    a32 = np.ascontiguousarray(a, np.int32)
+    out = np.empty(n, np.int64)
+    L.gi_argsort1(
+        _i32ptr(a32), ctypes.c_int64(n),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out

@@ -59,16 +59,28 @@ class ShardedEngine(DeviceEngine):
         self.mesh = mesh
         self.data_size = mesh.shape[DATA_AXIS]
         self.model_size = mesh.shape[MODEL_AXIS]
-        raw = _make_check_fn(self.plan, self.config, axis=MODEL_AXIS, jit=False)
+        raw = _make_check_fn(
+            self.plan, self.config, axis=MODEL_AXIS, jit=False,
+            caveat_plan=self.caveat_plan,
+        )
 
-        arr_spec = {k: P(MODEL_AXIS) for k in self._ARRAY_KEYS}
-        # node_type and tid_map are lookup tables, replicated everywhere
-        arr_spec["node_type"] = P()
+        def arr_spec_of(key: str):
+            # lookup tables (node type map, caveat context tables) are
+            # replicated; sorted edge columns shard along the model axis
+            if key == "node_type" or key.startswith("ectx_"):
+                return P()
+            return P(MODEL_AXIS)
+
+        self._arr_spec_of = arr_spec_of
+        arr_spec = {k: arr_spec_of(k) for k in self._array_keys()}
+        qctx_spec = {k: P() for k in ("vi", "vf", "pr", "host")}
         in_specs = (
             arr_spec, P(), P(),  # arrays, tid_map, now
-            P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),  # u_subj, u_srel, u_wc
+            P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),  # u_*
             P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),  # q_res, q_perm, q_subj
             P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),  # srel, wc, row, self
+            P(DATA_AXIS),  # q_ctx
+            qctx_spec,
         )
         out_specs = (P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS))
         self._fn = jax.jit(
@@ -78,61 +90,42 @@ class ShardedEngine(DeviceEngine):
             )
         )
 
-    _ARRAY_KEYS = (
-        "e_rel", "e_res", "e_subj", "e_srel1", "e_caveat", "e_exp",
-        "us_rel", "us_res", "us_subj", "us_srel", "us_caveat", "us_exp",
-        "ms_subj", "ms_res", "ms_rel", "ms_caveat", "ms_exp",
-        "mp_subj", "mp_srel", "mp_res", "mp_rel", "mp_caveat", "mp_exp",
-        "ar_rel", "ar_res", "ar_child", "ar_caveat", "ar_exp",
-        "node_type",
-    )
+    def _array_keys(self):
+        # single source of truth for the column set lives in DeviceEngine
+        # (ARRAY_COLUMN_KEYS), so a new column added to _host_arrays can't
+        # silently diverge from the shard_map specs
+        keys = list(DeviceEngine.ARRAY_COLUMN_KEYS)
+        if self.caveat_plan is not None:
+            keys += ["ectx_vi", "ectx_vf", "ectx_pr", "ectx_host"]
+        return keys
 
     # -- snapshot preparation: pad every view to a multiple of model_size --
     def prepare(self, snap: Snapshot) -> DeviceSnapshot:
-        def bucket(n: int) -> int:
-            return _ceil_pow2(max(n, 1), max(8, self.model_size))
-
-        E = bucket(snap.e_rel.shape[0])
-        US = bucket(snap.us_rel.shape[0])
-        MS = bucket(snap.ms_subj.shape[0])
-        MP = bucket(snap.mp_subj.shape[0])
-        AR = bucket(snap.ar_rel.shape[0])
-        NN = _ceil_pow2(snap.num_nodes)
-        host = {
-            "e_rel": _pad_sorted(snap.e_rel, E),
-            "e_res": _pad_sorted(snap.e_res, E),
-            "e_subj": _pad_sorted(snap.e_subj, E),
-            "e_srel1": _pad_sorted(snap.e_srel1, E),
-            "e_caveat": _pad_payload(snap.e_caveat, E),
-            "e_exp": _pad_payload(snap.e_exp, E),
-            "us_rel": _pad_sorted(snap.us_rel, US),
-            "us_res": _pad_sorted(snap.us_res, US),
-            "us_subj": _pad_payload(snap.us_subj, US, -1),
-            "us_srel": _pad_payload(snap.us_srel, US, -1),
-            "us_caveat": _pad_payload(snap.us_caveat, US),
-            "us_exp": _pad_payload(snap.us_exp, US),
-            "ms_subj": _pad_sorted(snap.ms_subj, MS),
-            "ms_res": _pad_payload(snap.ms_res, MS, -1),
-            "ms_rel": _pad_payload(snap.ms_rel, MS, -1),
-            "ms_caveat": _pad_payload(snap.ms_caveat, MS),
-            "ms_exp": _pad_payload(snap.ms_exp, MS),
-            "mp_subj": _pad_sorted(snap.mp_subj, MP),
-            "mp_srel": _pad_sorted(snap.mp_srel, MP),
-            "mp_res": _pad_payload(snap.mp_res, MP, -1),
-            "mp_rel": _pad_payload(snap.mp_rel, MP, -1),
-            "mp_caveat": _pad_payload(snap.mp_caveat, MP),
-            "mp_exp": _pad_payload(snap.mp_exp, MP),
-            "ar_rel": _pad_sorted(snap.ar_rel, AR),
-            "ar_res": _pad_sorted(snap.ar_res, AR),
-            "ar_child": _pad_payload(snap.ar_child, AR, -1),
-            "ar_caveat": _pad_payload(snap.ar_caveat, AR),
-            "ar_exp": _pad_payload(snap.ar_exp, AR),
-            "node_type": _pad_payload(snap.node_type, NN, -1),
+        host = self._host_arrays(snap)
+        # Model-sharded columns must split evenly across model_size (power
+        # of two); the base padding is already pow2, so only meshes wider
+        # than the smallest bucket need more.  Sorted key columns keep the
+        # I32_MAX sentinel so the padded tail sorts last; payload pads are
+        # never read through a matching key.
+        sorted_keys = {
+            "e_rel", "e_res", "e_subj", "e_srel1", "us_rel", "us_res",
+            "ms_subj", "mp_subj", "mp_srel", "ar_rel", "ar_res",
         }
+        m = max(8, _ceil_pow2(self.model_size, 1))
+        for k, v in list(host.items()):
+            if self._arr_spec_of(k) == P(MODEL_AXIS) and v.shape[0] % self.model_size:
+                size = _ceil_pow2(v.shape[0], m)
+                fill = (2**31 - 1) if k in sorted_keys else -1
+                out = np.full(size, fill, v.dtype)
+                out[: v.shape[0]] = v
+                host[k] = out
+        ectx, strings = self._ectx_tables(snap)
+        host.update(ectx)
         arrays = {}
         for k, v in host.items():
-            spec = P() if k == "node_type" else P(MODEL_AXIS)
-            arrays[k] = jax.device_put(v, NamedSharding(self.mesh, spec))
+            arrays[k] = jax.device_put(
+                v, NamedSharding(self.mesh, self._arr_spec_of(k))
+            )
         tid_map = np.full(max(self.plan.num_schema_types, 1), -1, dtype=np.int32)
         for tname, tid in self.compiled.type_ids.items():
             tid_map[tid] = snap.interner.type_lookup(tname)
@@ -141,32 +134,40 @@ class ShardedEngine(DeviceEngine):
             arrays=arrays,
             tid_map=jnp.asarray(tid_map),
             snapshot=snap,
+            strings=strings,
         )
 
     # -- batched check: queries partitioned per data-shard ----------------
-    def check_batch(
+    def _dispatch_columns(
         self,
         dsnap: DeviceSnapshot,
-        rels: Sequence[Relationship],
-        *,
-        now_us: Optional[int] = None,
+        queries: Dict[str, np.ndarray],
+        qctx: Dict[str, np.ndarray],
+        now_us: Optional[int],
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        if not rels:
-            z = np.zeros(0, bool)
-            return z, z, z
+        """Partition query columns across the data axis, compute per-shard
+        unique (subject, context) closure rows, and dispatch the
+        shard_mapped check.  ``queries`` holds length-B columns (q_res,
+        q_perm, q_subj, q_srel, q_wc, q_ctx, q_self); q_row is derived
+        here per shard."""
         snap = dsnap.snapshot
         D = self.data_size
-        B = len(rels)
+        B = queries["q_res"].shape[0]
         per = _ceil_pow2(-(-B // D), self.config.batch_bucket_min)
         BP = per * D
 
-        queries, _ = self._lower_queries(snap, rels)
+        q = {
+            k: np.full(BP, -1 if v.dtype != bool else 0, v.dtype)
+            for k, v in queries.items()
+            if k != "q_row"
+        }
+        for k in q:
+            q[k][:B] = queries[k]
         # per-data-shard unique subjects (each shard computes closures only
         # for its own slice of the batch)
-        q = {k: np.full(BP, -1 if v.dtype != bool else 0, v.dtype) for k, v in queries.items()}
-        for k, v in queries.items():
-            q[k][:B] = v
-        subj_key = np.stack([q["q_subj"], q["q_srel"], q["q_wc"]], axis=1)
+        subj_key = np.stack(
+            [q["q_subj"], q["q_srel"], q["q_wc"], q["q_ctx"]], axis=1
+        )
         ulists = []
         rows = np.zeros(BP, np.int32)
         for s in range(D):
@@ -178,23 +179,78 @@ class ShardedEngine(DeviceEngine):
         u_subj = np.full(D * UP, -1, np.int32)
         u_srel = np.full(D * UP, -1, np.int32)
         u_wc = np.full(D * UP, -1, np.int32)
+        u_qctx = np.full(D * UP, -1, np.int32)
         for s, uniq in enumerate(ulists):
             n = uniq.shape[0]
             u_subj[s * UP : s * UP + n] = uniq[:, 0]
             u_srel[s * UP : s * UP + n] = uniq[:, 1]
             u_wc[s * UP : s * UP + n] = uniq[:, 2]
+            u_qctx[s * UP : s * UP + n] = uniq[:, 3]
         q["q_row"] = rows
 
         now = jnp.int32(snap.now_rel32(now_us))
         dsh = NamedSharding(self.mesh, P(DATA_AXIS))
+        rep = NamedSharding(self.mesh, P())
 
         def put(a):
             return jax.device_put(a, dsh)
 
         d, p, ovf = self._fn(
             dsnap.arrays, dsnap.tid_map, now,
-            put(u_subj), put(u_srel), put(u_wc),
+            put(u_subj), put(u_srel), put(u_wc), put(u_qctx),
             put(q["q_res"]), put(q["q_perm"]), put(q["q_subj"]),
             put(q["q_srel"]), put(q["q_wc"]), put(q["q_row"]), put(q["q_self"]),
+            put(q["q_ctx"]),
+            {k: jax.device_put(v, rep) for k, v in qctx.items()},
         )
-        return (np.asarray(d)[:B], np.asarray(p)[:B], np.asarray(ovf)[:B])
+        d, p, ovf = jax.device_get((d, p, ovf))
+        return d[:B], p[:B], ovf[:B]
+
+    def check_batch(
+        self,
+        dsnap: DeviceSnapshot,
+        rels: Sequence[Relationship],
+        *,
+        now_us: Optional[int] = None,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        if not rels:
+            z = np.zeros(0, bool)
+            return z, z, z
+        queries, _, qctx = self._lower_queries(dsnap.snapshot, rels, dsnap.strings)
+        return self._dispatch_columns(dsnap, queries, qctx, now_us)
+
+    def check_columns(
+        self,
+        dsnap: DeviceSnapshot,
+        q_res: np.ndarray,
+        q_perm: np.ndarray,
+        q_subj: np.ndarray,
+        *,
+        q_srel: Optional[np.ndarray] = None,
+        q_wc: Optional[np.ndarray] = None,
+        q_ctx: Optional[np.ndarray] = None,
+        qctx_rows=None,
+        now_us: Optional[int] = None,
+        fetch: bool = True,  # sharded dispatch always fetches (one get)
+    ):
+        """Columnar bulk check with the sharded layout (the base-class fast
+        path assumes an unsharded q_row/uniq table, which would be wrong
+        under shard_map — see _dispatch_columns)."""
+        B = q_res.shape[0]
+        if q_srel is None:
+            q_srel = np.full(B, -1, np.int32)
+        if q_wc is None:
+            q_wc = np.full(B, -1, np.int32)
+        if q_ctx is None:
+            q_ctx = np.full(B, -1, np.int32)
+        qctx = self._encode_query_contexts(list(qctx_rows or []), dsnap.strings)
+        queries = {
+            "q_res": q_res.astype(np.int32),
+            "q_perm": q_perm.astype(np.int32),
+            "q_subj": q_subj.astype(np.int32),
+            "q_srel": q_srel.astype(np.int32),
+            "q_wc": q_wc.astype(np.int32),
+            "q_ctx": q_ctx.astype(np.int32),
+            "q_self": (q_res == q_subj) & (q_srel >= 0) & (q_perm == q_srel),
+        }
+        return self._dispatch_columns(dsnap, queries, qctx, now_us)

@@ -272,6 +272,23 @@ class _Parser:
         while self.peek().text != ")":
             pname = self.expect_ident("parameter name").text
             ptype = self.expect_ident("parameter type").text
+            # generic types: list<int>, map<string>, nested generics
+            if self.peek().text == "<":
+                depth = 0
+                while True:
+                    t = self.next()
+                    ptype += t.text
+                    if t.text == "<":
+                        depth += 1
+                    elif t.text == ">":
+                        depth -= 1
+                        if depth == 0:
+                            break
+                    if t.kind == "eof":
+                        raise SchemaParseError(
+                            f"unterminated generic type for parameter {pname!r}",
+                            t.line,
+                        )
             if pname in params:
                 raise SchemaParseError(f"duplicate caveat parameter {pname!r}", self.peek().line)
             params[pname] = ptype

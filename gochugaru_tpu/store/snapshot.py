@@ -30,6 +30,7 @@ from typing import Any, Dict, Iterator, List, Mapping, Optional, Sequence
 
 import numpy as np
 
+from ..native.sort import argsort1, lexsort2, lexsort4
 from ..rel.filter import Filter
 from ..rel.relationship import Relationship, WILDCARD_ID, expiration_micros
 from ..schema.compiler import CompiledSchema
@@ -317,7 +318,9 @@ def build_snapshot_from_columns(
 
     srel1 = srel + 1
 
-    order = np.lexsort((srel1, subj, res, rel))
+    # primary order (rel, res, subj, srel1) — native parallel sort when the
+    # C++ ingest layer is available (the 100M-edge rebuild bottleneck)
+    order = lexsort4(rel, res, subj, srel1)
     return finish_snapshot(
         revision, compiled, interner,
         e_rel=rel[order].astype(np.int32),
@@ -387,7 +390,7 @@ def finish_snapshot(
 
     # seeds: direct edges into used usersets, by subject node
     seed_mask = feeds & (srel_o < 0)
-    seed_sort = np.argsort(subj_o[seed_mask], kind="stable")
+    seed_sort = argsort1(subj_o[seed_mask].astype(np.int32))
     ms_subj = subj_o[seed_mask][seed_sort].astype(np.int32)
     ms_res = res_o[seed_mask][seed_sort].astype(np.int32)
     ms_rel = rel_o[seed_mask][seed_sort].astype(np.int32)
@@ -397,7 +400,9 @@ def finish_snapshot(
 
     # propagation: userset edges into used usersets, by (subj, srel)
     prop_mask = feeds & (srel_o >= 0)
-    prop_sort = np.lexsort((srel_o[prop_mask], subj_o[prop_mask]))
+    prop_sort = lexsort2(
+        subj_o[prop_mask].astype(np.int32), srel_o[prop_mask].astype(np.int32)
+    )
     mp_subj = subj_o[prop_mask][prop_sort].astype(np.int32)
     mp_srel = srel_o[prop_mask][prop_sort].astype(np.int32)
     mp_res = res_o[prop_mask][prop_sort].astype(np.int32)

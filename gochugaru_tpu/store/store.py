@@ -80,7 +80,11 @@ class Store:
         self._schema_text = ""
         self._compiled: Optional[CompiledSchema] = None
         self._caveat_programs: Dict[str, CelProgram] = {}
-        self.interner = Interner()
+        # native C++ interner when the ingest library loads; pure-Python
+        # fallback with identical semantics (native/interner.py)
+        from ..native.interner import make_interner
+
+        self.interner = make_interner()
         self._snapshots: Dict[int, Snapshot] = {}
         self._keep_generations = keep_generations
 

@@ -1,0 +1,323 @@
+"""On-device CEL caveat evaluation (caveats/device.py) — differential
+tests against the host oracle.
+
+The contract under test: for every query, the device's (definite,
+possible) planes bracket the oracle's tri-state — definite == (oracle==T)
+whenever the device had what it needed, and any query where the device
+can't be exact surfaces as possible&~definite (→ host fallback in the
+client), never as a wrong definite answer.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from gochugaru_tpu import rel
+from gochugaru_tpu.caveats import compile_cel
+from gochugaru_tpu.caveats.device import build_caveat_plan, encode_contexts
+from gochugaru_tpu.engine.device import DeviceEngine
+from gochugaru_tpu.engine.oracle import F, Oracle, T, U
+from gochugaru_tpu.schema import compile_schema, parse_schema
+from gochugaru_tpu.store.interner import Interner
+from gochugaru_tpu.store.snapshot import build_snapshot
+
+NOW = 1_700_000_000_000_000
+
+
+def world(schema, rels):
+    cs = compile_schema(parse_schema(schema))
+    snap = build_snapshot(1, cs, Interner(), rels, epoch_us=NOW)
+    progs = {
+        name: compile_cel(name, decl.params, decl.expression)
+        for name, decl in cs.schema.caveats.items()
+    }
+    oracle = Oracle(cs, rels, progs, now_us=NOW)
+    engine = DeviceEngine(cs)
+    dsnap = engine.prepare(snap)
+    return cs, engine, dsnap, oracle
+
+
+def run_and_compare(engine, dsnap, oracle, checks, expect_no_fallback=True):
+    d, p, ovf = engine.check_batch(dsnap, checks, now_us=NOW)
+    for i, q in enumerate(checks):
+        want = oracle.check_relationship(q)
+        assert bool(d[i]) == (want == T), f"definite mismatch on {q}: {want}"
+        if not ovf[i]:
+            # possible must bracket: oracle U or T ⇒ possible
+            assert bool(p[i]) == (want != F), f"possible mismatch on {q}: {want}"
+        if expect_no_fallback and want != U:
+            assert not (p[i] and not d[i]) or want == T, q
+    return d, p, ovf
+
+
+SCHEMA_BASIC = """
+caveat tier_at_least(tier int, minimum int) { tier >= minimum }
+caveat ip_allowed(ip string) { ip in ['10.0.0.1', '10.0.0.2'] }
+caveat weekday(is_weekday bool) { is_weekday }
+definition user {}
+definition doc {
+    relation viewer: user | user with tier_at_least | user with ip_allowed | user with weekday
+    permission view = viewer
+}
+"""
+
+
+def test_int_comparison_definite_on_device():
+    rels = [
+        rel.must_from_triple("doc:a", "viewer", "user:u1").with_caveat(
+            "tier_at_least", {"minimum": 5}
+        ),
+    ]
+    _, engine, dsnap, oracle = world(SCHEMA_BASIC, rels)
+    checks = [
+        rel.must_from_triple("doc:a", "view", "user:u1").with_caveat("", {"tier": 7}),
+        rel.must_from_triple("doc:a", "view", "user:u1").with_caveat("", {"tier": 3}),
+        rel.must_from_triple("doc:a", "view", "user:u1"),  # missing → U
+    ]
+    d, p, _ = run_and_compare(engine, dsnap, oracle, checks)
+    assert list(d) == [True, False, False]
+    assert list(p) == [True, False, True]
+
+
+def test_string_membership_and_unknown_strings():
+    rels = [
+        rel.must_from_triple("doc:a", "viewer", "user:u1").with_caveat(
+            "ip_allowed", {}
+        ),
+    ]
+    _, engine, dsnap, oracle = world(SCHEMA_BASIC, rels)
+    checks = [
+        rel.must_from_triple("doc:a", "view", "user:u1").with_caveat("", {"ip": "10.0.0.2"}),
+        # string the snapshot has never seen — must get a fresh negative id
+        rel.must_from_triple("doc:a", "view", "user:u1").with_caveat("", {"ip": "8.8.8.8"}),
+    ]
+    d, p, _ = run_and_compare(engine, dsnap, oracle, checks)
+    assert list(d) == [True, False]
+    assert list(p) == [True, False]
+
+
+def test_bool_param_and_stored_context_wins():
+    rels = [
+        # stored context pins is_weekday=False; query context must NOT
+        # override it (oracle.py: stored wins)
+        rel.must_from_triple("doc:a", "viewer", "user:u1").with_caveat(
+            "weekday", {"is_weekday": False}
+        ),
+        rel.must_from_triple("doc:b", "viewer", "user:u1").with_caveat("weekday", {}),
+    ]
+    _, engine, dsnap, oracle = world(SCHEMA_BASIC, rels)
+    checks = [
+        rel.must_from_triple("doc:a", "view", "user:u1").with_caveat(
+            "", {"is_weekday": True}
+        ),
+        rel.must_from_triple("doc:b", "view", "user:u1").with_caveat(
+            "", {"is_weekday": True}
+        ),
+    ]
+    d, p, _ = run_and_compare(engine, dsnap, oracle, checks)
+    assert list(d) == [False, True]
+
+
+SCHEMA_ARITH = """
+caveat quota(used int, limit int) { used + used * 2 < limit && limit % 2 == 0 }
+definition user {}
+definition doc {
+    relation viewer: user with quota
+    permission view = viewer
+}
+"""
+
+
+def test_int_arithmetic_with_division_semantics():
+    rels = [
+        rel.must_from_triple("doc:a", "viewer", "user:u1").with_caveat("quota", {}),
+    ]
+    _, engine, dsnap, oracle = world(SCHEMA_ARITH, rels)
+    checks = [
+        rel.must_from_triple("doc:a", "view", "user:u1").with_caveat(
+            "", {"used": 3, "limit": 10}
+        ),
+        rel.must_from_triple("doc:a", "view", "user:u1").with_caveat(
+            "", {"used": 4, "limit": 10}
+        ),
+        rel.must_from_triple("doc:a", "view", "user:u1").with_caveat(
+            "", {"used": 1, "limit": 9}
+        ),
+    ]
+    d, _, _ = run_and_compare(engine, dsnap, oracle, checks)
+    assert list(d) == [True, False, False]
+
+
+def test_out_of_bound_int_falls_back_to_host():
+    rels = [
+        rel.must_from_triple("doc:a", "viewer", "user:u1").with_caveat("quota", {}),
+    ]
+    _, engine, dsnap, oracle = world(SCHEMA_ARITH, rels)
+    # huge value: device must flag host (row bound), not overflow silently
+    big = 2**40
+    checks = [
+        rel.must_from_triple("doc:a", "view", "user:u1").with_caveat(
+            "", {"used": 1, "limit": big}
+        ),
+    ]
+    d, p, ovf = engine.check_batch(dsnap, checks, now_us=NOW)
+    assert not d[0]  # device cannot be definite
+    assert p[0]  # → conditional, host resolves
+    assert oracle.check_relationship(checks[0]) == T
+
+
+SCHEMA_HOSTONLY = """
+caveat complex_one(m map<string>) { m.owner == 'alice' }
+definition user {}
+definition doc {
+    relation viewer: user with complex_one
+    permission view = viewer
+}
+"""
+
+
+def test_host_only_caveat_stays_conditional():
+    plan_schema = compile_schema(parse_schema(SCHEMA_HOSTONLY))
+    plan = build_caveat_plan(plan_schema)
+    cid = plan_schema.caveat_ids["complex_one"]
+    assert plan.host_only[cid]
+    rels = [
+        rel.must_from_triple("doc:a", "viewer", "user:u1").with_caveat(
+            "complex_one", {"m": {"owner": "alice"}}
+        ),
+    ]
+    _, engine, dsnap, oracle = world(SCHEMA_HOSTONLY, rels)
+    checks = [rel.must_from_triple("doc:a", "view", "user:u1")]
+    d, p, _ = engine.check_batch(dsnap, checks, now_us=NOW)
+    assert not d[0] and p[0]  # device defers
+    assert oracle.check_relationship(checks[0]) == T  # host resolves
+
+
+SCHEMA_DOUBLE = """
+caveat score_ok(score double) { score >= 0.5 }
+definition user {}
+definition doc {
+    relation viewer: user with score_ok
+    permission view = viewer
+}
+"""
+
+
+def test_double_comparison_f32_exact():
+    rels = [
+        rel.must_from_triple("doc:a", "viewer", "user:u1").with_caveat("score_ok", {}),
+    ]
+    _, engine, dsnap, oracle = world(SCHEMA_DOUBLE, rels)
+    checks = [
+        rel.must_from_triple("doc:a", "view", "user:u1").with_caveat("", {"score": 0.75}),
+        rel.must_from_triple("doc:a", "view", "user:u1").with_caveat("", {"score": 0.25}),
+        # not exactly representable in f32 → host fallback, not a wrong answer
+        rel.must_from_triple("doc:a", "view", "user:u1").with_caveat("", {"score": 0.1}),
+    ]
+    d, p, _ = engine.check_batch(dsnap, checks, now_us=NOW)
+    assert list(d)[:2] == [True, False]
+    assert not d[2] and p[2]
+    assert oracle.check_relationship(checks[2]) == F
+
+
+SCHEMA_GROUPS = """
+caveat on_call(level int) { level > 3 }
+definition user {}
+definition team {
+    relation member: user | team#member | user with on_call
+}
+definition doc {
+    relation org: team
+    relation reader: user | team#member with on_call
+    permission view = reader + org->member
+}
+"""
+
+
+def test_caveats_on_membership_userset_and_arrow_edges():
+    rels = [
+        # caveated direct membership (ms view)
+        rel.must_from_tuple("team:t1#member", "user:u1").with_caveat("on_call", {}),
+        # nested team, caveated propagation edge (mp view)
+        rel.must_from_tuple("team:t2#member", "team:t1#member").with_caveat(
+            "on_call", {"level": 9}
+        ),
+        # caveated userset grant (us view)
+        rel.must_from_tuple("doc:d1#reader", "team:t2#member").with_caveat(
+            "on_call", {}
+        ),
+        # caveated arrow edge (ar view)
+        rel.must_from_tuple("doc:d2#org", "team:t1").with_caveat("on_call", {"level": 5}),
+        rel.must_from_tuple("team:t1#member", "user:u2"),
+    ]
+    _, engine, dsnap, oracle = world(SCHEMA_GROUPS, rels)
+    checks = [
+        rel.must_from_triple("doc:d1", "view", "user:u1").with_caveat("", {"level": 7}),
+        rel.must_from_triple("doc:d1", "view", "user:u1").with_caveat("", {"level": 1}),
+        rel.must_from_triple("doc:d2", "view", "user:u2").with_caveat("", {"level": 9}),
+        rel.must_from_triple("doc:d2", "view", "user:u2"),
+        rel.must_from_triple("doc:d1", "view", "user:u2").with_caveat("", {"level": 7}),
+    ]
+    run_and_compare(engine, dsnap, oracle, checks, expect_no_fallback=False)
+
+
+def test_randomized_differential_with_caveats():
+    rng = random.Random(42)
+    schema = """
+    caveat lim(v int, cap int) { v < cap }
+    caveat tag_ok(tag string) { tag in ['a', 'b', 'c'] }
+    definition user {}
+    definition group { relation member: user | group#member | user with lim }
+    definition res {
+        relation parent: group
+        relation writer: user | user with tag_ok | group#member
+        relation banned: user
+        permission write = (writer - banned) + parent->member
+    }
+    """
+    users = [f"user:u{i}" for i in range(12)]
+    groups = [f"group:g{i}" for i in range(4)]
+    ress = [f"res:r{i}" for i in range(8)]
+    rels = []
+    for g in groups:
+        for u in rng.sample(users, 4):
+            r = rel.must_from_tuple(f"{g}#member", u)
+            if rng.random() < 0.4:
+                r = r.with_caveat("lim", {"cap": rng.randint(1, 10)} if rng.random() < 0.7 else {})
+            rels.append(r)
+    for g in groups[1:]:
+        rels.append(rel.must_from_tuple(f"{g}#member", f"{groups[0]}#member"))
+    for rs in ress:
+        rels.append(rel.must_from_tuple(f"{rs}#parent", rng.choice(groups)))
+        for u in rng.sample(users, 3):
+            r = rel.must_from_tuple(f"{rs}#writer", u)
+            if rng.random() < 0.5:
+                r = r.with_caveat("tag_ok", {"tag": rng.choice(["a", "x"])} if rng.random() < 0.5 else {})
+            rels.append(r)
+        if rng.random() < 0.5:
+            rels.append(rel.must_from_tuple(f"{rs}#banned", rng.choice(users)))
+    _, engine, dsnap, oracle = world(schema, rels)
+    checks = []
+    for _ in range(64):
+        q = rel.must_from_triple(rng.choice(ress), "write", rng.choice(users))
+        ctx = {}
+        if rng.random() < 0.6:
+            ctx["v"] = rng.randint(0, 10)
+        if rng.random() < 0.6:
+            ctx["tag"] = rng.choice(["a", "b", "x"])
+        if ctx:
+            q = q.with_caveat("", ctx)
+        checks.append(q)
+    run_and_compare(engine, dsnap, oracle, checks, expect_no_fallback=False)
+
+
+def test_encode_contexts_wrong_type_flags_host():
+    cs = compile_schema(parse_schema(SCHEMA_BASIC))
+    plan = build_caveat_plan(cs)
+    strings = dict(plan.base_strings)
+    table = encode_contexts(plan, [{"tier": "not-an-int"}], strings)
+    cid = cs.caveat_ids["tier_at_least"]
+    assert table.host[0, cid]
+    # but the same row is fine for caveats that don't declare `tier`
+    assert not table.host[0, cs.caveat_ids["ip_allowed"]]

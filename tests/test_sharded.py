@@ -117,3 +117,58 @@ def test_edge_sharded_folder_recursion():
     d, p, ovf = eng.check_batch(dsnap, qs, now_us=1_700_000_000_000_000)
     assert list(d) == [True, True, False]
     assert not ovf.any()
+
+
+def test_array_keys_match_host_arrays():
+    # ShardedEngine derives its shard_map specs from
+    # DeviceEngine.ARRAY_COLUMN_KEYS; _host_arrays must emit exactly that
+    # column set or the in_specs pytree desyncs (silent drift hazard)
+    cs = compile_schema(parse_schema(SCHEMA))
+    rels = [rel.must_from_tuple("repo:r#reader", "user:u")]
+    snap = build_snapshot(1, cs, Interner(), rels, epoch_us=1_700_000_000_000_000)
+    eng = DeviceEngine(cs)
+    host = eng._host_arrays(snap)
+    assert set(host) == set(DeviceEngine.ARRAY_COLUMN_KEYS)
+
+
+def test_sharded_check_columns_matches_check_batch():
+    cs, snap, oracle, queries = build_world(seed=3)
+    mesh = make_mesh(4, 2)
+    eng = ShardedEngine(cs, mesh)
+    dsnap = eng.prepare(snap)
+    checks = queries[:48]
+    d0, p0, o0 = eng.check_batch(dsnap, checks, now_us=1_700_000_000_000_000)
+    interner = snap.interner
+    slot = cs.slot_of_name
+    q_res = np.array(
+        [interner.lookup(x.resource_type, x.resource_id) for x in checks], np.int32
+    )
+    q_perm = np.array([slot[x.resource_relation] for x in checks], np.int32)
+    q_subj = np.array(
+        [interner.lookup(x.subject_type, x.subject_id) for x in checks], np.int32
+    )
+    d1, p1, o1 = eng.check_columns(
+        dsnap, q_res, q_perm, q_subj, now_us=1_700_000_000_000_000
+    )
+    assert list(d0) == list(np.asarray(d1))
+    assert list(p0) == list(np.asarray(p1))
+
+
+def test_sharded_check_columns_reflexive_self():
+    cs, snap, oracle, queries = build_world(seed=5)
+    mesh = make_mesh(4, 2)
+    eng = ShardedEngine(cs, mesh)
+    dsnap = eng.prepare(snap)
+    interner = snap.interner
+    slot = cs.slot_of_name
+    # team:t0#member checked against itself → reflexive True
+    t0 = interner.lookup("team", "t0")
+    q_res = np.array([t0], np.int32)
+    q_perm = np.array([slot["member"]], np.int32)
+    q_subj = np.array([t0], np.int32)
+    q_srel = np.array([slot["member"]], np.int32)
+    d, p, o = eng.check_columns(
+        dsnap, q_res, q_perm, q_subj, q_srel=q_srel,
+        now_us=1_700_000_000_000_000,
+    )
+    assert bool(np.asarray(d)[0])
