@@ -2,32 +2,47 @@
 2-hop org→team→repo rewrites, 100k-check batches on one chip.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "checks/sec/chip", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "checks/sec/chip", "vs_baseline": N,
+   "p99_ms": N, "batch": N, "edges": N[, "note": ...]}
 
 ``vs_baseline`` is the fraction of the BASELINE.json north-star target
 (10M checks/sec/chip); the reference itself publishes no numbers
-(BASELINE.md), so the target is the denominator.
+(BASELINE.md), so the target is the denominator.  ``p99_ms`` is the p99
+batch-evaluation latency (north star: p99 < 2 ms, BASELINE.md:22).
 
-Methodology: the graph is materialized once (columnar bulk path), queries
-are lowered to int32 arrays once, and the check is timed in forced-synchronous
-mode with null-program calibration (benchmarks/common.py sync_rate): on
-remote-attached TPUs, block_until_ready does not actually wait until the
-process performs its first device→host fetch, so enqueue-loop timings are
-fantasy; after one fetch every blocked execution is real but pays a fixed
-dispatch round trip, which timing a same-signature null program cancels.  Host-side query lowering is
+Robustness contract (the driver runs this unattended): the parent process
+NEVER imports jax — it orchestrates child subprocesses under bounded
+timeouts.  Attempt 1 runs on the default platform (the real TPU chip);
+if the backend hangs or errors, attempt 2 re-runs degraded on CPU with a
+"note" naming the failure.  If even that fails, a last-resort JSON line
+with value 0 is emitted.  The process always exits 0 with a parseable
+line on stdout.
+
+Methodology (child): the graph is materialized once (columnar bulk path),
+queries are lowered to int32 arrays once, and the check is timed in forced-
+synchronous mode with null-program calibration (benchmarks/common.py
+sync_rate): on remote-attached TPUs, block_until_ready does not actually
+wait until the process performs its first device→host fetch, so
+enqueue-loop timings are fantasy; after one fetch every blocked execution
+is real but pays a fixed dispatch round trip, which timing a
+same-signature null program cancels.  Host-side query lowering is
 excluded, matching how the reference's client-side proto building is not
 part of SpiceDB's evaluation numbers.
 """
 
 import json
-import random
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
+TPU_CHILD_TIMEOUT_S = int(os.environ.get("GOCHUGARU_BENCH_TPU_TIMEOUT", "300"))
+CPU_CHILD_TIMEOUT_S = int(os.environ.get("GOCHUGARU_BENCH_CPU_TIMEOUT", "180"))
 
 
 def build_world(n_repos=10_000, n_users=1_000, n_teams=100, n_orgs=10, seed=11):
+    import numpy as np
+
     from gochugaru_tpu import rel  # noqa: F401
     from gochugaru_tpu.schema import compile_schema, parse_schema
     from gochugaru_tpu.store.interner import Interner
@@ -98,17 +113,19 @@ def build_world(n_repos=10_000, n_users=1_000, n_teams=100, n_orgs=10, seed=11):
     return cs, snap, users, repos, slot
 
 
-def main():
+def run_bench(batch, world_kw, note=None):
+    """The real measurement; runs in a child process.  Returns the result
+    dict that becomes the driver-facing JSON line."""
+    import numpy as np
     import jax
     import jax.numpy as jnp
 
     from gochugaru_tpu.engine.device import DeviceEngine
 
-    # batch sized to the largest program the remote-attached platform
-    # compiles promptly; the null-program calibration (sync_rate) subtracts
-    # the fixed dispatch cost
-    batch = 100_000
-    cs, snap, users, repos, slot = build_world()
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.common import sync_rate
+
+    cs, snap, users, repos, slot = build_world(**world_kw)
     engine = DeviceEngine(cs)
     dsnap = engine.prepare(snap)
 
@@ -142,10 +159,6 @@ def main():
         {k: jnp.asarray(v) for k, v in qctx.items()},
     )
 
-    import os
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from benchmarks.common import sync_rate
-
     # correctness signal first (one real fetch; also flips the platform
     # into synchronous execution for honest timing)
     d, p, ovf = jax.device_get(engine._fn(*args))
@@ -159,22 +172,134 @@ def main():
     )
     rate, step, overhead = sync_rate(engine._fn, null_fn, args, B)
 
-    print(
-        json.dumps(
-            {
-                "metric": "rbac_2hop_bulk_check_throughput",
-                "value": round(rate, 1),
-                "unit": "checks/sec/chip",
-                "vs_baseline": round(rate / 10_000_000, 4),
-            }
-        )
-    )
+    # p99 batch-evaluation latency: individually blocked executions of the
+    # real program, fixed dispatch round trip subtracted (north star is
+    # evaluation latency, not tunnel latency)
+    ts = []
+    for _ in range(30):
+        t0 = time.perf_counter()
+        jax.block_until_ready(engine._fn(*args))
+        ts.append(time.perf_counter() - t0)
+    lat = np.maximum(np.asarray(ts) - overhead, 0.0) * 1000.0
+    p99_ms = float(np.percentile(lat, 99))
+
+    result = {
+        "metric": "rbac_2hop_bulk_check_throughput",
+        "value": round(rate, 1),
+        "unit": "checks/sec/chip",
+        "vs_baseline": round(rate / 10_000_000, 4),
+        "p99_ms": round(p99_ms, 3),
+        "batch": int(B),
+        "edges": int(snap.num_edges),
+        "platform": jax.default_backend(),
+    }
+    if note:
+        result["note"] = note
     print(
         f"# batch={B} step={step*1000:.2f}ms dispatch_overhead={overhead*1000:.1f}ms"
-        f" granted={int(d.sum())} overflow={int(ovf.sum())} edges={snap.num_edges}",
+        f" p99={p99_ms:.2f}ms granted={int(d.sum())} overflow={int(ovf.sum())}"
+        f" edges={snap.num_edges}",
         file=sys.stderr,
     )
+    return result
+
+
+def child_main(mode: str, note: str | None) -> None:
+    if mode == "cpu":
+        from gochugaru_tpu.utils.platform import force_cpu_platform
+
+        force_cpu_platform()
+        result = run_bench(
+            batch=32_768,
+            world_kw=dict(n_repos=2_000, n_users=500, n_teams=50, n_orgs=5),
+            note=note or "degraded: cpu fallback",
+        )
+    else:
+        result = run_bench(batch=100_000, world_kw={}, note=note)
+    print(json.dumps(result))
+
+
+def _run_child(mode: str, timeout_s: int, note: str | None):
+    """Run one child attempt; returns (json_line|None, failure_reason)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--child", mode]
+    if note:
+        cmd.append(note)
+    try:
+        r = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"{mode} attempt timed out after {timeout_s}s"
+    if r.stderr:
+        sys.stderr.write(r.stderr)
+    for line in reversed(r.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+                if "metric" in parsed and "value" in parsed:
+                    return line, None
+            except json.JSONDecodeError:
+                continue
+    err = (r.stderr or "").strip().splitlines()
+    tail = err[-1][:200] if err else f"rc={r.returncode}, no JSON line"
+    return None, f"{mode} attempt failed: {tail}"
+
+
+PROBE_TIMEOUT_S = int(os.environ.get("GOCHUGARU_BENCH_PROBE_TIMEOUT", "75"))
+
+
+def _probe_backend() -> str | None:
+    """Cheap bounded liveness probe of the default (TPU) backend; returns
+    a failure reason, or None when the backend is usable."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(len(jax.devices()), jax.default_backend())"],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        return f"backend probe timed out after {PROBE_TIMEOUT_S}s"
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()
+        return f"backend probe failed: {tail[-1][:200] if tail else r.returncode}"
+    return None
+
+
+def main() -> int:
+    # Parent orchestrator: no jax import here, so a hung TPU backend can
+    # never keep the driver-facing process from printing a parseable line.
+    reason = _probe_backend()
+    if reason is None:
+        line, reason = _run_child("tpu", TPU_CHILD_TIMEOUT_S, None)
+    else:
+        line = None
+        sys.stderr.write(f"# {reason}\n")
+    if line is None:
+        sys.stderr.write(f"# {reason}; retrying degraded on cpu\n")
+        line, reason2 = _run_child(
+            "cpu", CPU_CHILD_TIMEOUT_S, f"degraded cpu run ({reason})"
+        )
+        if line is None:
+            line = json.dumps(
+                {
+                    "metric": "rbac_2hop_bulk_check_throughput",
+                    "value": 0.0,
+                    "unit": "checks/sec/chip",
+                    "vs_baseline": 0.0,
+                    "p99_ms": 0.0,
+                    "batch": 0,
+                    "edges": 0,
+                    "platform": "none",
+                    "note": f"all attempts failed: {reason}; {reason2}",
+                }
+            )
+    print(line)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        child_main(sys.argv[2], sys.argv[3] if len(sys.argv) > 3 else None)
+    else:
+        sys.exit(main())

@@ -394,6 +394,13 @@ class CelProgram:
                         q = abs(a) // abs(b)
                         return q if (a >= 0) == (b >= 0) else -q
                     return a / b
+                # CEL '%' is the truncated remainder (sign of the dividend),
+                # not Python's floored remainder — must match the device
+                # lowering (device.py emit_ar) for negative operands
+                if isinstance(a, int) and isinstance(b, int):
+                    q = abs(a) // abs(b)
+                    q = q if (a >= 0) == (b >= 0) else -q
+                    return a - q * b
                 return a % b
             except (TypeError, ZeroDivisionError) as e:
                 raise CelCompileError(f"arithmetic error in caveat {self.name!r}: {e}") from e

@@ -113,59 +113,63 @@ def _base_type(ptype: str) -> str:
 # ---------------------------------------------------------------------------
 
 
+def _int_extent(node, types: Dict[str, str], bound: int, state: Dict[str, bool]) -> int:
+    """Max |value| of an int-typed node with every int context value bounded
+    by ``bound`` in magnitude; 0 for non-value nodes.  Sets ``state['ovf']``
+    when any int arithmetic node can exceed i32."""
+    op = node[0]
+    if op == "lit":
+        v = node[1]
+        return abs(v) if isinstance(v, int) and not isinstance(v, bool) else 0
+    if op == "var":
+        return bound if types.get(node[1]) == "int" else 0
+    if op == "neg":
+        return _int_extent(node[1], types, bound, state)
+    if op == "arith":
+        a = _int_extent(node[2], types, bound, state)
+        b = _int_extent(node[3], types, bound, state)
+        o = node[1]
+        if o in ("+", "-"):
+            m = a + b
+        elif o == "*":
+            m = a * b
+        elif o == "/":
+            m = a  # |a / b| ≤ |a| for truncated division
+        else:  # %: truncated remainder has |r| < |b| and |r| ≤ |a|
+            m = min(a, b)
+        if m >= I32_MAX:
+            state["ovf"] = True
+        return m
+    if op == "cond":
+        _int_extent(node[1], types, bound, state)
+        return max(
+            _int_extent(node[2], types, bound, state),
+            _int_extent(node[3], types, bound, state),
+        )
+    if op in ("not",):
+        _int_extent(node[1], types, bound, state)
+        return 0
+    if op in ("or", "and", "in"):
+        _int_extent(node[1], types, bound, state)
+        _int_extent(node[2], types, bound, state)
+        return 0
+    if op == "cmp":
+        _int_extent(node[2], types, bound, state)
+        _int_extent(node[3], types, bound, state)
+        return 0
+    if op == "list":
+        for it in node[1]:
+            _int_extent(it, types, bound, state)
+        return 0
+    return 0
+
+
 def _arith_safe(ast, types: Dict[str, str], bound: int) -> bool:
     """True if no int-typed arithmetic node can exceed i32 with every int
     context value bounded by ``bound`` in magnitude."""
-
-    ok = True
-
-    def walk(node) -> int:
-        """Max |value| of an int-typed node; 0 for non-value nodes."""
-        nonlocal ok
-        op = node[0]
-        if op == "lit":
-            v = node[1]
-            return abs(v) if isinstance(v, int) and not isinstance(v, bool) else 0
-        if op == "var":
-            return bound if types.get(node[1]) == "int" else 0
-        if op == "neg":
-            return walk(node[1])
-        if op == "arith":
-            a, b = walk(node[2]), walk(node[3])
-            o = node[1]
-            if o in ("+", "-"):
-                m = a + b
-            elif o == "*":
-                m = a * b
-            elif o == "/":
-                m = a
-            else:  # %
-                m = min(a, b)
-            if m >= I32_MAX:
-                ok = False
-            return m
-        if op == "cond":
-            walk(node[1])
-            return max(walk(node[2]), walk(node[3]))
-        if op in ("not",):
-            walk(node[1])
-            return 0
-        if op in ("or", "and", "in"):
-            walk(node[1]); walk(node[2])
-            return 0
-        if op == "cmp":
-            walk(node[2]); walk(node[3])
-            return 0
-        if op == "list":
-            for it in node[1]:
-                walk(it)
-            return 0
-        if op == "member":
-            return 0
-        return 0
-
-    walk(ast)
-    return ok
+    state = {"ovf": False}
+    _int_extent(ast, types, bound, state)
+    return not state["ovf"]
 
 
 # ---------------------------------------------------------------------------
@@ -193,6 +197,13 @@ def _lower_program(
         if s not in strings:
             strings[s] = len(strings) + 1
         return strings[s]
+
+    # int-typed subtrees that get promoted to f32 in a double comparison;
+    # build_caveat_plan must prove their interval max ≤ F32_EXACT_INT under
+    # the chosen int bound, or evict the caveat to the host (compound int
+    # expressions can exceed 2^24 while still passing the i32 overflow
+    # check — e.g. 'a + 99999999 > lim' rounds in f32)
+    promoted_int: List[Any] = []
 
     # Each lowered node is (kind, emit).  For kind 'bool', emit(vi,vf,pr)
     # returns tri; for value kinds it returns (value, known).
@@ -301,8 +312,10 @@ def _lower_program(
                     raise _HostOnly("ordered comparison on strings")
             promote = "double" if "double" in (ka, kb) else ka
             if promote == "double":
-                _check_promotable(node[2], ka)
-                _check_promotable(node[3], kb)
+                if ka == "int":
+                    promoted_int.append(node[2])
+                if kb == "int":
+                    promoted_int.append(node[3])
 
             def emit_cmp(vi, vf, pr, o=o, promote=promote):
                 av, akn = ea(vi, vf, pr)
@@ -364,9 +377,11 @@ def _lower_program(
             if node[2][0] != "list":
                 raise _HostOnly("'in' target not a list literal")
             elems = [lower(it) for it in node[2][1]]
-            for ke, _ in elems:
+            for it, (ke, _) in zip(node[2][1], elems):
                 if ke != ka and not (ka == "double" and ke == "int"):
                     raise _HostOnly("'in' list element type mismatch")
+                if ka == "double" and ke == "int":
+                    promoted_int.append(it)
 
             def emit_in(vi, vf, pr):
                 av, akn = ea(vi, vf, pr)
@@ -382,15 +397,6 @@ def _lower_program(
             return "bool", emit_in
         raise _HostOnly(f"construct {op!r}")
 
-    def _check_promotable(node, kind: str) -> None:
-        """Int literals promoted to f32 must be exactly representable."""
-        if kind != "int":
-            return
-        if node[0] == "lit" and abs(node[1]) > F32_EXACT_INT:
-            raise _HostOnly("int literal not f32-exact in double comparison")
-        # int *vars* are covered by the bound analysis (int_bound ≤ 2^20
-        # whenever the program mixes doubles, enforced in build).
-
     kind, emit = lower(prog.ast)
     if kind != "bool":
         raise _HostOnly("caveat does not evaluate to bool")
@@ -399,7 +405,7 @@ def _lower_program(
         shape = vi.shape[:-1]
         return jnp.broadcast_to(emit(vi, vf, pr), shape).astype(jnp.int32)
 
-    return run, types
+    return run, types, promoted_int
 
 
 # ---------------------------------------------------------------------------
@@ -442,23 +448,28 @@ def build_caveat_plan(compiled: CompiledSchema) -> CaveatDevicePlan:
         cid = compiled.caveat_ids[name]
         try:
             prog = compile_cel(name, decl.params, decl.expression)
-            fn, types = _lower_program(prog, slot_of, base_strings)
+            fn, types, promoted = _lower_program(prog, slot_of, base_strings)
         except (_HostOnly, CelCompileError):
             host_only[cid] = True
             continue
-        # pick the largest int bound that provably cannot overflow i32
-        has_double = "double" in types.values() or _ast_has_double_literal(prog.ast)
-        chosen = None
-        for b in _INT_BOUNDS:
-            if has_double and b > 2**20:
-                continue  # ints beyond 2^20 lose headroom in f32 compares
-            if _arith_safe(prog.ast, types, b):
-                chosen = b
-                break
+
+        # pick the largest int bound under which (a) no int arithmetic can
+        # overflow i32 and (b) every int subtree promoted to f32 in a double
+        # comparison stays within F32_EXACT_INT, so the promotion is exact
+        def bound_ok(b: int) -> bool:
+            if not _arith_safe(prog.ast, types, b):
+                return False
+            st = {"ovf": False}
+            return all(
+                _int_extent(sub, types, b, st) <= F32_EXACT_INT
+                for sub in promoted
+            )
+
+        chosen = next((b for b in _INT_BOUNDS if bound_ok(b)), None)
         if chosen is None:
             host_only[cid] = True
             continue
-        if not _ast_has_arith(prog.ast) and not has_double:
+        if not _ast_has_arith(prog.ast) and not promoted:
             chosen = I32_MAX - 1
         int_bound[cid] = chosen
         programs[cid] = fn
@@ -486,16 +497,6 @@ def _ast_has_arith(ast) -> bool:
         for c in ast[1:]
         if isinstance(c, tuple)
     ) or (ast[0] == "list" and any(_ast_has_arith(it) for it in ast[1]))
-
-
-def _ast_has_double_literal(ast) -> bool:
-    if ast[0] == "lit" and isinstance(ast[1], float):
-        return True
-    return any(
-        _ast_has_double_literal(c)
-        for c in ast[1:]
-        if isinstance(c, tuple)
-    ) or (ast[0] == "list" and any(_ast_has_double_literal(it) for it in ast[1]))
 
 
 # ---------------------------------------------------------------------------

@@ -941,17 +941,12 @@ class DeviceEngine:
         snap = dsnap.snapshot
         B = q_res.shape[0]
         BP = _ceil_pow2(B, self.config.batch_bucket_min)
-        if q_srel is None:
-            q_srel = np.full(B, -1, np.int32)
-        if q_wc is None:
-            q_wc = np.full(B, -1, np.int32)
-        if q_ctx is None:
-            q_ctx = np.full(B, -1, np.int32)
-        qctx = self._encode_query_contexts(list(qctx_rows or []), dsnap.strings)
-        # reflexive userset identity (a userset is a member of itself),
-        # same as _lower_queries' q_self: slots are shared between q_perm
-        # and q_srel, and equal interned nodes mean equal (type, id)
-        q_self = (q_res == q_subj) & (q_srel >= 0) & (q_perm == q_srel)
+        queries, qctx = self._columns_preamble(
+            dsnap, q_res, q_perm, q_subj, q_srel, q_wc, q_ctx, qctx_rows
+        )
+        q_res, q_perm, q_subj = queries["q_res"], queries["q_perm"], queries["q_subj"]
+        q_srel, q_wc, q_ctx = queries["q_srel"], queries["q_wc"], queries["q_ctx"]
+        q_self = queries["q_self"]
 
         subj_key = np.stack([q_subj, q_srel, q_wc, q_ctx], axis=1)
         uniq, q_row = np.unique(subj_key, axis=0, return_inverse=True)

@@ -10,12 +10,17 @@ callers fall back to the pure-numpy/python paths with identical results.
 
 The library is compiled on first use with g++ (the image has no pybind11;
 ctypes needs only a .so), cached next to this file, and rebuilt whenever
-``ingest.cpp`` is newer than the cached binary.
+the cached binary was not built from the current ``ingest.cpp`` — the
+source hash is stored in a sidecar stamp file, so a stale or foreign
+binary is never silently loaded (mtimes are useless for this: a fresh
+checkout gives source and binary the same timestamp).  The binary itself
+is never committed to version control.
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -24,13 +29,22 @@ from typing import Optional
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "ingest.cpp")
 _SO = os.path.join(_HERE, "libgochugaru_ingest.so")
+_STAMP = _SO + ".srchash"
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _build() -> bool:
+def _src_hash() -> Optional[str]:
+    try:
+        with open(_SRC, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()
+    except OSError:
+        return None
+
+
+def _build(src_hash: str) -> bool:
     cmds = [
         ["g++", "-O3", "-shared", "-fPIC", "-fopenmp", "-std=c++17",
          _SRC, "-o", _SO],
@@ -41,6 +55,8 @@ def _build() -> bool:
         try:
             r = subprocess.run(cmd, capture_output=True, timeout=120)
             if r.returncode == 0:
+                with open(_STAMP, "w") as f:
+                    f.write(src_hash)
                 return True
         except (OSError, subprocess.TimeoutExpired):
             return False
@@ -54,11 +70,17 @@ def _load() -> Optional[ctypes.CDLL]:
             return _lib
         _tried = True
         try:
-            need_build = not os.path.exists(_SO) or (
-                os.path.exists(_SRC)
-                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
-            )
-            if need_build and not _build():
+            want = _src_hash()
+            if want is None:
+                return None
+            have = None
+            if os.path.exists(_SO) and os.path.exists(_STAMP):
+                try:
+                    with open(_STAMP) as f:
+                        have = f.read().strip()
+                except OSError:
+                    have = None
+            if have != want and not _build(want):
                 return None
             lib = ctypes.CDLL(_SO)
         except OSError:

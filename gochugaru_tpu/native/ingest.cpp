@@ -199,7 +199,8 @@ void gi_lexsort4(const int32_t* a, const int32_t* b, const int32_t* c,
   }
   auto cmp = [&](int64_t x, int64_t y) {
     if (hi[x] != hi[y]) return hi[x] < hi[y];
-    return lo[x] < lo[y];
+    if (lo[x] != lo[y]) return lo[x] < lo[y];
+    return x < y;  // stability: match np.lexsort on duplicate keys
   };
 #if defined(_OPENMP)
   __gnu_parallel::sort(out, out + n, cmp);

@@ -144,12 +144,15 @@ class ShardedEngine(DeviceEngine):
         queries: Dict[str, np.ndarray],
         qctx: Dict[str, np.ndarray],
         now_us: Optional[int],
+        fetch: bool = True,
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Partition query columns across the data axis, compute per-shard
         unique (subject, context) closure rows, and dispatch the
         shard_mapped check.  ``queries`` holds length-B columns (q_res,
         q_perm, q_subj, q_srel, q_wc, q_ctx, q_self); q_row is derived
-        here per shard."""
+        here per shard.  With ``fetch=False`` the raw padded sharded
+        device outputs (length BP ≥ B) are returned for pipelined
+        dispatch, mirroring DeviceEngine.check_columns."""
         snap = dsnap.snapshot
         D = self.data_size
         B = queries["q_res"].shape[0]
@@ -203,6 +206,8 @@ class ShardedEngine(DeviceEngine):
             put(q["q_ctx"]),
             {k: jax.device_put(v, rep) for k, v in qctx.items()},
         )
+        if not fetch:
+            return d, p, ovf
         d, p, ovf = jax.device_get((d, p, ovf))
         return d[:B], p[:B], ovf[:B]
 
@@ -231,26 +236,12 @@ class ShardedEngine(DeviceEngine):
         q_ctx: Optional[np.ndarray] = None,
         qctx_rows=None,
         now_us: Optional[int] = None,
-        fetch: bool = True,  # sharded dispatch always fetches (one get)
+        fetch: bool = True,
     ):
         """Columnar bulk check with the sharded layout (the base-class fast
         path assumes an unsharded q_row/uniq table, which would be wrong
         under shard_map — see _dispatch_columns)."""
-        B = q_res.shape[0]
-        if q_srel is None:
-            q_srel = np.full(B, -1, np.int32)
-        if q_wc is None:
-            q_wc = np.full(B, -1, np.int32)
-        if q_ctx is None:
-            q_ctx = np.full(B, -1, np.int32)
-        qctx = self._encode_query_contexts(list(qctx_rows or []), dsnap.strings)
-        queries = {
-            "q_res": q_res.astype(np.int32),
-            "q_perm": q_perm.astype(np.int32),
-            "q_subj": q_subj.astype(np.int32),
-            "q_srel": q_srel.astype(np.int32),
-            "q_wc": q_wc.astype(np.int32),
-            "q_ctx": q_ctx.astype(np.int32),
-            "q_self": (q_res == q_subj) & (q_srel >= 0) & (q_perm == q_srel),
-        }
-        return self._dispatch_columns(dsnap, queries, qctx, now_us)
+        queries, qctx = self._columns_preamble(
+            dsnap, q_res, q_perm, q_subj, q_srel, q_wc, q_ctx, qctx_rows
+        )
+        return self._dispatch_columns(dsnap, queries, qctx, now_us, fetch=fetch)

@@ -1,0 +1,37 @@
+"""Platform forcing for tests / dryruns / degraded benches.
+
+The environment's sitecustomize pins JAX onto the one-chip remote TPU
+tunnel (JAX_PLATFORMS=axon) and pre-imports jax, so overriding the
+platform needs both the env var (for subprocesses) and a live
+``jax.config`` update (for this process).  One definition here so the
+test conftest, the driver's multichip dryrun, and the bench's degraded
+path cannot drift.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_cpu_platform(n_devices: int | None = None) -> None:
+    """Force JAX onto the CPU backend, optionally with ``n_devices``
+    virtual devices (replacing any pre-set device-count flag, which may
+    carry a different count)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        flag = f"--xla_force_host_platform_device_count={n_devices}"
+        if "xla_force_host_platform_device_count" in flags:
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", flag, flags
+            )
+        else:
+            flags = (flags + " " + flag).strip()
+        os.environ["XLA_FLAGS"] = flags
+    # the sitecustomize pre-imports jax, so the env var alone is not
+    # honored — force the platform through the live config too (the
+    # backend itself initializes lazily, so XLA_FLAGS still takes effect)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

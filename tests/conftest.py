@@ -10,15 +10,6 @@ deliberately run the suite against the real chip."""
 import os
 
 if os.environ.get("GOCHUGARU_TEST_TPU") != "1":
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8"
-        ).strip()
-    # the axon sitecustomize pre-imports jax, so the env var alone is not
-    # honored — force the platform through the live config too (the backend
-    # itself initializes lazily, so XLA_FLAGS still takes effect)
-    import jax
+    from gochugaru_tpu.utils.platform import force_cpu_platform
 
-    jax.config.update("jax_platforms", "cpu")
+    force_cpu_platform(8)

@@ -321,3 +321,92 @@ def test_encode_contexts_wrong_type_flags_host():
     assert table.host[0, cid]
     # but the same row is fine for caveats that don't declare `tier`
     assert not table.host[0, cs.caveat_ids["ip_allowed"]]
+
+
+# ---------------------------------------------------------------------------
+# review regressions: f32 promotion exactness + CEL '%' semantics
+# ---------------------------------------------------------------------------
+
+SCHEMA_F32 = """
+caveat f32risk(a int, lim double) { a + 99999999 > lim }
+caveat f32safe(a int, lim double) { a > lim }
+caveat inrisk(lim double) { lim in [100000001, 5.0] }
+definition user {}
+definition doc {
+    relation viewer: user with f32risk | user with f32safe | user with inrisk
+    permission view = viewer
+}
+"""
+
+
+def test_compound_int_in_double_compare_is_host_only():
+    """A compound int expression promoted to f32 can exceed 2^24 while
+    passing the i32 overflow check; such caveats must be evicted to the
+    host, never evaluated inexactly on device."""
+    cs = compile_schema(parse_schema(SCHEMA_F32))
+    plan = build_caveat_plan(cs)
+    assert plan.host_only[cs.caveat_ids["f32risk"]]
+    # a big int literal inside an 'in' list with a double needle likewise
+    assert plan.host_only[cs.caveat_ids["inrisk"]]
+    # but a bare-var double compare stays on device with a bounded range
+    cid = cs.caveat_ids["f32safe"]
+    assert not plan.host_only[cid]
+    assert plan.int_bound[cid] <= 2**24
+
+
+def test_f32risk_falls_back_not_wrong_definite():
+    """The advisor's concrete miscompare: a=2, lim=1e8 → 100000001 > 1e8
+    is TRUE exactly but FALSE after f32 rounding.  The device must emit
+    possible-without-definite (host fallback), not a wrong definite."""
+    rels = [
+        rel.must_from_triple("doc:a", "viewer", "user:u1").with_caveat(
+            "f32risk", {"lim": 1.0e8}
+        ),
+    ]
+    _, engine, dsnap, oracle = world(SCHEMA_F32, rels)
+    q = rel.must_from_triple("doc:a", "view", "user:u1").with_caveat("", {"a": 2})
+    assert oracle.check_relationship(q) == T
+    d, p, _ = engine.check_batch(dsnap, [q], now_us=NOW)
+    assert not bool(d[0]) and bool(p[0])  # → client resolves on host → True
+
+
+SCHEMA_MOD = """
+caveat modc(a int) { a % 3 == 2 }
+caveat modn(a int, b int) { a % b == -1 }
+definition user {}
+definition doc {
+    relation viewer: user with modc | user with modn
+    permission view = viewer
+}
+"""
+
+
+def test_modulo_truncates_toward_zero_host_and_device_agree():
+    """CEL '%' is the truncated remainder (sign of the dividend).  For
+    a=-7: -7 % 3 == -1, so 'a % 3 == 2' is FALSE — Python's floored '%'
+    would say 2 (TRUE).  Host oracle and device must agree on CEL
+    semantics."""
+    prog = compile_cel("modc", {"a": "int"}, "a % 3 == 2")
+    assert prog.evaluate({"a": -7}) is False
+    assert prog.evaluate({"a": 5}) is True
+    progn = compile_cel("modn", {"a": "int", "b": "int"}, "a % b == -1")
+    assert progn.evaluate({"a": -7, "b": 3}) is True  # truncated: r = -1
+    assert progn.evaluate({"a": 7, "b": -3}) is False  # truncated: r = 1
+
+    rels = [
+        rel.must_from_triple("doc:a", "viewer", "user:u1").with_caveat("modc", {}),
+        rel.must_from_triple("doc:b", "viewer", "user:u1").with_caveat("modn", {}),
+    ]
+    _, engine, dsnap, oracle = world(SCHEMA_MOD, rels)
+    checks = [
+        rel.must_from_triple("doc:a", "view", "user:u1").with_caveat("", {"a": -7}),
+        rel.must_from_triple("doc:a", "view", "user:u1").with_caveat("", {"a": 5}),
+        rel.must_from_triple("doc:b", "view", "user:u1").with_caveat(
+            "", {"a": -7, "b": 3}
+        ),
+        rel.must_from_triple("doc:b", "view", "user:u1").with_caveat(
+            "", {"a": 7, "b": -3}
+        ),
+    ]
+    d, p, _ = run_and_compare(engine, dsnap, oracle, checks)
+    assert list(d) == [False, True, True, False]
