@@ -31,7 +31,7 @@ import numpy as np
 from . import consistency as _consistency
 from .consistency import OVERLAP_KEY, Strategy
 from .engine.device import DeviceEngine, DeviceSnapshot
-from .engine.oracle import Oracle, T, U
+from .engine.oracle import Oracle, SnapshotOracle, T, U
 from .engine.plan import EngineConfig
 from .rel.filter import Filter, PreconditionedFilter
 from .rel.relationship import Relationship, RelationshipLike, as_relationship
@@ -138,7 +138,11 @@ class Client:
 
     # -- engine / oracle plumbing ----------------------------------------
     def _engine_for(self, snap: Snapshot) -> Optional[DeviceEngine]:
-        if not self._use_device or snap.compiled.has_permission_usersets:
+        """Permission-valued userset subjects no longer evict the whole
+        schema: the engine marks grants through them possible-not-definite
+        (us_perm / pus leaf flags), so only the affected queries fall back
+        to the host (checks.fallback_conditional)."""
+        if not self._use_device:
             return None
         with self._lock:
             if self._engine is None or self._engine_schema is not snap.compiled:
@@ -158,12 +162,14 @@ class Client:
             return ds
 
     def _oracle_for(self, snap: Snapshot) -> Oracle:
+        """O(1)-construction fallback oracle: SnapshotOracle binary-searches
+        the snapshot's sorted columns lazily, so the first conditional or
+        overflowed check costs O(log E), not an O(E) Python prebuild."""
         with self._lock:
             o = self._oracle_cache.get(snap.revision)
             if o is None:
-                o = Oracle(
-                    snap.compiled,
-                    snap.iter_relationships(None, None),
+                o = SnapshotOracle(
+                    snap,
                     {
                         name: self._store.caveat_program(name)
                         for name in snap.compiled.schema.caveats
@@ -424,15 +430,31 @@ class Client:
     ) -> Iterator[str]:
         """Stream resource IDs the subject can access.
         ``permission`` = "type#perm", ``subject`` = "type:id[#rel]"
-        (client/client.go:501-552)."""
+        (client/client.go:501-552).
+
+        Device path: reverse candidate expansion + one batched forward
+        check (engine/lookup.py); host-oracle scan only for schemas the
+        device can't evaluate."""
         self._check_overlap(ctx)
         subj_type, subj_id, subj_rel = parse_object_set(subject)
         obj_type, obj_rel = parse_typed_relation(permission)
         snap = self._store.snapshot_for(cs)
-        oracle = self._oracle_for(snap)
-        for rid in oracle.lookup_resources(
-            obj_type, obj_rel, subj_type, subj_id, subj_rel
-        ):
+        engine = self._engine_for(snap)
+        if engine is not None:
+            from .engine.lookup import lookup_resources_device
+
+            self._metrics.inc("lookups.resources_device")
+            ids = lookup_resources_device(
+                engine, self._dsnap_for(engine, snap),
+                obj_type, obj_rel, subj_type, subj_id, subj_rel,
+                oracle_factory=lambda: self._oracle_for(snap),
+            )
+        else:
+            self._metrics.inc("lookups.resources_oracle")
+            ids = self._oracle_for(snap).lookup_resources(
+                obj_type, obj_rel, subj_type, subj_id, subj_rel
+            )
+        for rid in ids:
             err = ctx.err()
             if err is not None:
                 raise err
@@ -443,15 +465,31 @@ class Client:
     ) -> Iterator[str]:
         """Stream subject IDs holding the permission on the resource.
         ``resource`` = "type:id", ``subject`` = "type[#rel]"
-        (client/client.go:554-599)."""
+        (client/client.go:554-599).
+
+        Device path mirrors lookup_resources: forward arrow/membership
+        expansion bounds the candidates, one batched device check
+        filters them exactly."""
         self._check_overlap(ctx)
         res_type, res_id, _ = parse_object_set(resource)
         subj_type, _, subj_rel = subject.partition("#")
         snap = self._store.snapshot_for(cs)
-        oracle = self._oracle_for(snap)
-        for sid in oracle.lookup_subjects(
-            res_type, res_id, permission, subj_type, subj_rel
-        ):
+        engine = self._engine_for(snap)
+        if engine is not None:
+            from .engine.lookup import lookup_subjects_device
+
+            self._metrics.inc("lookups.subjects_device")
+            ids = lookup_subjects_device(
+                engine, self._dsnap_for(engine, snap),
+                res_type, res_id, permission, subj_type, subj_rel,
+                oracle_factory=lambda: self._oracle_for(snap),
+            )
+        else:
+            self._metrics.inc("lookups.subjects_oracle")
+            ids = self._oracle_for(snap).lookup_subjects(
+                res_type, res_id, permission, subj_type, subj_rel
+            )
+        for sid in ids:
             err = ctx.err()
             if err is not None:
                 raise err

@@ -433,6 +433,18 @@ def _query_one(
         in_p = jax.vmap(
             lambda s, r: _lex_contains2(my_cp_n, my_cp_r, s, r)
         )(us_subj[idxc], us_srel[idxc])
+        if plan.has_permission_usersets:
+            # permission-valued usersets: membership is the permission
+            # fixpoint the device doesn't run — the grant is possible
+            # (→ per-query host resolution), never device-definite.  Same
+            # for relation usersets whose membership may be extended
+            # through a permission chain (the static pus pair set).
+            permf = arrs["us_perm"][idxc] != 0
+            in_pus = jax.vmap(
+                lambda s, r: _lex_contains2(arrs["pus_n"], arrs["pus_r"], s, r)
+            )(us_subj[idxc], us_srel[idxc])
+            in_d = in_d & ~permf
+            in_p = in_p | in_pus | permf
         d |= jnp.any(valid & in_d & _gate(
             us_cav[idxc], us_ctx[idxc], us_exp[idxc], now, "d", q_ctx, tri, tables
         ))
@@ -624,7 +636,7 @@ class DeviceEngine:
     ARRAY_COLUMN_KEYS = (
         "e_rel", "e_res", "e_subj", "e_srel1", "e_caveat", "e_ctx", "e_exp",
         "us_rel", "us_res", "us_subj", "us_srel", "us_caveat", "us_ctx",
-        "us_exp",
+        "us_exp", "us_perm", "pus_n", "pus_r",
         "ms_subj", "ms_res", "ms_rel", "ms_caveat", "ms_ctx", "ms_exp",
         "mp_subj", "mp_srel", "mp_res", "mp_rel", "mp_caveat", "mp_ctx",
         "mp_exp",
@@ -657,6 +669,9 @@ class DeviceEngine:
             "us_caveat": _pad_payload(snap.us_caveat, US),
             "us_ctx": _pad_payload(snap.us_ctx, US, -1),
             "us_exp": _pad_payload(snap.us_exp, US),
+            "us_perm": _pad_payload(snap.us_perm, US),
+            "pus_n": _pad_sorted(snap.pus_n, _ceil_pow2(snap.pus_n.shape[0])),
+            "pus_r": _pad_sorted(snap.pus_r, _ceil_pow2(snap.pus_n.shape[0])),
             "ms_subj": _pad_sorted(snap.ms_subj, MS),
             "ms_res": _pad_payload(snap.ms_res, MS, -1),
             "ms_rel": _pad_payload(snap.ms_rel, MS, -1),

@@ -1,0 +1,481 @@
+"""Device-backed LookupResources / LookupSubjects.
+
+The reference streams these from the server (client/client.go:508-552,
+561-599).  Round 1 ran them as O(candidate-objects × recursive Python
+check) host loops; this module is the scalable replacement, a two-stage
+pipeline (SURVEY.md §7.7 "lookups as reverse-BFS on transposed
+adjacency"):
+
+1. **Reverse candidate expansion (host, vectorized).**  Transposed
+   sorted views — all edges keyed by (subject, subject_relation), arrow
+   edges keyed by child, plus resource-keyed views — are built lazily
+   once per Snapshot.  A worklist over subject-occurrence keys expands a
+   **provable superset** of the answer with numpy ``searchsorted`` range
+   scans: every grant needs at least one positive edge path from
+   resource to subject through the rewrite graph, so reverse
+   reachability over {direct-grant edges ∪ arrows ∪ userset membership ∪
+   permission-valued userset chains} (ignoring caveat/expiry gates,
+   which only shrink results) covers union/intersection/exclusion/
+   arrow/wildcard/self-identity semantics.
+
+2. **Exact forward filter (device).**  The candidates run through the
+   engine's differentially-tested batched check in one dispatch
+   (``check_columns``); definite grants stream back through the
+   interner.  Overflowed and possible-not-definite candidates re-check
+   on the host oracle, which keeps exactly the definite ones — matching
+   oracle.lookup_*'s conditional omission (the bool collapse,
+   client/client.go:277) while still resolving permission-userset
+   grants the device can only call "possible".
+
+Cost: candidate expansion is O(result-neighborhood · log E) host work
+with no per-edge Python; the exact filter is one device dispatch over
+|candidates| queries — at 1M docs this is milliseconds of device time,
+vs minutes of recursive host checks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..native.sort import argsort1, lexsort2
+from ..rel.relationship import WILDCARD_ID
+from ..store.snapshot import Snapshot
+
+_B32 = np.int64(2**32)
+
+
+def _ranges(lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
+    """Concatenated index ranges [lo[i], hi[i]) — the ragged gather that
+    turns per-key searchsorted bounds into one flat index array."""
+    counts = (hi - lo).astype(np.int64)
+    total = int(counts.sum())
+    if total == 0:
+        return np.empty(0, np.int64)
+    starts = np.repeat(lo.astype(np.int64), counts)
+    ends = np.cumsum(counts)
+    offs = np.arange(total, dtype=np.int64) - np.repeat(ends - counts, counts)
+    return starts + offs
+
+
+@dataclass
+class LookupIndex:
+    """Transposed sorted views for reverse expansion, built once per
+    Snapshot (lazily) and cached on it."""
+
+    #: all edges keyed by packed (subject, srel1), sorted
+    rs_key: np.ndarray  # int64[E] = subj * (num_slots+1) + srel1
+    rs_res: np.ndarray  # int32[E]
+    rs_rel: np.ndarray  # int32[E]
+    #: arrow edges keyed by child node, sorted
+    ra_child: np.ndarray  # int32[A]
+    ra_res: np.ndarray  # int32[A]
+    #: all edges keyed by resource node, sorted
+    er_res: np.ndarray  # int32[E]
+    er_subj: np.ndarray  # int32[E]
+    er_srel1: np.ndarray  # int32[E]
+    #: primary view packed (rel, res) — already sorted by construction
+    e_relres: np.ndarray  # int64[E]
+    #: arrow view packed (rel, res) — already sorted by construction
+    ar_relres: np.ndarray  # int64[A]
+    #: [interner num_types, num_slots] — slot is a permission on the type
+    perm_table: np.ndarray
+    #: interner tid → permission slots on that type (int64 array)
+    perm_slots_of_tid: Dict[int, np.ndarray]
+
+
+def lookup_index(snap: Snapshot) -> LookupIndex:
+    idx = getattr(snap, "_lookup_index", None)
+    if idx is not None:
+        return idx
+    NS1 = snap.num_slots + 1
+    order = lexsort2(snap.e_subj, snap.e_srel1)
+    rs_key = (
+        snap.e_subj[order].astype(np.int64) * NS1
+        + snap.e_srel1[order].astype(np.int64)
+    )
+    ra_order = argsort1(snap.ar_child)
+    er_order = argsort1(snap.e_res)
+    interner = snap.interner
+    compiled = snap.compiled
+    perm_table = np.zeros((max(interner.num_types, 1), snap.num_slots), bool)
+    perm_slots_of_tid: Dict[int, np.ndarray] = {}
+    for tname, d in compiled.schema.definitions.items():
+        itid = interner.type_lookup(tname)
+        if itid < 0:
+            continue
+        slots = np.asarray(
+            sorted(compiled.slot_of_name[p] for p in d.permissions), np.int64
+        )
+        if slots.size:
+            perm_table[itid, slots] = True
+            perm_slots_of_tid[itid] = slots
+    idx = LookupIndex(
+        rs_key=rs_key,
+        rs_res=snap.e_res[order],
+        rs_rel=snap.e_rel[order],
+        ra_child=snap.ar_child[ra_order],
+        ra_res=snap.ar_res[ra_order],
+        er_res=snap.e_res[er_order],
+        er_subj=snap.e_subj[er_order],
+        er_srel1=snap.e_srel1[er_order],
+        e_relres=snap.e_rel.astype(np.int64) * _B32 + snap.e_res.astype(np.int64),
+        ar_relres=snap.ar_rel.astype(np.int64) * _B32 + snap.ar_res.astype(np.int64),
+        perm_table=perm_table,
+        perm_slots_of_tid=perm_slots_of_tid,
+    )
+    snap._lookup_index = idx
+    return idx
+
+
+def _setdiff(new: np.ndarray, seen: np.ndarray) -> np.ndarray:
+    if new.size == 0 or seen.size == 0:
+        return new
+    return new[~np.isin(new, seen)]
+
+
+def _exact_filter(
+    engine,
+    dsnap,
+    cand: np.ndarray,
+    q_res: np.ndarray,
+    q_perm: np.ndarray,
+    q_subj: np.ndarray,
+    q_srel: np.ndarray,
+    q_wc: np.ndarray,
+    now_us: Optional[int],
+    oracle_check: Callable[[int], bool],
+) -> np.ndarray:
+    """Run the device forward check over candidate queries; returns the
+    subset of ``cand`` definitively granted.  Overflowed AND
+    possible-not-definite items re-check on the host oracle — the oracle
+    includes the ones it resolves to T and drops genuinely-conditional
+    ones, exactly matching oracle.lookup_* (conditional omission = the
+    bool collapse, client/client.go:277).  Resolving p&~d on the host
+    matters for permission-valued userset subjects, where the device can
+    only ever report "possible" but the host answer is definite."""
+    d, p, ovf = engine.check_columns(
+        dsnap, q_res, q_perm, q_subj, q_srel=q_srel, q_wc=q_wc, now_us=now_us
+    )
+    needs_host = ovf | (p & ~d)
+    granted = list(cand[d & ~needs_host])
+    for i in np.nonzero(needs_host)[0]:
+        if oracle_check(int(cand[i])):
+            granted.append(int(cand[i]))
+    return np.asarray(granted, np.int64)
+
+
+def lookup_resources_device(
+    engine,
+    dsnap,
+    resource_type: str,
+    permission: str,
+    subject_type: str,
+    subject_id: str,
+    subject_relation: str = "",
+    *,
+    now_us: Optional[int] = None,
+    oracle_factory: Optional[Callable[[], object]] = None,
+) -> List[str]:
+    """Resource ids of ``resource_type`` the subject definitively holds
+    ``permission`` on, sorted — reverse worklist expansion + device exact
+    filter.  Matches oracle.lookup_resources output exactly.
+
+    The worklist is over *subject-occurrence keys* packed
+    (node, srel1): scanning a key yields every edge where that userset
+    (or direct subject / wildcard) appears as the subject; each hit's
+    resource becomes a candidate, is closed under reverse arrows, and
+    contributes new keys — (res, rel+1) for the granted relation (the
+    membership chain, generalizing the device's Phase-A closure) and,
+    for schemas with permission-valued usersets, (n, p+1) for every
+    permission p on each new node n (the subject may hold p on n, so
+    edges granted to n#p may be granted to the subject)."""
+    snap: Snapshot = dsnap.snapshot
+    interner = snap.interner
+    compiled = snap.compiled
+    NS1 = snap.num_slots + 1
+    perm_slot = compiled.slot_of_name.get(permission)
+    rtid = interner.type_lookup(resource_type)
+    if perm_slot is None or rtid < 0:
+        return []
+    if subject_relation and subject_relation not in compiled.slot_of_name:
+        return []
+    srel_slot = compiled.slot_of_name[subject_relation] if subject_relation else -1
+    subj_node = interner.lookup(subject_type, subject_id)
+    stid = interner.type_lookup(subject_type)
+    wc_node = -1
+    if (
+        srel_slot < 0
+        and subject_id != WILDCARD_ID
+        and 0 <= stid < snap.wildcard_node_of_type.shape[0]
+    ):
+        wc_node = int(snap.wildcard_node_of_type[stid])
+    if subj_node < 0 and wc_node < 0:
+        return []
+
+    idx = lookup_index(snap)
+    perm_chains = bool(compiled.has_permission_usersets)
+
+    def rev_arrows(frontier: np.ndarray) -> np.ndarray:
+        lo = np.searchsorted(idx.ra_child, frontier, "left")
+        hi = np.searchsorted(idx.ra_child, frontier, "right")
+        return idx.ra_res[_ranges(lo, hi)].astype(np.int64)
+
+    init: List[np.ndarray] = []
+    if subj_node >= 0:
+        init.append(
+            np.array(
+                [subj_node * NS1 + (srel_slot + 1 if srel_slot >= 0 else 0)], np.int64
+            )
+        )
+    if wc_node >= 0:
+        init.append(np.array([wc_node * NS1], np.int64))
+    seen_keys = np.unique(np.concatenate(init))
+    key_frontier = seen_keys
+    # self-identity: the subject node itself may be the resource
+    seen_nodes = (
+        np.array([subj_node], np.int64) if subj_node >= 0 else np.empty(0, np.int64)
+    )
+    while key_frontier.size:
+        lo = np.searchsorted(idx.rs_key, key_frontier, "left")
+        hi = np.searchsorted(idx.rs_key, key_frontier, "right")
+        ii = _ranges(lo, hi)
+        new_keys: List[np.ndarray] = []
+        if ii.size:
+            res = idx.rs_res[ii].astype(np.int64)
+            relk = idx.rs_rel[ii].astype(np.int64)
+            # granted usersets continue the membership chain
+            new_keys.append(res * NS1 + relk + 1)
+            # candidates: the resources themselves, closed under reverse
+            # arrows (parents granting through tupleset traversal)
+            fresh_rounds: List[np.ndarray] = []
+            node_frontier = _setdiff(np.unique(res), seen_nodes)
+            while node_frontier.size:
+                seen_nodes = np.union1d(seen_nodes, node_frontier)
+                fresh_rounds.append(node_frontier)
+                parents = np.unique(rev_arrows(node_frontier))
+                node_frontier = _setdiff(parents, seen_nodes)
+            if perm_chains and fresh_rounds:
+                # the subject may hold any permission on any fresh
+                # candidate node; edges granted to n#p extend the chain
+                fresh = np.concatenate(fresh_rounds)
+                tids = snap.node_type[fresh]
+                for t in np.unique(tids):
+                    slots = idx.perm_slots_of_tid.get(int(t))
+                    if slots is None:
+                        continue
+                    nn = fresh[tids == t]
+                    new_keys.append(
+                        (nn[:, None] * NS1 + slots[None, :] + 1).ravel()
+                    )
+        if new_keys:
+            nk = np.unique(np.concatenate(new_keys))
+            key_frontier = _setdiff(nk, seen_keys)
+            seen_keys = np.union1d(seen_keys, key_frontier)
+        else:
+            key_frontier = np.empty(0, np.int64)
+
+    cand = seen_nodes[snap.node_type[seen_nodes] == rtid]
+    if cand.size == 0:
+        return []
+
+    B = cand.shape[0]
+    oracle = None
+
+    def oracle_check(node: int) -> bool:
+        nonlocal oracle
+        if oracle is None:
+            oracle = oracle_factory()
+        from .oracle import T
+
+        _, rid = interner.key_of(node)
+        return oracle.check(
+            resource_type, rid, permission,
+            subject_type, subject_id, subject_relation,
+        ) == T
+
+    granted = _exact_filter(
+        engine, dsnap, cand,
+        q_res=cand.astype(np.int32),
+        q_perm=np.full(B, perm_slot, np.int32),
+        q_subj=np.full(B, subj_node, np.int32),
+        q_srel=np.full(B, srel_slot, np.int32),
+        q_wc=np.full(B, wc_node, np.int32),
+        now_us=now_us,
+        oracle_check=oracle_check,
+    )
+    return sorted(interner.key_of(int(n))[1] for n in granted)
+
+
+def lookup_subjects_device(
+    engine,
+    dsnap,
+    resource_type: str,
+    resource_id: str,
+    permission: str,
+    subject_type: str,
+    subject_relation: str = "",
+    *,
+    now_us: Optional[int] = None,
+    oracle_factory: Optional[Callable[[], object]] = None,
+) -> List[str]:
+    """Subject ids of ``subject_type`` definitively holding ``permission``
+    on the resource, sorted — forward worklist expansion + device exact
+    filter.  Matches oracle.lookup_subjects output exactly.
+
+    The worklist alternates nodes and userset pairs: a node contributes
+    its arrow subgraph and every edge hanging off it (direct subjects →
+    candidates, userset subjects → pairs); a pair (g, r) contributes g's
+    members when r is a relation (edges (r, g)), or puts g back on the
+    node worklist when r is a *permission* — holders of r on g are found
+    by expanding g itself (superset; the forward check is exact)."""
+    snap: Snapshot = dsnap.snapshot
+    interner = snap.interner
+    compiled = snap.compiled
+    NS = snap.num_slots
+    perm_slot = compiled.slot_of_name.get(permission)
+    res_node = interner.lookup(resource_type, resource_id)
+    stid = interner.type_lookup(subject_type)
+    if perm_slot is None or res_node < 0 or stid < 0:
+        return []
+    if subject_relation and subject_relation not in compiled.slot_of_name:
+        return []
+    srel_slot = compiled.slot_of_name[subject_relation] if subject_relation else -1
+    wc_node = -1
+    if 0 <= stid < snap.wildcard_node_of_type.shape[0]:
+        wc_node = int(snap.wildcard_node_of_type[stid])
+
+    idx = lookup_index(snap)
+    ts_slots = np.asarray(sorted(compiled.tupleset_slots), np.int64)
+
+    def fwd_arrows(frontier: np.ndarray) -> np.ndarray:
+        if ts_slots.size == 0:
+            return np.empty(0, np.int64)
+        kk = (ts_slots[:, None] * _B32 + frontier[None, :]).ravel()
+        lo = np.searchsorted(idx.ar_relres, kk, "left")
+        hi = np.searchsorted(idx.ar_relres, kk, "right")
+        return snap.ar_child[_ranges(lo, hi)].astype(np.int64)
+
+    cand_parts: List[np.ndarray] = []
+    wildcard_found = False
+    seen_nodes = np.empty(0, np.int64)
+    seen_pairs = np.empty(0, np.int64)
+    node_frontier = np.array([res_node], np.int64)
+    pair_frontier = np.empty(0, np.int64)
+
+    def absorb_edges(subs: np.ndarray, sr1: np.ndarray) -> np.ndarray:
+        """Direct subjects → candidates / wildcard flag; userset subjects
+        → packed pairs.  Returns the new pairs."""
+        nonlocal wildcard_found
+        direct = subs[sr1 == 0].astype(np.int64)
+        if srel_slot < 0 and direct.size:
+            cand_parts.append(direct[snap.node_type[direct] == stid])
+        if wc_node >= 0 and not wildcard_found and np.any(direct == wc_node):
+            wildcard_found = True
+        um = sr1 > 0
+        return subs[um].astype(np.int64) * NS + (sr1[um].astype(np.int64) - 1)
+
+    while node_frontier.size or pair_frontier.size:
+        new_pairs: List[np.ndarray] = []
+        next_nodes: List[np.ndarray] = []
+        if node_frontier.size:
+            # arrow closure of the frontier, then every edge off the new nodes
+            frontier = node_frontier
+            fresh_all: List[np.ndarray] = []
+            while frontier.size:
+                fresh = _setdiff(np.unique(frontier), seen_nodes)
+                if fresh.size == 0:
+                    break
+                seen_nodes = np.union1d(seen_nodes, fresh)
+                fresh_all.append(fresh)
+                frontier = fwd_arrows(fresh)
+            if fresh_all:
+                nodes = np.concatenate(fresh_all)
+                lo = np.searchsorted(idx.er_res, nodes, "left")
+                hi = np.searchsorted(idx.er_res, nodes, "right")
+                ii = _ranges(lo, hi)
+                new_pairs.append(absorb_edges(idx.er_subj[ii], idx.er_srel1[ii]))
+        if pair_frontier.size:
+            g = pair_frontier // NS
+            r = pair_frontier % NS
+            is_perm = idx.perm_table[snap.node_type[g], r]
+            # permission pairs: holders of g#p ⊆ expansion of g itself
+            if np.any(is_perm):
+                next_nodes.append(g[is_perm])
+            # relation pairs: members are the subjects of edges (r, g)
+            rel_g, rel_r = g[~is_perm], r[~is_perm]
+            if rel_g.size:
+                kk = rel_r * _B32 + rel_g
+                lo = np.searchsorted(idx.e_relres, kk, "left")
+                hi = np.searchsorted(idx.e_relres, kk, "right")
+                jj = _ranges(lo, hi)
+                new_pairs.append(
+                    absorb_edges(
+                        snap.e_subj[jj].astype(np.int64),
+                        snap.e_srel1[jj].astype(np.int64),
+                    )
+                )
+        if new_pairs:
+            np_all = np.unique(np.concatenate(new_pairs))
+            pair_frontier = _setdiff(np_all, seen_pairs)
+            seen_pairs = np.union1d(seen_pairs, pair_frontier)
+        else:
+            pair_frontier = np.empty(0, np.int64)
+        node_frontier = (
+            _setdiff(np.unique(np.concatenate(next_nodes)), seen_nodes)
+            if next_nodes
+            else np.empty(0, np.int64)
+        )
+
+    if srel_slot >= 0 and seen_pairs.size:
+        # userset-subject lookup: candidate usersets with matching relation
+        gs = seen_pairs[seen_pairs % NS == srel_slot] // NS
+        cand_parts.append(gs[snap.node_type[gs] == stid])
+    # self-identity: the resource itself can be the subject
+    if snap.node_type[res_node] == stid:
+        cand_parts.append(np.array([res_node], np.int64))
+    if wildcard_found and srel_slot < 0:
+        # a reachable wildcard grants every direct subject of the type
+        # that appears anywhere in the graph (oracle's subjects_of_type)
+        all_subj = np.unique(snap.e_subj).astype(np.int64)
+        cand_parts.append(all_subj[snap.node_type[all_subj] == stid])
+
+    if not cand_parts:
+        return []
+    cand = np.unique(np.concatenate(cand_parts))
+    if cand.size == 0:
+        return []
+
+    B = cand.shape[0]
+    q_wc = np.full(B, -1, np.int32)
+    if srel_slot < 0 and wc_node >= 0:
+        # a candidate that IS the wildcard node checks as itself, not
+        # against the wildcard (oracle: subject_id != WILDCARD guard)
+        q_wc = np.where(cand == wc_node, -1, wc_node).astype(np.int32)
+    oracle = None
+
+    def oracle_check(node: int) -> bool:
+        nonlocal oracle
+        if oracle is None:
+            oracle = oracle_factory()
+        from .oracle import T
+
+        _, sid = interner.key_of(node)
+        return oracle.check(
+            resource_type, resource_id, permission,
+            subject_type, sid, subject_relation,
+        ) == T
+
+    granted = _exact_filter(
+        engine, dsnap, cand,
+        q_res=np.full(B, res_node, np.int32),
+        q_perm=np.full(B, perm_slot, np.int32),
+        q_subj=cand.astype(np.int32),
+        q_srel=np.full(B, srel_slot, np.int32),
+        q_wc=q_wc,
+        now_us=now_us,
+        oracle_check=oracle_check,
+    )
+    return sorted(interner.key_of(int(n))[1] for n in granted)

@@ -65,9 +65,11 @@ class ShardedEngine(DeviceEngine):
         )
 
         def arr_spec_of(key: str):
-            # lookup tables (node type map, caveat context tables) are
-            # replicated; sorted edge columns shard along the model axis
-            if key == "node_type" or key.startswith("ectx_"):
+            # lookup tables (node type map, caveat context tables, the
+            # static possibly-userset pair set — probed whole by every
+            # leaf test) are replicated; sorted edge columns shard along
+            # the model axis
+            if key == "node_type" or key.startswith(("ectx_", "pus_")):
                 return P()
             return P(MODEL_AXIS)
 

@@ -1,0 +1,279 @@
+"""Columnar base segments: the scalable half of the Store.
+
+The reference's BulkImport streams to a server engineered for bulk load
+(client/client.go:438-465).  Here the equivalent is this layer: bulk
+imports land as immutable int32 column blocks (one per import call) with
+a sorted key sidecar, instead of per-edge Python ``Relationship`` objects
+in the live dict — the dict stays for small interactive writes.  100M+
+edges then cost numpy/native work (batch interning, vectorized
+validation by *shape*, sorted-key dedup), not 100M Python objects.
+
+Key packing: an edge key (res, rel, subj, srel1) packs into two int64s
+h=(rel<<32)|res, l=(subj<<32)|srel1 (all components non-negative), and a
+numpy structured array of (h, l) compares lexicographically — giving
+O(log N) existence probes via ``searchsorted`` with no Python sets.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Any, Dict, Iterator, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..rel.filter import Filter
+from ..rel.relationship import Relationship, expiration_micros
+from ..schema.compiler import CompiledSchema
+
+KEY_DT = np.dtype([("h", np.int64), ("l", np.int64)])
+
+
+def pack_keys(
+    res: np.ndarray, rel: np.ndarray, subj: np.ndarray, srel1: np.ndarray
+) -> np.ndarray:
+    out = np.empty(res.shape[0], KEY_DT)
+    out["h"] = (rel.astype(np.int64) << 32) | res.astype(np.int64)
+    out["l"] = (subj.astype(np.int64) << 32) | srel1.astype(np.int64)
+    return out
+
+
+class ColumnSegment:
+    """One immutable bulk-imported block of edges with a mutable liveness
+    mask (TOUCH/DELETE of an imported edge marks its row dead; the
+    replacement lives in a newer segment or the live dict)."""
+
+    __slots__ = (
+        "res", "rel", "subj", "srel1", "caveat", "ctx", "exp_us",
+        "live", "skey", "sorder",
+    )
+
+    def __init__(self, res, rel, subj, srel1, caveat, ctx, exp_us) -> None:
+        self.res = res
+        self.rel = rel
+        self.subj = subj
+        self.srel1 = srel1
+        self.caveat = caveat
+        self.ctx = ctx
+        self.exp_us = exp_us
+        self.live = np.ones(res.shape[0], bool)
+        keys = pack_keys(res, rel, subj, srel1)
+        self.sorder = np.argsort(keys, kind="stable")
+        self.skey = keys[self.sorder]
+
+    def __len__(self) -> int:
+        return int(self.res.shape[0])
+
+    @property
+    def live_count(self) -> int:
+        return int(np.count_nonzero(self.live))
+
+    # -- key probes ------------------------------------------------------
+    def rows_of_keys(self, keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """(hit_mask, row_index) per query key; only LIVE rows hit.  Keys
+        are unique within a segment, so at most one row matches."""
+        lo = np.searchsorted(self.skey, keys, "left")
+        loc = np.clip(lo, 0, max(len(self.skey) - 1, 0))
+        hit = np.zeros(keys.shape[0], bool)
+        rows = np.zeros(keys.shape[0], np.int64)
+        if len(self.skey):
+            found = self.skey[loc] == keys
+            rows = self.sorder[loc]
+            hit = found & self.live[rows]
+        return hit, rows
+
+    def row_of_key(self, key: np.ndarray) -> int:
+        """Live row index for one packed key, or -1."""
+        hit, rows = self.rows_of_keys(key.reshape(1))
+        return int(rows[0]) if hit[0] else -1
+
+    # -- decoding --------------------------------------------------------
+    def decode(
+        self,
+        row: int,
+        interner,
+        slot_names: Mapping[int, str],
+        caveat_names: Mapping[int, str],
+        contexts: Sequence[Mapping[str, Any]],
+    ) -> Relationship:
+        rtype, rid = interner.key_of(int(self.res[row]))
+        stype, sid = interner.key_of(int(self.subj[row]))
+        srel1 = int(self.srel1[row])
+        cav = int(self.caveat[row])
+        ctx_i = int(self.ctx[row])
+        exp_us = int(self.exp_us[row])
+        expiration = None
+        if exp_us:
+            expiration = _dt.datetime.fromtimestamp(
+                exp_us / 1_000_000, tz=_dt.timezone.utc
+            )
+        return Relationship(
+            resource_type=rtype,
+            resource_id=rid,
+            resource_relation=slot_names[int(self.rel[row])],
+            subject_type=stype,
+            subject_id=sid,
+            subject_relation=slot_names[srel1 - 1] if srel1 > 0 else "",
+            caveat_name=caveat_names[cav] if cav else "",
+            caveat_context=contexts[ctx_i] if ctx_i >= 0 else {},
+            expiration=expiration,
+        )
+
+    # -- vectorized filter matching -------------------------------------
+    def filter_mask(
+        self,
+        f: Optional[Filter],
+        compiled: CompiledSchema,
+        interner,
+        node_type: np.ndarray,
+        now_us: Optional[int],
+    ) -> np.ndarray:
+        """Boolean mask of LIVE, unexpired rows matching the filter —
+        the columnar mirror of Filter.matches/Snapshot.iter_relationships."""
+        mask = self.live.copy()
+        if now_us is not None:
+            mask &= (self.exp_us == 0) | (self.exp_us > now_us)
+        if f is None:
+            return mask
+        none = np.zeros(len(self), bool)
+        if f.resource_type != "":
+            tid = interner.type_lookup(f.resource_type)
+            if tid < 0:
+                return none
+            mask &= node_type[self.res] == tid
+        if f.optional_resource_id != "":
+            n = interner.lookup(f.resource_type, f.optional_resource_id)
+            if n < 0:
+                return none
+            mask &= self.res == n
+        if f.optional_relation != "":
+            s = compiled.slot_of_name.get(f.optional_relation)
+            if s is None:
+                return none
+            mask &= self.rel == s
+        sf = f.optional_subject_filter
+        if sf is not None:
+            if sf.subject_type != "":
+                tid = interner.type_lookup(sf.subject_type)
+                if tid < 0:
+                    return none
+                mask &= node_type[self.subj] == tid
+            if sf.optional_subject_id != "":
+                n = interner.lookup(sf.subject_type, sf.optional_subject_id)
+                if n < 0:
+                    return none
+                mask &= self.subj == n
+            if sf.optional_relation is not None:
+                if sf.optional_relation == "":
+                    mask &= self.srel1 == 0
+                else:
+                    s = compiled.slot_of_name.get(sf.optional_relation)
+                    if s is None:
+                        return none
+                    mask &= self.srel1 == s + 1
+        return mask
+
+    # -- schema migration ------------------------------------------------
+    def remap_slots(
+        self, slot_map: np.ndarray, caveat_map: np.ndarray
+    ) -> None:
+        """Renumber relation/caveat ids after a schema write (slot
+        numbering is schema-derived; segments outlive schemas).  Maps are
+        old-id → new-id arrays; -1 entries never occur for ids referenced
+        by validated live rows."""
+        self.rel = slot_map[self.rel]
+        srel = self.srel1.astype(np.int64) - 1
+        remapped = np.where(srel >= 0, slot_map[np.clip(srel, 0, None)], -1)
+        self.srel1 = (remapped + 1).astype(np.int32)
+        self.caveat = caveat_map[self.caveat]
+        keys = pack_keys(self.res, self.rel, self.subj, self.srel1)
+        self.sorder = np.argsort(keys, kind="stable")
+        self.skey = keys[self.sorder]
+
+
+def relationships_to_columns(
+    batch: Sequence[Relationship],
+    compiled: CompiledSchema,
+    interner,
+    contexts: List[Mapping[str, Any]],
+    ctx_index: Dict[str, int],
+) -> Dict[str, np.ndarray]:
+    """Convert a batch of Relationship objects to int columns with batch
+    interning and *shape-level* validation: write-validity depends only on
+    (resource_type, relation, subject_type, subject_relation, wildcard,
+    caveat, has_expiration) — one validate per distinct shape, not per
+    edge.  Appends novel caveat contexts to ``contexts`` (deduplicated by
+    canonical repr through ``ctx_index``)."""
+    B = len(batch)
+    slot_of = compiled.slot_of_name
+    caveat_ids = compiled.caveat_ids
+
+    rtypes: List[str] = [""] * B
+    rids: List[str] = [""] * B
+    stypes: List[str] = [""] * B
+    sids: List[str] = [""] * B
+    rel = np.empty(B, np.int32)
+    srel1 = np.empty(B, np.int32)
+    caveat = np.zeros(B, np.int32)
+    ctx = np.full(B, -1, np.int32)
+    exp_us = np.zeros(B, np.int64)
+
+    seen_shapes: set = set()
+    for i, r in enumerate(batch):
+        rtypes[i] = r.resource_type
+        rids[i] = r.resource_id
+        stypes[i] = r.subject_type
+        sids[i] = r.subject_id
+        shape = (
+            r.resource_type, r.resource_relation, r.subject_type,
+            r.subject_relation, r.subject_id == "*", r.caveat_name,
+            r.has_expiration(),
+        )
+        if shape not in seen_shapes:
+            compiled.validate_relationship(r)
+            seen_shapes.add(shape)
+        rel[i] = slot_of[r.resource_relation]
+        srel1[i] = slot_of[r.subject_relation] + 1 if r.subject_relation else 0
+        if r.caveat_name:
+            caveat[i] = caveat_ids[r.caveat_name]
+            if r.caveat_context:
+                ck = repr(sorted(r.caveat_context.items(), key=lambda kv: kv[0]))
+                at = ctx_index.get(ck)
+                if at is None:
+                    at = len(contexts)
+                    ctx_index[ck] = at
+                    contexts.append(r.caveat_context)
+                ctx[i] = at
+        if r.has_expiration():
+            exp_us[i] = expiration_micros(r.expiration)
+
+    if hasattr(interner, "node_batch_typed"):
+        tid_of: Dict[str, int] = {}
+
+        def tids(names: List[str]) -> np.ndarray:
+            out = np.empty(len(names), np.int32)
+            for i, n in enumerate(names):
+                t = tid_of.get(n)
+                if t is None:
+                    t = interner.type_id(n)
+                    tid_of[n] = t
+                out[i] = t
+            return out
+
+        res = interner.node_batch_typed(tids(rtypes), rids)
+        subj = interner.node_batch_typed(tids(stypes), sids)
+    else:
+        res = np.fromiter(
+            (interner.node(t, i) for t, i in zip(rtypes, rids)), np.int32, B
+        )
+        subj = np.fromiter(
+            (interner.node(t, i) for t, i in zip(stypes, sids)), np.int32, B
+        )
+    return {
+        "res": res, "rel": rel, "subj": subj, "srel1": srel1,
+        "caveat": caveat, "ctx": ctx, "exp_us": exp_us,
+    }
+
+
+def iter_segment_rows(seg: ColumnSegment, rows: Iterator[int]):
+    """Helper for lazy Update views (see store._ColumnUpdates)."""
+    return rows

@@ -87,6 +87,18 @@ class Snapshot:
     us_caveat: np.ndarray
     us_ctx: np.ndarray
     us_exp: np.ndarray
+    #: 1 where the userset's relation is a *permission* on the subject's
+    #: type (rel/relationship.go:35-37 makes these first-class): the device
+    #: can't decide membership (it's the permission fixpoint), so such leaf
+    #: grants hit only the possible plane → per-query host resolution
+    us_perm: np.ndarray
+
+    #: static possibly-userset pairs, sorted lex (node, rel): relation
+    #: usersets whose membership may be extended through a permission-valued
+    #: userset chain (transitive mp-closure of permission-srel edge targets);
+    #: leaf probes treat containment as possible for every subject
+    pus_n: np.ndarray
+    pus_r: np.ndarray
 
     # membership seeds (direct edges into used usersets) sorted by ms_subj
     ms_subj: np.ndarray
@@ -411,6 +423,54 @@ def finish_snapshot(
     mp_ctx = e_ctx[prop_mask][prop_sort]
     mp_exp = e_exp[prop_mask][prop_sort]
 
+    # permission-valued userset machinery: per-(interner type, slot) "is a
+    # permission" table → us_perm leaf flags + the transitive possibly-
+    # userset pair set (see Snapshot.us_perm / pus_n docs)
+    perm_table = np.zeros((max(interner.num_types, 1), num_slots), bool)
+    for tname2, d2 in compiled.schema.definitions.items():
+        itid = interner.type_lookup(tname2)
+        if itid < 0:
+            continue
+        for pname2 in d2.permissions:
+            perm_table[itid, compiled.slot_of_name[pname2]] = True
+    if us_subj.shape[0]:
+        us_perm = perm_table[
+            node_type[us_subj], np.clip(us_srel, 0, num_slots - 1)
+        ].astype(np.int32)
+    else:
+        us_perm = np.zeros(0, np.int32)
+
+    pus_n = np.zeros(0, np.int32)
+    pus_r = np.zeros(0, np.int32)
+    if mp_subj.shape[0] and compiled.has_permission_usersets:
+        mp_is_perm = perm_table[
+            node_type[mp_subj], np.clip(mp_srel, 0, num_slots - 1)
+        ]
+        seeds = np.unique(
+            mp_res[mp_is_perm].astype(np.int64) * num_slots + mp_rel[mp_is_perm]
+        )
+        mp_key = mp_subj.astype(np.int64) * num_slots + mp_srel.astype(np.int64)
+        visited = seeds
+        frontier = seeds
+        while frontier.size:
+            lo = np.searchsorted(mp_key, frontier, "left")
+            hi = np.searchsorted(mp_key, frontier, "right")
+            counts = (hi - lo).astype(np.int64)
+            total = int(counts.sum())
+            if total == 0:
+                break
+            starts = np.repeat(lo.astype(np.int64), counts)
+            ends = np.cumsum(counts)
+            ii = starts + (np.arange(total) - np.repeat(ends - counts, counts))
+            nxt = np.unique(
+                mp_res[ii].astype(np.int64) * num_slots + mp_rel[ii]
+            )
+            frontier = nxt[~np.isin(nxt, visited)]
+            visited = np.union1d(visited, frontier)
+        if visited.size:
+            pus_n = (visited // num_slots).astype(np.int32)
+            pus_r = (visited % num_slots).astype(np.int32)
+
     # arrow view: tupleset relations, direct subjects only (SpiceDB arrows
     # traverse ellipsis subjects)
     ts_slots = np.asarray(sorted(compiled.tupleset_slots), dtype=np.int64)
@@ -434,7 +494,8 @@ def finish_snapshot(
         e_rel=e_rel, e_res=e_res, e_subj=e_subj, e_srel1=e_srel1,
         e_caveat=e_cav, e_ctx=e_ctx, e_exp=e_exp, e_exp_us=e_exp_us,
         us_rel=us_rel, us_res=us_res, us_subj=us_subj, us_srel=us_srel,
-        us_caveat=us_cav, us_ctx=us_ctx, us_exp=us_exp,
+        us_caveat=us_cav, us_ctx=us_ctx, us_exp=us_exp, us_perm=us_perm,
+        pus_n=pus_n, pus_r=pus_r,
         ms_subj=ms_subj, ms_res=ms_res, ms_rel=ms_rel,
         ms_caveat=ms_cav, ms_ctx=ms_ctx, ms_exp=ms_exp,
         mp_subj=mp_subj, mp_srel=mp_srel, mp_res=mp_res, mp_rel=mp_rel,

@@ -1,0 +1,213 @@
+"""Permission-valued userset subjects on device (VERDICT round-1 item 4).
+
+The reference's data model makes userset subjects first-class
+(rel/relationship.go:35-37), including subjects whose relation is a
+*permission* (``relation shared: document#view``).  Round 1 evicted the
+entire schema to the host oracle when one appeared; now the device marks
+grants through them possible-not-definite (us_perm flag), and relation
+usersets transitively fed by permission chains (the static pus pair set)
+likewise, so only the affected *queries* fall back — everything else
+stays device-definite.
+
+Contract under test: device definite ⇒ oracle T; oracle T ⇒ device
+possible (no silent misses); unaffected queries stay definite."""
+
+import numpy as np
+
+from gochugaru_tpu import consistency, new_tpu_evaluator, rel
+from gochugaru_tpu.engine.device import DeviceEngine
+from gochugaru_tpu.engine.oracle import Oracle, T
+from gochugaru_tpu.rel.txn import Txn
+from gochugaru_tpu.schema import compile_schema, parse_schema
+from gochugaru_tpu.store.interner import Interner
+from gochugaru_tpu.store.snapshot import build_snapshot
+from gochugaru_tpu.utils import background
+from gochugaru_tpu.utils.metrics import default as metrics
+
+NOW = 1_700_000_000_000_000
+
+SHARED = """
+definition user {}
+definition document {
+    relation viewer: user
+    relation shared: document#view
+    permission view = viewer + shared
+}
+"""
+
+
+def world(schema, rels):
+    cs = compile_schema(parse_schema(schema))
+    snap = build_snapshot(1, cs, Interner(), rels, epoch_us=NOW)
+    oracle = Oracle(cs, rels, now_us=NOW)
+    engine = DeviceEngine(cs)
+    dsnap = engine.prepare(snap)
+    return cs, engine, dsnap, oracle
+
+
+def brackets(engine, dsnap, oracle, checks):
+    """Device planes must bracket the oracle: d ⇒ T, T ⇒ p."""
+    d, p, ovf = engine.check_batch(dsnap, checks, now_us=NOW)
+    for i, q in enumerate(checks):
+        want = oracle.check_relationship(q)
+        if d[i]:
+            assert want == T, f"wrong device-definite on {q}"
+        if want == T and not ovf[i]:
+            assert p[i], f"device missed possible grant on {q}"
+    return d, p, ovf
+
+
+def test_direct_permission_userset_subject():
+    rels = [
+        rel.must_from_triple("document:a", "viewer", "user:u"),
+        rel.must_from_tuple("document:b#shared", "document:a#view"),
+    ]
+    cs, engine, dsnap, oracle = world(SHARED, rels)
+    assert cs.has_permission_usersets
+    checks = [
+        rel.must_from_triple("document:a", "view", "user:u"),   # direct: definite
+        rel.must_from_triple("document:b", "view", "user:u"),   # via a#view: possible
+        rel.must_from_triple("document:b", "view", "user:v"),   # no grant anywhere
+        # symbolic userset subject: a#view definitively has shared on b
+        rel.must_from_tuple("document:b#view", "document:a#view"),
+    ]
+    d, p, ovf = brackets(engine, dsnap, oracle, checks)
+    assert bool(d[0]) and not ovf[0]            # unaffected query stays definite
+    assert not d[1] and bool(p[1])              # permission chain → host fallback
+    assert oracle.check_relationship(checks[1]) == T
+    assert bool(d[3])                           # symbolic match is definite
+
+
+PUS = """
+definition user {}
+definition team { relation member: user | document#view }
+definition document {
+    relation viewer: user | team#member
+    permission view = viewer
+}
+"""
+
+
+def test_relation_userset_fed_by_permission_chain():
+    rels = [
+        rel.must_from_triple("document:a", "viewer", "user:u"),
+        rel.must_from_tuple("team:t#member", "document:a#view"),
+        rel.must_from_tuple("document:b#viewer", "team:t#member"),
+    ]
+    _, engine, dsnap, oracle = world(PUS, rels)
+    # the pus set contains (t, member): membership may flow through a#view
+    snap = dsnap.snapshot
+    assert snap.pus_n.shape[0] >= 1
+    checks = [
+        rel.must_from_triple("document:a", "view", "user:u"),
+        rel.must_from_triple("document:b", "view", "user:u"),  # u ∈ t via a#view
+        rel.must_from_triple("document:b", "view", "user:v"),  # not granted
+    ]
+    d, p, ovf = brackets(engine, dsnap, oracle, checks)
+    assert bool(d[0])
+    assert not d[1] and bool(p[1])  # possible via pus → host resolves True
+    assert oracle.check_relationship(checks[1]) == T
+    assert oracle.check_relationship(checks[2]) != T
+
+
+def test_transitive_pus_through_nested_teams():
+    schema = """
+    definition user {}
+    definition team { relation member: user | team#member | document#view }
+    definition document {
+        relation viewer: user | team#member
+        permission view = viewer
+    }
+    """
+    rels = [
+        rel.must_from_triple("document:a", "viewer", "user:u"),
+        rel.must_from_tuple("team:t1#member", "document:a#view"),
+        rel.must_from_tuple("team:t2#member", "team:t1#member"),
+        rel.must_from_tuple("document:b#viewer", "team:t2#member"),
+    ]
+    _, engine, dsnap, oracle = world(schema, rels)
+    snap = dsnap.snapshot
+    pus = set(zip(snap.pus_n.tolist(), snap.pus_r.tolist()))
+    assert len(pus) >= 2  # (t1, member) and (t2, member)
+    q = rel.must_from_triple("document:b", "view", "user:u")
+    d, p, ovf = brackets(engine, dsnap, oracle, [q])
+    assert not d[0] and bool(p[0])
+    assert oracle.check_relationship(q) == T
+
+
+def test_client_keeps_device_engine_for_permission_userset_schema():
+    c = new_tpu_evaluator()
+    ctx = background()
+    c.write_schema(ctx, SHARED)
+    txn = Txn()
+    txn.create(rel.must_from_triple("document:a", "viewer", "user:u"))
+    txn.create(rel.must_from_tuple("document:b#shared", "document:a#view"))
+    for i in range(6):
+        txn.create(rel.must_from_triple(f"document:d{i}", "viewer", f"user:w{i}"))
+    rev = c.write(ctx, txn)
+    strat = consistency.at_least(rev)
+    snap = c.store.snapshot_for(strat)
+    assert c._engine_for(snap) is not None  # no whole-schema eviction
+
+    base_dev = metrics.counter("checks.device_definite")
+    base_fb = metrics.counter("checks.fallback_conditional")
+    # unaffected batch: all device-definite, no fallback
+    assert c.check(
+        ctx, strat,
+        *[rel.must_from_triple(f"document:d{i}", "view", f"user:w{i}") for i in range(6)],
+    ) == [True] * 6
+    assert metrics.counter("checks.device_definite") == base_dev + 6
+    assert metrics.counter("checks.fallback_conditional") == base_fb
+    # affected queries resolve correctly through the per-query fallback
+    assert c.check_one(ctx, strat, rel.must_from_triple("document:b", "view", "user:u"))
+    assert not c.check_one(
+        ctx, strat, rel.must_from_triple("document:b", "view", "user:nope")
+    )
+    assert metrics.counter("checks.fallback_conditional") > base_fb
+
+
+def test_sharded_permission_usersets():
+    from gochugaru_tpu.parallel import ShardedEngine, make_mesh
+
+    rels = [
+        rel.must_from_triple("document:a", "viewer", "user:u"),
+        rel.must_from_tuple("document:b#shared", "document:a#view"),
+        rel.must_from_triple("document:c", "viewer", "user:v"),
+    ]
+    cs = compile_schema(parse_schema(SHARED))
+    snap = build_snapshot(1, cs, Interner(), rels, epoch_us=NOW)
+    oracle = Oracle(cs, rels, now_us=NOW)
+    mesh = make_mesh(2, 4)
+    engine = ShardedEngine(cs, mesh)
+    dsnap = engine.prepare(snap)
+    checks = [
+        rel.must_from_triple("document:a", "view", "user:u"),
+        rel.must_from_triple("document:b", "view", "user:u"),
+        rel.must_from_triple("document:c", "view", "user:v"),
+        rel.must_from_triple("document:c", "view", "user:u"),
+    ]
+    d, p, ovf = engine.check_batch(dsnap, checks, now_us=NOW)
+    assert list(d) == [True, False, True, False]
+    assert bool(p[1])  # the permission-chain grant surfaces as possible
+    for i, q in enumerate(checks):
+        if d[i]:
+            assert oracle.check_relationship(q) == T
+
+
+def test_lookup_resources_with_permission_usersets_via_client():
+    """Lookups on permission-userset schemas: device candidates route the
+    conditional slice through the oracle-backed overflow path or the
+    client's host scan — results must equal the oracle exactly."""
+    c = new_tpu_evaluator()
+    ctx = background()
+    c.write_schema(ctx, SHARED)
+    txn = Txn()
+    txn.create(rel.must_from_triple("document:a", "viewer", "user:u"))
+    txn.create(rel.must_from_tuple("document:b#shared", "document:a#view"))
+    rev = c.write(ctx, txn)
+    strat = consistency.at_least(rev)
+    got = sorted(c.lookup_resources(ctx, strat, "document#view", "user:u"))
+    snap = c.store.snapshot_for(strat)
+    oracle = c._oracle_for(snap)
+    want = sorted(oracle.lookup_resources("document", "view", "user", "u", ""))
+    assert got == want
