@@ -1,0 +1,297 @@
+"""Precomputed membership closure: the Leopard-style flattened index.
+
+SpiceDB's dispatch cluster re-walks group nesting on every check; Zanzibar's
+Leopard index instead flattens the member→group transitive closure offline so
+a check becomes one set-membership probe (BASELINE.md config 5 names it).
+That is the TPU-shaped move: closure computation happens ONCE per snapshot
+revision on the host (vectorized numpy sort-merge joins over the snapshot's
+membership columns, native parallel sorts), and the per-check device work
+collapses to O(1) hash probes into the flattened table — no per-query
+frontier walk, no device-side sort/dedup (the round-2 hot-path bottleneck,
+engine/device.py Phase A).
+
+Two planes, one max-min expiry semiring each (SURVEY.md §2.6 expiration +
+three-valued permissionship):
+
+- ``definite``: paths made only of caveat-free edges.  The stored value is
+  ``max over paths of (min over path edges of expiry)`` — an edge with no
+  expiration contributes +inf (stored ``NO_EXP``).  At query time the pair
+  grants definitely iff ``value > now``.
+- ``possible``: paths through any edge (caveated edges admitted — the host
+  oracle resolves the caveat per query with real context).  Same semiring,
+  so expiry alone never sends a check to the host: the max-min value
+  answers "is some path fully live at ``now``" exactly.
+
+A source whose closure exceeds ``per_source_cap`` — or that is still
+unconverged when ``max_hops`` runs out — is dropped from the table and
+recorded in the overflow set; queries whose subject hits the overflow set
+are re-checked on the host oracle (caps bound memory, never correctness —
+the same contract as engine/plan.py's EngineConfig).
+
+Replaces (the membership half of) the reference's server-side graph walk
+behind CheckBulkPermissions (client/client.go:238-266).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Tuple
+
+import numpy as np
+
+from ..native.sort import lexsort4
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .snapshot import Snapshot
+
+#: semiring +inf: "no expiration along the best path"
+NO_EXP = np.int32(2**31 - 1)
+#: semiring -inf: "no admissible path on this plane"
+NEVER = np.int32(-(2**31))
+
+
+@dataclass
+class ClosureIndex:
+    """Flattened membership closure at one revision.
+
+    Rows are sorted lexicographically by (src, srel1, g, grel) where
+    ``src``/``srel1`` identify the member (``srel1 == 0`` → a direct object
+    subject, e.g. a user node; ``srel1 == r+1`` → the userset ``src#r``)
+    and (``g``, ``grel``) is a userset the member transitively belongs to.
+    Reflexive pairs (``X#r ∈ X#r``) are NOT stored — probes test identity
+    directly.  ``d_until``/``p_until`` are the per-plane semiring values.
+    """
+
+    revision: int
+    c_src: np.ndarray  # int32[P]
+    c_srel1: np.ndarray  # int32[P]
+    c_g: np.ndarray  # int32[P]
+    c_grel: np.ndarray  # int32[P]
+    c_d_until: np.ndarray  # int32[P]  NEVER = not definite via any path
+    c_p_until: np.ndarray  # int32[P]
+    # sources whose closure overflowed per_source_cap, sorted lex
+    ovf_src: np.ndarray  # int32[O]
+    ovf_srel1: np.ndarray  # int32[O]
+
+    @property
+    def num_pairs(self) -> int:
+        return int(self.c_src.shape[0])
+
+
+def _in_sorted(sorted_arr: np.ndarray, x: np.ndarray) -> np.ndarray:
+    """Membership of x in a sorted unique array, via binary search."""
+    if sorted_arr.size == 0 or x.size == 0:
+        return np.zeros(x.shape[0], bool)
+    pos = np.clip(np.searchsorted(sorted_arr, x), 0, sorted_arr.shape[0] - 1)
+    return sorted_arr[pos] == x
+
+
+class _Builder:
+    """Mutable state of one build_closure run."""
+
+    def __init__(self, S1: np.int64, per_source_cap: int) -> None:
+        self.S1 = S1
+        self.cap = per_source_cap
+        self.ovf = np.zeros(0, np.int64)  # sorted unique overflowed src keys
+
+    def add_overflow(self, keys: np.ndarray) -> None:
+        if keys.size:
+            self.ovf = np.union1d(self.ovf, keys)
+
+    def group_max(self, src, dst, d, p):
+        """Combine duplicate (src, dst) rows, per-plane max; lexsorted out.
+        Sorts via the native parallel lexsort on the unpacked int32 columns
+        (native/sort.py — numpy lexsort is tens of seconds at 100M rows)."""
+        if src.size == 0:
+            return src, dst, d, p
+        order = lexsort4(src // self.S1, src % self.S1, dst // self.S1, dst % self.S1)
+        src, dst, d, p = src[order], dst[order], d[order], p[order]
+        first = np.ones(src.shape[0], bool)
+        first[1:] = (src[1:] != src[:-1]) | (dst[1:] != dst[:-1])
+        starts = np.nonzero(first)[0]
+        return (
+            src[first],
+            dst[first],
+            np.maximum.reduceat(d, starts),
+            np.maximum.reduceat(p, starts),
+        )
+
+    def drop_oversized(self, src, dst, d, p):
+        """Enforce per_source_cap; src must be sorted (post group_max)."""
+        if src.size == 0:
+            return src, dst, d, p
+        uniq, counts = np.unique(src, return_counts=True)
+        self.add_overflow(uniq[counts > self.cap])
+        return self.drop_overflowed(src, dst, d, p)
+
+    def drop_overflowed(self, src, dst, d, p):
+        if self.ovf.size == 0 or src.size == 0:
+            return src, dst, d, p
+        keep = ~_in_sorted(self.ovf, src)
+        return src[keep], dst[keep], d[keep], p[keep]
+
+
+def _pair_ids(
+    src_a: np.ndarray, dst_a: np.ndarray, src_b: np.ndarray, dst_b: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Dense int64 ids for (src, dst) pairs, consistent across both inputs
+    and monotone w.r.t. (src, dst) lexicographic order (so a lexsorted
+    table yields sorted ids, and np.searchsorted applies)."""
+    ns, nb = src_a.shape[0], src_b.shape[0]
+    _, inv_s = np.unique(np.concatenate([src_a, src_b]), return_inverse=True)
+    ud, inv_d = np.unique(np.concatenate([dst_a, dst_b]), return_inverse=True)
+    ids = inv_s.astype(np.int64) * np.int64(max(ud.shape[0], 1)) + inv_d
+    return ids[:ns], ids[ns : ns + nb]
+
+
+def _edge_values(cav: np.ndarray, exp: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-edge semiring weights: expiry 0 → +inf; caveated edges are
+    NEVER on the definite plane (resolving them needs per-query context)."""
+    w = np.where(exp == 0, np.int64(NO_EXP), exp.astype(np.int64)).astype(np.int32)
+    return np.where(cav == 0, w, NEVER), w
+
+
+def _expand_join(
+    keys_sorted: np.ndarray, probe: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """All-pairs sort-merge join: for each probe[i], the row indices of
+    every match in keys_sorted.  Returns (probe_row, match_row) flattened."""
+    lo = np.searchsorted(keys_sorted, probe, "left")
+    hi = np.searchsorted(keys_sorted, probe, "right")
+    counts = (hi - lo).astype(np.int64)
+    total = int(counts.sum())
+    if total == 0:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    reps = np.repeat(np.arange(probe.shape[0], dtype=np.int64), counts)
+    ends = np.cumsum(counts)
+    ii = np.repeat(lo.astype(np.int64), counts) + (
+        np.arange(total, dtype=np.int64) - np.repeat(ends - counts, counts)
+    )
+    return reps, ii
+
+
+def build_closure(
+    snap: "Snapshot",
+    *,
+    per_source_cap: int = 4096,
+    global_cap: int = 200_000_000,
+    max_hops: int = 10_000,
+) -> ClosureIndex:
+    """Flatten the snapshot's membership graph (ms_/mp_ views) into a
+    ClosureIndex via a semi-naive fixpoint of vectorized joins."""
+    S1 = np.int64(snap.num_slots + 1)  # srel1 radix
+    b = _Builder(S1, per_source_cap)
+
+    def src_key(node: np.ndarray, srel1) -> np.ndarray:
+        return node.astype(np.int64) * S1 + srel1
+
+    # -- pair-level closure over userset-propagation edges ----------------
+    # direct pair edges: (mp_subj # mp_srel)  →  (mp_res # mp_rel)
+    e_src = src_key(snap.mp_subj, snap.mp_srel.astype(np.int64) + 1)
+    e_dst = src_key(snap.mp_res, snap.mp_rel.astype(np.int64) + 1)
+    e_d, e_p = _edge_values(snap.mp_caveat, snap.mp_exp)
+    # self-loop edges (a#m @ a#m) add nothing to any path: drop them so the
+    # no-reflexive-rows invariant holds from the initial table on
+    loop = e_src == e_dst
+    if loop.any():
+        e_src, e_dst, e_d, e_p = e_src[~loop], e_dst[~loop], e_d[~loop], e_p[~loop]
+    e_order = np.argsort(e_src, kind="stable")
+    e_src, e_dst = e_src[e_order], e_dst[e_order]
+    e_d, e_p = e_d[e_order], e_p[e_order]
+
+    c_src, c_dst, c_d, c_p = b.group_max(e_src, e_dst, e_d, e_p)
+    c_src, c_dst, c_d, c_p = b.drop_oversized(c_src, c_dst, c_d, c_p)
+    n_src, n_dst, n_d, n_p = c_src, c_dst, c_d, c_p  # frontier
+
+    for _ in range(max_hops):
+        if n_src.size == 0:
+            break
+        reps, ii = _expand_join(e_src, n_dst)
+        if reps.size == 0:
+            n_src = n_src[:0]
+            break
+        j_src = n_src[reps]
+        j_dst = e_dst[ii]
+        j_d = np.minimum(n_d[reps], e_d[ii])
+        j_p = np.minimum(n_p[reps], e_p[ii])
+        keep = j_src != j_dst  # reflexivity is the probe's job
+        j_src, j_dst, j_d, j_p = j_src[keep], j_dst[keep], j_d[keep], j_p[keep]
+        j_src, j_dst, j_d, j_p = b.group_max(j_src, j_dst, j_d, j_p)
+        # an overflowed source stays overflowed: no partial creep-back
+        j_src, j_dst, j_d, j_p = b.drop_overflowed(j_src, j_dst, j_d, j_p)
+        if j_src.size == 0:
+            n_src = j_src
+            break
+
+        # improvement test against the current table
+        c_ids, j_ids = _pair_ids(c_src, c_dst, j_src, j_dst)
+        pos = np.searchsorted(c_ids, j_ids)
+        posc = np.clip(pos, 0, max(c_ids.shape[0] - 1, 0))
+        found = (c_ids.shape[0] > 0) & (c_ids[posc] == j_ids)
+        old_d = np.where(found, c_d[posc], NEVER)
+        old_p = np.where(found, c_p[posc], NEVER)
+        improved = (j_d > old_d) | (j_p > old_p)
+        j_src, j_dst = j_src[improved], j_dst[improved]
+        j_d, j_p = j_d[improved], j_p[improved]
+        if j_src.size == 0:
+            n_src = j_src
+            break
+
+        c_src, c_dst, c_d, c_p = b.group_max(
+            np.concatenate([c_src, j_src]),
+            np.concatenate([c_dst, j_dst]),
+            np.concatenate([c_d, j_d]),
+            np.concatenate([c_p, j_p]),
+        )
+        c_src, c_dst, c_d, c_p = b.drop_oversized(c_src, c_dst, c_d, c_p)
+        if c_src.size > global_cap:
+            raise MemoryError(
+                f"membership closure exceeded global cap ({c_src.size} pairs)"
+            )
+        n_src, n_dst, n_d, n_p = b.drop_overflowed(j_src, j_dst, j_d, j_p)
+    if n_src.size:
+        # hop budget exhausted before convergence: the unconverged sources'
+        # rows may be incomplete — overflow them so queries fall back to the
+        # host oracle instead of silently missing memberships
+        b.add_overflow(np.unique(n_src))
+
+    # -- user-level closure: direct seeds ∪ (seeds ⋈ pair closure) --------
+    s_src = src_key(snap.ms_subj, 0)  # direct-object members, srel1 = 0
+    s_dst = src_key(snap.ms_res, snap.ms_rel.astype(np.int64) + 1)
+    s_d, s_p = _edge_values(snap.ms_caveat, snap.ms_exp)
+
+    reps, ii = _expand_join(c_src, s_dst)
+    if reps.size:
+        u_src = np.concatenate([s_src, s_src[reps]])
+        u_dst = np.concatenate([s_dst, c_dst[ii]])
+        u_d = np.concatenate([s_d, np.minimum(s_d[reps], c_d[ii])])
+        u_p = np.concatenate([s_p, np.minimum(s_p[reps], c_p[ii])])
+    else:
+        u_src, u_dst, u_d, u_p = s_src, s_dst, s_d, s_p
+    # a user whose seed points at an overflowed pair overflows too: the
+    # pair's (dropped) closure would have been part of the user's closure
+    if b.ovf.size:
+        b.add_overflow(np.unique(s_src[_in_sorted(b.ovf, s_dst)]))
+    u_src, u_dst, u_d, u_p = b.group_max(u_src, u_dst, u_d, u_p)
+    u_src, u_dst, u_d, u_p = b.drop_oversized(u_src, u_dst, u_d, u_p)
+
+    # -- assemble (final sweep drops any row of an overflowed source) -----
+    a_src = np.concatenate([u_src, c_src])
+    a_dst = np.concatenate([u_dst, c_dst])
+    a_d = np.concatenate([u_d, c_d]).astype(np.int32)
+    a_p = np.concatenate([u_p, c_p]).astype(np.int32)
+    a_src, a_dst, a_d, a_p = b.drop_overflowed(a_src, a_dst, a_d, a_p)
+    order = lexsort4(a_src // S1, a_src % S1, a_dst // S1, a_dst % S1)
+    a_src, a_dst, a_d, a_p = a_src[order], a_dst[order], a_d[order], a_p[order]
+
+    return ClosureIndex(
+        revision=snap.revision,
+        c_src=(a_src // S1).astype(np.int32),
+        c_srel1=(a_src % S1).astype(np.int32),
+        c_g=(a_dst // S1).astype(np.int32),
+        c_grel=(a_dst % S1 - 1).astype(np.int32),
+        c_d_until=a_d,
+        c_p_until=a_p,
+        ovf_src=(b.ovf // S1).astype(np.int32),
+        ovf_srel1=(b.ovf % S1).astype(np.int32),
+    )

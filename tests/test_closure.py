@@ -1,0 +1,310 @@
+"""Differential tests for the flattened membership closure
+(store/closure.py) against a brute-force max-min path evaluator."""
+
+import numpy as np
+import pytest
+
+from gochugaru_tpu.schema import compile_schema, parse_schema
+from gochugaru_tpu.store.closure import NEVER, NO_EXP, build_closure
+from gochugaru_tpu.store.snapshot import build_snapshot
+from gochugaru_tpu.rel.relationship import Relationship
+import datetime as dt
+
+EPOCH_US = 1_700_000_000_000_000
+
+
+def rel(res, rl, subj, srel="", caveat="", exp_s=0):
+    rt, rid = res.split(":")
+    st, sid = subj.split(":")
+    expiration = None
+    if exp_s:
+        expiration = dt.datetime.fromtimestamp(
+            (EPOCH_US / 1_000_000) + exp_s, tz=dt.timezone.utc
+        )
+    return Relationship(
+        resource_type=rt, resource_id=rid, resource_relation=rl,
+        subject_type=st, subject_id=sid, subject_relation=srel,
+        caveat_name=caveat, caveat_context={},
+        expiration=expiration,
+    )
+
+
+SCHEMA = """
+caveat c1(x int) { x > 0 }
+definition user {}
+definition group {
+    relation member: user | user:* | group#member | group#other with c1
+    relation other: user | group#member
+}
+definition doc {
+    relation reader: user | group#member | group#other
+    permission view = reader
+}
+"""
+
+
+def brute_closure(snap):
+    """Max-min path values over the ms/mp membership graph, per plane."""
+    S1 = snap.num_slots + 1
+    edges = []  # (src_key, dst_key, dval, pval)
+    for i in range(snap.ms_subj.shape[0]):
+        w = NO_EXP if snap.ms_exp[i] == 0 else int(snap.ms_exp[i])
+        d = w if snap.ms_caveat[i] == 0 else int(NEVER)
+        edges.append(
+            (
+                int(snap.ms_subj[i]) * S1,
+                int(snap.ms_res[i]) * S1 + int(snap.ms_rel[i]) + 1,
+                d,
+                w,
+            )
+        )
+    for i in range(snap.mp_subj.shape[0]):
+        w = NO_EXP if snap.mp_exp[i] == 0 else int(snap.mp_exp[i])
+        d = w if snap.mp_caveat[i] == 0 else int(NEVER)
+        edges.append(
+            (
+                int(snap.mp_subj[i]) * S1 + int(snap.mp_srel[i]) + 1,
+                int(snap.mp_res[i]) * S1 + int(snap.mp_rel[i]) + 1,
+                d,
+                w,
+            )
+        )
+    best = {}  # (src, dst) -> [d, p]
+    sources = {e[0] for e in edges}
+    # Bellman-Ford-style relaxation from each source
+    for s in sources:
+        vals = {s: (NO_EXP, NO_EXP)}  # node -> (d, p) best value from s
+        changed = True
+        while changed:
+            changed = False
+            for (a, b, d, p) in edges:
+                if a in vals:
+                    nd = min(vals[a][0], d)
+                    np_ = min(vals[a][1], p)
+                    od, op = vals.get(b, (NEVER, NEVER))
+                    if nd > od or np_ > op:
+                        vals[b] = (max(nd, od), max(np_, op))
+                        changed = True
+        for dst, (d, p) in vals.items():
+            if dst != s:
+                best[(s, dst)] = (d, p)
+    return best
+
+
+def closure_dict(idx, num_slots):
+    S1 = num_slots + 1
+    out = {}
+    for i in range(idx.num_pairs):
+        src = int(idx.c_src[i]) * S1 + int(idx.c_srel1[i])
+        dst = int(idx.c_g[i]) * S1 + int(idx.c_grel[i]) + 1
+        out[(src, dst)] = (int(idx.c_d_until[i]), int(idx.c_p_until[i]))
+    return out
+
+
+def check_world(rels, schema=SCHEMA, **kw):
+    cs = compile_schema(parse_schema(schema))
+    from gochugaru_tpu.store.interner import Interner
+
+    snap = build_snapshot(1, cs, Interner(), rels, epoch_us=EPOCH_US)
+    idx = build_closure(snap, **kw)
+    assert idx.ovf_src.shape[0] == 0 or kw, "unexpected overflow"
+    got = closure_dict(idx, snap.num_slots)
+    want = brute_closure(snap)
+    assert got == want
+    return idx, snap
+
+
+def test_direct_membership():
+    check_world(
+        [
+            rel("group:eng", "member", "user:alice"),
+            rel("group:eng", "member", "user:bob"),
+            rel("doc:d1", "reader", "group:eng", "member"),
+        ]
+    )
+
+
+def test_nested_groups_three_deep():
+    check_world(
+        [
+            rel("group:a", "member", "user:u1"),
+            rel("group:b", "member", "group:a", "member"),
+            rel("group:c", "member", "group:b", "member"),
+            rel("doc:d", "reader", "group:c", "member"),
+        ]
+    )
+
+
+def test_cyclic_groups_terminate():
+    check_world(
+        [
+            rel("group:a", "member", "user:u1"),
+            rel("group:b", "member", "group:a", "member"),
+            rel("group:a", "member", "group:b", "member"),
+            rel("doc:d", "reader", "group:b", "member"),
+            rel("doc:d", "reader", "group:a", "member"),
+        ]
+    )
+
+
+def test_caveated_edge_definite_never():
+    idx, snap = check_world(
+        [
+            rel("group:a", "other", "user:u1", caveat="c1"),
+            rel("group:b", "member", "group:a", "other"),
+            rel("doc:d", "reader", "group:b", "member"),
+        ]
+    )
+    # the caveated seed makes every pair from u1 possible-only
+    S1 = snap.num_slots + 1
+    d = closure_dict(idx, snap.num_slots)
+    u1 = snap.interner.lookup("user", "u1") * S1
+    vals = [v for (s, _), v in d.items() if s == u1]
+    assert vals and all(dv == NEVER and pv == NO_EXP for dv, pv in vals)
+
+
+def test_expiring_edge_semiring():
+    idx, snap = check_world(
+        [
+            rel("group:a", "member", "user:u1", exp_s=500),
+            rel("group:b", "member", "group:a", "member", exp_s=1000),
+            # second, longer-lived path to b
+            rel("group:c", "member", "user:u1"),
+            rel("group:b", "member", "group:c", "member", exp_s=800),
+            rel("doc:d", "reader", "group:b", "member"),
+        ]
+    )
+    S1 = snap.num_slots + 1
+    d = closure_dict(idx, snap.num_slots)
+    u1 = snap.interner.lookup("user", "u1") * S1
+    b = snap.interner.lookup("group", "b")
+    member = snap.compiled.slot_of_name["member"]
+    # path via a: min(500, 1000) = 500; via c: min(inf, 800) = 800 → max 800
+    assert d[(u1, b * S1 + member + 1)] == (800, 800)
+
+
+def test_wildcard_subject_is_ordinary_source():
+    idx, snap = check_world(
+        [
+            rel("group:a", "member", "user:*"),
+            rel("group:b", "member", "group:a", "member"),
+            rel("doc:d", "reader", "group:b", "member"),
+        ]
+    )
+    S1 = snap.num_slots + 1
+    d = closure_dict(idx, snap.num_slots)
+    wc = snap.interner.lookup("user", "*") * S1
+    b = snap.interner.lookup("group", "b")
+    member = snap.compiled.slot_of_name["member"]
+    assert d[(wc, b * S1 + member + 1)] == (int(NO_EXP), int(NO_EXP))
+
+
+def test_per_source_cap_overflow():
+    rels = [rel("group:big", "member", "user:u0")]
+    # u0 belongs to 40 groups transitively; cap at 8 → u0 overflows
+    for i in range(40):
+        rels.append(rel(f"group:g{i}", "member", "group:big", "member"))
+        rels.append(rel("doc:d", "reader", f"group:g{i}", "member"))
+    rels.append(rel("doc:d", "reader", "group:big", "member"))
+    cs = compile_schema(parse_schema(SCHEMA))
+    from gochugaru_tpu.store.interner import Interner
+
+    snap = build_snapshot(1, cs, Interner(), rels, epoch_us=EPOCH_US)
+    idx = build_closure(snap, per_source_cap=8)
+    S1 = snap.num_slots + 1
+    ovf = {
+        int(idx.ovf_src[i]) * S1 + int(idx.ovf_srel1[i])
+        for i in range(idx.ovf_src.shape[0])
+    }
+    u0 = snap.interner.lookup("user", "u0") * S1
+    big = snap.interner.lookup("group", "big")
+    member = snap.compiled.slot_of_name["member"]
+    assert u0 in ovf  # user inherits the overflow
+    assert big * S1 + member + 1 in ovf  # the pair source itself
+    # no partial rows for overflowed sources survive
+    d = closure_dict(idx, snap.num_slots)
+    assert not any(s in ovf for (s, _) in d)
+
+
+def test_random_worlds_match_brute_force():
+    rng = np.random.default_rng(7)
+    for trial in range(8):
+        n_users, n_groups = 6, 8
+        rels = []
+        for g in range(n_groups):
+            for u in rng.choice(n_users, 2, replace=False):
+                kw = {}
+                r = int(rng.integers(0, 4))
+                if r == 1:
+                    kw["caveat"] = "c1"
+                    rels.append(
+                        rel(f"group:g{g}", "other", f"user:u{u}", **kw)
+                    )
+                    continue
+                if r == 2:
+                    kw["exp_s"] = int(rng.integers(1, 1000))
+                rels.append(rel(f"group:g{g}", "member", f"user:u{u}", **kw))
+        for _ in range(6):
+            a, b = rng.choice(n_groups, 2, replace=False)
+            kw = {}
+            if rng.integers(0, 3) == 0:
+                kw["exp_s"] = int(rng.integers(1, 1000))
+            rels.append(
+                rel(f"group:g{a}", "member", f"group:g{b}", "member", **kw)
+            )
+        for g in range(n_groups):
+            rels.append(rel("doc:d", "reader", f"group:g{g}", "member"))
+            rels.append(rel("doc:d", "reader", f"group:g{g}", "other"))
+        check_world(rels)
+
+
+def test_empty_membership_graph():
+    idx, snap = check_world([rel("doc:d", "reader", "user:u1")])
+    assert idx.num_pairs == 0
+    assert idx.ovf_src.shape[0] == 0
+
+
+def test_self_loop_edge_no_reflexive_row():
+    # group:a#member @ group:a#member is writable; the closure must not
+    # store the reflexive pair (probes test identity directly)
+    idx, snap = check_world(
+        [
+            rel("group:a", "member", "group:a", "member"),
+            rel("group:a", "member", "user:u1"),
+            rel("doc:d", "reader", "group:a", "member"),
+        ]
+    )
+    S1 = snap.num_slots + 1
+    a = snap.interner.lookup("group", "a")
+    member = snap.compiled.slot_of_name["member"]
+    key = a * S1 + member + 1
+    d = closure_dict(idx, snap.num_slots)
+    assert (key, key) not in d
+
+
+def test_max_hops_exhaustion_overflows_not_silently_wrong():
+    # 5-deep chain with max_hops=1: unconverged sources must land in the
+    # overflow set (host-oracle fallback), never silently miss pairs
+    rels = [rel("group:g0", "member", "user:u0")]
+    for i in range(1, 6):
+        rels.append(rel(f"group:g{i}", "member", f"group:g{i-1}", "member"))
+        rels.append(rel("doc:d", "reader", f"group:g{i}", "member"))
+    rels.append(rel("doc:d", "reader", "group:g0", "member"))
+    cs = compile_schema(parse_schema(SCHEMA))
+    from gochugaru_tpu.store.interner import Interner
+
+    snap = build_snapshot(1, cs, Interner(), rels, epoch_us=EPOCH_US)
+    idx = build_closure(snap, max_hops=1)
+    S1 = snap.num_slots + 1
+    ovf = {
+        int(idx.ovf_src[i]) * S1 + int(idx.ovf_srel1[i])
+        for i in range(idx.ovf_src.shape[0])
+    }
+    got = closure_dict(idx, snap.num_slots)
+    want = brute_closure(snap)
+    # every missing or divergent pair belongs to an overflowed source
+    for (s, dsts), v in want.items():
+        if got.get((s, dsts)) != v:
+            assert s in ovf, (s, dsts, v, got.get((s, dsts)))
+    # and no overflowed source has partial rows
+    assert not any(s in ovf for (s, _) in got)
