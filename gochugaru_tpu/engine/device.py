@@ -611,6 +611,9 @@ class DeviceSnapshot:
     #: string-intern pool for caveat context values (literals + stored
     #: context strings); query-time strings outside it get negative ids
     strings: Optional[Dict[str, int]] = None
+    #: static geometry of the flat engine's hash/closure tables (None when
+    #: the flat kernel is disabled); see engine/flat.py
+    flat_meta: Optional[Any] = None
 
 
 class DeviceEngine:
@@ -629,6 +632,8 @@ class DeviceEngine:
         self._fn = _make_check_fn(
             self.plan, self.config, caveat_plan=self.caveat_plan
         )
+        #: flat-kernel cache: (slots tuple, FlatMeta) → jitted fn
+        self._flat_fns: Dict[Any, Any] = {}
 
     #: every per-edge/lookup column _host_arrays emits (the sharded engine
     #: derives its shard_map specs from this — keep in lockstep, enforced
@@ -720,6 +725,14 @@ class DeviceEngine:
         arrays = self._host_arrays(snap)
         ectx, strings = self._ectx_tables(snap)
         arrays.update(ectx)
+        flat_meta = None
+        if self.config.use_flat:
+            from .flat import build_flat_arrays
+
+            built = build_flat_arrays(snap, self.config)
+            if built is not None:  # unpackable graphs use the legacy path
+                flat_arrays, flat_meta = built
+                arrays.update(flat_arrays)
         arrays = {k: jnp.asarray(v) for k, v in arrays.items()}
         tid_map = np.full(max(self.plan.num_schema_types, 1), -1, dtype=np.int32)
         for tname, tid in self.compiled.type_ids.items():
@@ -730,6 +743,7 @@ class DeviceEngine:
             tid_map=jnp.asarray(tid_map),
             snapshot=snap,
             strings=strings,
+            flat_meta=flat_meta,
         )
 
     # -- query lowering --------------------------------------------------
@@ -833,6 +847,94 @@ class DeviceEngine:
             "host": padrows(table.host),
         }
 
+    # -- flat-kernel plumbing (engine/flat.py) ---------------------------
+    #: bound on cached per-permission-subset kernels (simple FIFO eviction:
+    #: a pathological workload cycling through C(P, ≤8) subsets pays
+    #: recompiles but can't grow device/host memory without bound)
+    FLAT_FN_CACHE_MAX = 16
+
+    def _flat_fn_for(self, slots: Tuple[int, ...], meta):
+        key = (slots, meta)
+        fn = self._flat_fns.get(key)
+        if fn is None:
+            from .flat import make_flat_fn
+
+            fn = make_flat_fn(
+                self.compiled, self.plan, self.config, meta, slots,
+                caveat_plan=self.caveat_plan,
+            )
+            while len(self._flat_fns) >= self.FLAT_FN_CACHE_MAX:
+                self._flat_fns.pop(next(iter(self._flat_fns)))
+            self._flat_fns[key] = fn
+        return fn
+
+    def flat_fn_and_args(
+        self,
+        dsnap: DeviceSnapshot,
+        queries: Dict[str, np.ndarray],
+        qctx: Dict[str, np.ndarray],
+        now,
+        B: int,
+        jit: bool = True,
+    ):
+        """The flat kernel + its lowered padded argument tuple — the ONE
+        place that knows the kernel's signature (check paths, bench.py and
+        __graft_entry__ all call this).  None when the flat path is
+        unavailable (disabled, unpackable graph, or more distinct
+        permissions in the batch than flat_max_slots)."""
+        if dsnap.flat_meta is None:
+            return None
+        slots = tuple(
+            sorted({int(s) for s in np.unique(queries["q_perm"]) if s >= 0})
+        )
+        if len(slots) > self.config.flat_max_slots:
+            return None
+        if jit:
+            fn = self._flat_fn_for(slots, dsnap.flat_meta)
+        else:
+            from .flat import make_flat_fn
+
+            fn = make_flat_fn(
+                self.compiled, self.plan, self.config, dsnap.flat_meta,
+                slots, caveat_plan=self.caveat_plan, jit=False,
+            )
+        BP = _ceil_pow2(B, self.config.batch_bucket_min)
+
+        def padq(a, fill):
+            a = np.asarray(a)
+            out = np.full(BP, fill, a.dtype)
+            out[:B] = a
+            return jnp.asarray(out)
+
+        q_srel1 = np.where(
+            queries["q_srel"] >= 0, queries["q_srel"] + 1, 0
+        ).astype(np.int32)
+        args = (
+            dsnap.arrays, dsnap.tid_map, now,
+            padq(queries["q_res"], -1), padq(queries["q_perm"], -1),
+            padq(queries["q_subj"], -1), padq(q_srel1, 0),
+            padq(queries["q_wc"], -1), padq(queries["q_ctx"], -1),
+            padq(queries["q_self"], False),
+            {k: jnp.asarray(v) for k, v in qctx.items()},
+        )
+        return fn, args
+
+    def _flat_call(
+        self,
+        dsnap: DeviceSnapshot,
+        queries: Dict[str, np.ndarray],
+        qctx: Dict[str, np.ndarray],
+        now,
+        B: int,
+    ):
+        """Dispatch the flat kernel; returns padded device (d, p, ovf), or
+        None when the flat path is unavailable."""
+        got = self.flat_fn_and_args(dsnap, queries, qctx, now, B)
+        if got is None:
+            return None
+        fn, args = got
+        return fn(*args)
+
     # -- the batched check ----------------------------------------------
     def check_batch(
         self,
@@ -853,6 +955,11 @@ class DeviceEngine:
         snap = dsnap.snapshot
         queries, uniq, qctx = self._lower_queries(snap, rels, dsnap.strings)
         B = len(rels)
+        now_flat = jnp.int32(snap.now_rel32(now_us))
+        out = self._flat_call(dsnap, queries, qctx, now_flat, B)
+        if out is not None:
+            d, p, ovf = jax.device_get(out)
+            return d[:B], p[:B], ovf[:B]
         BP = _ceil_pow2(B, self.config.batch_bucket_min)
         U = uniq.shape[0]
         UP = _ceil_pow2(U, self.config.batch_bucket_min)
@@ -959,6 +1066,13 @@ class DeviceEngine:
         queries, qctx = self._columns_preamble(
             dsnap, q_res, q_perm, q_subj, q_srel, q_wc, q_ctx, qctx_rows
         )
+        now_flat = jnp.int32(snap.now_rel32(now_us))
+        out = self._flat_call(dsnap, queries, qctx, now_flat, B)
+        if out is not None:
+            if not fetch:
+                return out
+            d, p, ovf = jax.device_get(out)
+            return d[:B], p[:B], ovf[:B]
         q_res, q_perm, q_subj = queries["q_res"], queries["q_perm"], queries["q_subj"]
         q_srel, q_wc, q_ctx = queries["q_srel"], queries["q_wc"], queries["q_ctx"]
         q_self = queries["q_self"]

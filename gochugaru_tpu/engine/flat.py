@@ -1,0 +1,601 @@
+"""The flat check kernel: statically-unrolled probe programs over hash
+indexes and the precomputed membership closure.
+
+This is the TPU-shaped replacement for the two-phase walk in
+engine/device.py.  The round-2 engine was correct everywhere and fast
+nowhere (~16k checks/sec true device rate): per query it ran a capped
+frontier walk with device-side sort/dedup (Phase A) plus a sequential
+scan-based subgraph BFS (Phase B) — hundreds of *dependent* scalar steps
+per check.  The flat kernel removes every per-query loop:
+
+- **membership** is precomputed: store/closure.py flattens the transitive
+  member→group closure once per revision; a userset grant test is one
+  4-key hash probe into the flattened table (engine/hash.py);
+- **rewrite structure** is unrolled at trace time: each permission's
+  expression tree becomes straight-line code; arrows gather a capped,
+  hash-indexed child block and recurse on the child axis (acyclic schemas
+  unroll exactly; recursive ones unroll to a budget and mark deeper
+  queries possible → host oracle);
+- every probe site is a batch-wide vectorized gather: the whole dispatch
+  is ~a few hundred *data-independent* gather/compare steps regardless of
+  batch size, so throughput scales with batch until HBM bandwidth.
+
+Semantics are identical to the legacy engine (differentially tested
+against engine/oracle.py): two Kleene planes (definite, possible),
+caveats gated per edge through the on-device CEL VM with merged
+stored/query context, expiration via the closure's max-min semiring at
+membership level and per-edge gates at leaf level, wildcard and userset
+subjects, permission-valued userset conservatism (us_perm/pus), and
+overflow flags that route capped queries to the host oracle.  The one
+intentional degradation: caveats on *membership* edges decide closure
+containment per query on the host (possible-plane), because the closure
+is precomputed without query context.
+
+Replaces the evaluation behind the reference's CheckBulkPermissions
+(client/client.go:238-266).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..schema.compiler import CompiledSchema
+from .hash import (
+    _ceil_pow2,
+    build_hash,
+    build_range_hash,
+    probe_range,
+    probe_rows,
+)
+from .plan import DevicePlan, EngineConfig, ExprIR, _eval_cyclic_pairs
+
+
+# ---------------------------------------------------------------------------
+# static metadata (part of the traced-function cache key)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FlatMeta:
+    """Static per-snapshot table geometry the kernel closes over.
+
+    Keys are PACKED into ≤2 int32 columns (``N``/``S1`` radices) — every
+    probe step then costs 3 gathers (rows + 2 keys) instead of 5, and
+    range probes cost 2.  Graphs too large to pack (num_nodes·num_slots ≥
+    2³¹) skip the flat engine and use the legacy two-phase kernel."""
+
+    N: int  # node-id radix (num_nodes)
+    S1: int  # num_slots + 1 (srel1 radix)
+    e_cap: int
+    e_n: int
+    usr_cap: int  # userset (rel, res) range-group table
+    usr_gn: int
+    us_rows: int
+    arr_cap: int  # arrow (rel, res) range-group table
+    arr_gn: int
+    ar_rows: int
+    cl_cap: int  # flattened closure pair table
+    cl_n: int
+    pus_cap: int
+    pus_n: int
+    ovf_cap: int  # closure-overflow source table
+    ovf_n: int
+    #: ((rel_slot, max_fanout_pow2), ...) actual max children per (slot,
+    #: resource) in the arrow view — folder trees have 1 parent, so the
+    #: unrolled lattice stays narrow regardless of the config cap
+    ar_fanout_by_slot: Tuple[Tuple[int, int], ...] = ()
+    #: per-view "any caveated rows" / "any expiring rows" flags: views
+    #: without them compile trivial gates (no CEL VM, no expiry gathers)
+    e_hascav: bool = False
+    e_hasexp: bool = False
+    us_hascav: bool = False
+    us_hasexp: bool = False
+    ar_hascav: bool = False
+    ar_hasexp: bool = False
+    #: slots with ≥1 row in the primary / userset views — leaf code for a
+    #: slot with no data compiles to nothing
+    e_slots: Tuple[int, ...] = ()
+    us_slots: Tuple[int, ...] = ()
+    #: any wildcard-subject edges at all / any wildcard closure sources —
+    #: both False in most worlds, erasing the wildcard probe sites
+    has_wc_edges: bool = False
+    has_wc_closure: bool = False
+    #: ((rel_slot, max_userset_edges_pow2), ...) actual max userset grants
+    #: per (slot, resource) — org⟶2 teams means 2 closure probes, not the
+    #: config cap of 8
+    us_fanout_by_slot: Tuple[Tuple[int, int], ...] = ()
+
+
+def _round_cap(c: int) -> int:
+    for p in (1, 2, 4, 8, 16, 32):
+        if c <= p:
+            return p
+    return c
+
+
+def _pad(a: np.ndarray, size: int, fill) -> np.ndarray:
+    out = np.full(size, fill, np.int32)
+    out[: a.shape[0]] = a
+    return out
+
+
+def build_flat_arrays(
+    snap, config: EngineConfig
+) -> Optional[Tuple[Dict[str, np.ndarray], FlatMeta]]:
+    """Hash-index the snapshot + flatten its membership closure.  Returns
+    padded host arrays (merged into DeviceSnapshot.arrays) and the static
+    FlatMeta — or None when keys don't pack into int32 (num_nodes ·
+    num_slots ≥ 2³¹; such graphs use the legacy engine)."""
+    from ..store.closure import NEVER, build_closure
+
+    N = max(snap.num_nodes, 1)
+    S1 = snap.num_slots + 1
+    if N * snap.num_slots >= 2**31 or N * S1 >= 2**31:
+        return None
+
+    cl = build_closure(snap, per_source_cap=config.closure_source_cap)
+
+    def pk(a, radix, b):
+        return (a.astype(np.int64) * radix + b).astype(np.int32)
+
+    e_k1 = pk(snap.e_rel, N, snap.e_res)
+    e_k2 = pk(snap.e_subj, S1, snap.e_srel1)
+    us_gk = pk(snap.us_rel, N, snap.us_res)
+    ar_gk = pk(snap.ar_rel, N, snap.ar_res)
+    cl_k1 = pk(cl.c_src, S1, cl.c_srel1)
+    cl_k2 = pk(cl.c_g, S1, cl.c_grel + 1)
+    pus_k = pk(snap.pus_n, S1, snap.pus_r + 1)
+    ovf_k = pk(cl.ovf_src, S1, cl.ovf_srel1)
+
+    eh = build_hash([e_k1, e_k2])
+    usr = build_range_hash(us_gk)
+    arr = build_range_hash(ar_gk)
+    clh = build_hash([cl_k1, cl_k2])
+    push = build_hash([pus_k])
+    ovfh = build_hash([ovf_k])
+
+    out: Dict[str, np.ndarray] = {}
+
+    def put_hash(prefix: str, h) -> None:
+        # off keeps its exact size+1 length: the device probe derives the
+        # bucket mask from off.shape[0] - 1, which must equal the build
+        # size (a pow2 already, so shapes stay bucketed for jit)
+        out[prefix + "_off"] = h.off
+        out[prefix + "_rows"] = _pad(h.rows, _ceil_pow2(h.rows.shape[0]), 0)
+
+    def put_range(prefix: str, r) -> None:
+        G = _ceil_pow2(max(r.gk.shape[0], 1))
+        out[prefix + "_gk"] = _pad(r.gk, G, -1)
+        out[prefix + "_glo"] = _pad(r.glo, G, 0)
+        out[prefix + "_ghi"] = _pad(r.ghi, G, 0)
+        put_hash(prefix, r.index)
+
+    put_hash("eh", eh)
+    put_range("usr", usr)
+    put_range("arr", arr)
+    put_hash("clh", clh)
+    put_hash("push", push)
+    put_hash("ovfh", ovfh)
+
+    E = _ceil_pow2(max(e_k1.shape[0], 1))
+    out["e_k1"] = _pad(e_k1, E, -1)
+    out["e_k2"] = _pad(e_k2, E, -1)
+    P = _ceil_pow2(max(cl.num_pairs, 1))
+    out["cl_k1"] = _pad(cl_k1, P, -1)
+    out["cl_k2"] = _pad(cl_k2, P, -1)
+    out["cl_d_until"] = _pad(cl.c_d_until, P, NEVER)
+    out["cl_p_until"] = _pad(cl.c_p_until, P, NEVER)
+    out["pus_k"] = _pad(pus_k, _ceil_pow2(max(pus_k.shape[0], 1)), -1)
+    out["ovf_k"] = _pad(ovf_k, _ceil_pow2(max(ovf_k.shape[0], 1)), -1)
+
+    wc_nodes = snap.wildcard_node_of_type[snap.wildcard_node_of_type >= 0]
+
+    def run_maxes(gk: np.ndarray, glo: np.ndarray, ghi: np.ndarray):
+        """Per-slot max run length of a packed (slot·N + res) range index
+        (pow2-bucketed so retraces are rare)."""
+        fans: Dict[int, int] = {}
+        if gk.shape[0]:
+            slots_of = gk.astype(np.int64) // N
+            lens = (ghi - glo).astype(np.int64)
+            first = np.ones(gk.shape[0], bool)
+            first[1:] = slots_of[1:] != slots_of[:-1]
+            starts = np.nonzero(first)[0]
+            for s, m in zip(slots_of[starts], np.maximum.reduceat(lens, starts)):
+                fans[int(s)] = _round_cap(int(m))
+        return fans
+
+    meta = FlatMeta(
+        N=N, S1=S1,
+        e_cap=_round_cap(eh.cap), e_n=eh.n,
+        usr_cap=_round_cap(usr.index.cap), usr_gn=usr.index.n,
+        us_rows=int(snap.us_rel.shape[0]),
+        arr_cap=_round_cap(arr.index.cap), arr_gn=arr.index.n,
+        ar_rows=int(snap.ar_rel.shape[0]),
+        cl_cap=_round_cap(clh.cap), cl_n=clh.n,
+        pus_cap=_round_cap(push.cap), pus_n=push.n,
+        ovf_cap=_round_cap(ovfh.cap), ovf_n=ovfh.n,
+        ar_fanout_by_slot=tuple(sorted(run_maxes(arr.gk, arr.glo, arr.ghi).items())),
+        us_fanout_by_slot=tuple(sorted(run_maxes(usr.gk, usr.glo, usr.ghi).items())),
+        e_hascav=bool(snap.e_caveat.any()),
+        e_hasexp=bool(snap.e_exp.any()),
+        us_hascav=bool(snap.us_caveat.any()),
+        us_hasexp=bool(snap.us_exp.any()),
+        ar_hascav=bool(snap.ar_caveat.any()),
+        ar_hasexp=bool(snap.ar_exp.any()),
+        e_slots=tuple(int(s) for s in np.unique(snap.e_rel)),
+        us_slots=tuple(int(s) for s in np.unique(snap.us_rel)),
+        has_wc_edges=bool(np.isin(snap.e_subj, wc_nodes).any()),
+        has_wc_closure=bool(
+            np.isin(cl.c_src[cl.c_srel1 == 0], wc_nodes).any()
+            or np.isin(cl.ovf_src[cl.ovf_srel1 == 0], wc_nodes).any()
+        ),
+    )
+    return out, meta
+
+
+# ---------------------------------------------------------------------------
+# kernel codegen
+# ---------------------------------------------------------------------------
+
+
+def make_flat_fn(
+    compiled: CompiledSchema,
+    plan: DevicePlan,
+    cfg: EngineConfig,
+    meta: FlatMeta,
+    slots: Tuple[int, ...],
+    caveat_plan=None,
+    jit: bool = True,
+):
+    """Build the batched flat check function for a static set of permission
+    slots.  Queries select their slot's result with a vectorized compare —
+    evaluating ≤ flat_max_slots programs over the whole batch is far
+    cheaper than any per-query dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..caveats.device import make_tri_fn
+
+    tri = make_tri_fn(caveat_plan) if caveat_plan is not None else None
+
+    perm_programs: Dict[int, List[Tuple[str, int, ExprIR]]] = {}
+    for (tname, tid, slot, expr) in plan.topo_programs:
+        perm_programs.setdefault(slot, []).append((tname, tid, expr))
+    rel_slots = frozenset(plan.rel_leaf_slots)
+    cyclic = _eval_cyclic_pairs(compiled)
+    KU = cfg.us_leaf_cap
+    K = cfg.arrow_fanout
+    all_types = frozenset(compiled.type_ids)
+    tname_of_tid = {tid: t for t, tid in compiled.type_ids.items()}
+
+    def arrow_child_types(ts_slot: int, types: frozenset) -> frozenset:
+        """Types an arrow through ``ts_slot`` can reach from ``types`` —
+        the static pruning that makes the unroll follow the TYPE-level
+        dependency graph (plan._eval_dep_graph), not name collisions."""
+        out = set()
+        for tname in types:
+            ct = compiled.types[compiled.type_ids[tname]]
+            rel = ct.relations.get(ts_slot)
+            if rel is None:
+                continue
+            for a in rel.allowed:
+                if a.relation_slot < 0:  # arrows traverse direct subjects
+                    out.add(tname_of_tid[a.type_id])
+        return frozenset(out)
+
+    def fn(arrs, tid_map, now, q_res, q_perm, q_subj, q_srel1, q_wc,
+           q_ctx, q_self, qctx):
+        if tri is not None:
+            tables = {
+                "ectx_vi": arrs["ectx_vi"], "ectx_vf": arrs["ectx_vf"],
+                "ectx_pr": arrs["ectx_pr"], "ectx_host": arrs["ectx_host"],
+                "qctx_vi": qctx["vi"], "qctx_vf": qctx["vf"],
+                "qctx_pr": qctx["pr"], "qctx_host": qctx["host"],
+            }
+        else:
+            tables = None
+        node_type = arrs["node_type"]
+        # wildcard closure-source only applies to direct-object subjects
+        q_wcc = jnp.where(q_srel1 == 0, q_wc, -1)
+
+        def bq(a, nd: int):
+            """Broadcast a [B] query column against [B, ...] node dims."""
+            return a.reshape(a.shape + (1,) * (nd - 1))
+
+        def reduceB(x):
+            return x if x.ndim == 1 else jnp.any(x, axis=tuple(range(1, x.ndim)))
+
+        _view_flags = {
+            "e": (meta.e_hascav, meta.e_hasexp),
+            "us": (meta.us_hascav, meta.us_hasexp),
+            "ar": (meta.ar_hascav, meta.ar_hasexp),
+        }
+
+        def gate2(prefix: str, rowidx, hit):
+            """(definite, possible) admissibility of the hit edges, with
+            the CEL VM evaluated ONCE per site and skipped statically for
+            views with no caveated/expiring rows."""
+            hascav, hasexp = _view_flags[prefix]
+            if not hascav and not hasexp:
+                return hit, hit
+            rc = jnp.clip(rowidx, 0, arrs[prefix + "_caveat"].shape[0] - 1)
+            live = hit
+            if hasexp:
+                exp = arrs[prefix + "_exp"][rc]
+                live = hit & ((exp == 0) | (exp > now))
+            if not hascav:
+                return live, live
+            cav = arrs[prefix + "_caveat"][rc]
+            if tri is None:
+                d = live & (cav == 0)
+                return d, live
+            ctxc = arrs[prefix + "_ctx"][rc]
+            qb = jnp.broadcast_to(bq(q_ctx, rowidx.ndim), cav.shape)
+            t = tri(cav, ctxc, qb, tables)
+            return live & (t == 2), live & (t >= 1)
+
+        def range_of(prefix: str, cap: int, n: int, q):
+            ri = {
+                k: arrs[prefix + "_" + k]
+                for k in ("gk", "glo", "ghi", "off", "rows")
+            }
+            return probe_range(ri, cap, n, q)
+
+        def cl_probe(srck, gk):
+            """Closure containment per plane via until-value comparison.
+            Keys are packed (src·S1+srel1, g·S1+grel+1); -1 never matches."""
+            if meta.cl_n == 0:
+                z = jnp.zeros(
+                    jnp.broadcast_shapes(jnp.shape(srck), jnp.shape(gk)), bool
+                )
+                return z, z
+            row = probe_rows(
+                arrs["clh_off"], arrs["clh_rows"],
+                (arrs["cl_k1"], arrs["cl_k2"]), (srck, gk),
+                meta.cl_cap, meta.cl_n,
+            )
+            rc = jnp.clip(row, 0, arrs["cl_k1"].shape[0] - 1)
+            hit = row >= 0
+            return (
+                hit & (arrs["cl_d_until"][rc] > now),
+                hit & (arrs["cl_p_until"][rc] > now),
+            )
+
+        zB = jnp.zeros(q_res.shape, bool)
+        Nc = jnp.int32(meta.N)
+        S1c = jnp.int32(meta.S1)
+        # packed per-query subject keys: -1 = "matches nothing"
+        q_k2 = jnp.where(q_subj >= 0, q_subj * S1c + q_srel1, -1)
+        w_k2 = jnp.where((q_wc >= 0) & (q_srel1 == 0), q_wc * S1c, -1)
+        wcl_k = jnp.where(q_wcc >= 0, q_wcc * S1c, -1)
+        us_fans = dict(meta.us_fanout_by_slot)
+        us_fan_max = max(us_fans.values(), default=0)
+
+        # Every eval function returns (definite, possible, ovf, used):
+        # d/p shaped like the node lattice, ovf/used reduced to [B].
+        # Compositional returns let ONE memo serve every root slot while
+        # keeping overflow attribution per query.
+
+        def leaf(slot, nodes):
+            """Direct + wildcard + userset leaf tests at a [B, ...] node
+            lattice.  ``slot`` is a static int for program-internal
+            references; ``None`` means dynamic — the query's own q_perm
+            column is the relation, so ONE probe site at the root covers
+            every slot's direct relation check."""
+            nd = nodes.ndim
+            zn = jnp.zeros(nodes.shape, bool)
+            d, p, ovf, used = zn, zn, zB, zB
+            exists = nodes >= 0
+            dyn = slot is None
+            sc = bq(q_perm, nd) if dyn else jnp.int32(slot)
+            # packed (slot, node) key; invalid nodes use 0 and are masked
+            # by `exists` wherever the (possibly aliased) probe lands
+            k1 = sc * Nc + jnp.where(exists, nodes, 0)
+
+            if (meta.e_n > 0) if dyn else (slot in meta.e_slots):
+                ecols = (arrs["e_k1"], arrs["e_k2"])
+                row = probe_rows(
+                    arrs["eh_off"], arrs["eh_rows"], ecols,
+                    (k1, bq(q_k2, nd)), meta.e_cap, meta.e_n,
+                )
+                d, p = gate2("e", row, (row >= 0) & exists)
+                if meta.has_wc_edges:
+                    # wildcard edges only grant direct-object subjects
+                    wrow = probe_rows(
+                        arrs["eh_off"], arrs["eh_rows"], ecols,
+                        (k1, bq(w_k2, nd)), meta.e_cap, meta.e_n,
+                    )
+                    wd, wp = gate2("e", wrow, (wrow >= 0) & exists)
+                    d, p = d | wd, p | wp
+
+            KU_site = min(KU, us_fan_max if dyn else us_fans.get(slot, 0))
+            if KU_site > 0:
+                # userset grants: gather the (slot, node) edge block, test
+                # each subject pair against the flattened closure
+                lo, hi = range_of("usr", meta.usr_cap, meta.usr_gn, k1)
+                ovf = ovf | reduceB(exists & ((hi - lo) > KU_site))
+                idx = lo[..., None] + jnp.arange(KU_site, dtype=jnp.int32)
+                valid = (idx < hi[..., None]) & exists[..., None]
+                used = used | reduceB(valid)
+                idxc = jnp.clip(idx, 0, max(meta.us_rows - 1, 0))
+                s = arrs["us_subj"][idxc]
+                r = arrs["us_srel"][idxc]
+                gk = s * S1c + (r + 1)  # padded rows (-1, -1) → negative
+                nd2 = nd + 1
+                in_d, in_p = cl_probe(bq(q_k2, nd2), gk)
+                if meta.has_wc_closure:
+                    win_d, win_p = cl_probe(bq(wcl_k, nd2), gk)
+                    in_d, in_p = in_d | win_d, in_p | win_p
+                refl = (gk == bq(q_k2, nd2)) & (bq(q_k2, nd2) >= 0)
+                if plan.has_permission_usersets:
+                    permf = arrs["us_perm"][idxc] != 0
+                    in_pus = probe_rows(
+                        arrs["push_off"], arrs["push_rows"],
+                        (arrs["pus_k"],), (gk,),
+                        meta.pus_cap, meta.pus_n,
+                    ) >= 0
+                    in_d = (in_d | refl) & ~permf
+                    in_p = in_p | refl | in_pus | permf
+                else:
+                    in_d = in_d | refl
+                    in_p = in_p | refl
+                ugd, ugp = gate2("us", idxc, valid)
+                d = d | jnp.any(ugd & in_d, axis=-1)
+                p = p | jnp.any(ugp & in_p, axis=-1)
+            return d, p, ovf, used
+
+        memo: Dict = {}
+        pins: List = []  # keep node arrays alive so id() keys stay unique
+
+        def eval_progs(slot: int, nodes, stack: Tuple, types) -> Tuple:
+            """The permission programs of ``slot`` at ``nodes`` (no leaf)."""
+            zn = jnp.zeros(nodes.shape, bool)
+            d, p, ovf, used = zn, zn, zB, zB
+            progs = [
+                (tname, tid, expr)
+                for (tname, tid, expr) in perm_programs.get(slot, ())
+                if tname in types
+            ]
+            if progs:
+                ntype = jnp.where(nodes >= 0, node_type[jnp.clip(nodes, 0)], -1)
+            for (tname, tid, expr) in progs:
+                mask = ntype == tid_map[tid]
+                if (tname, slot) in cyclic and stack.count(
+                    (tname, slot)
+                ) >= cfg.flat_recursion:
+                    # recursion budget exhausted: deeper evaluation is
+                    # unknown → possible-only, the host oracle finishes it
+                    p = p | (mask & (nodes >= 0))
+                    continue
+                ed, ep, eo, eu = eval_expr(
+                    expr, nodes, stack + ((tname, slot),), frozenset((tname,))
+                )
+                d = d | (mask & ed)
+                p = p | (mask & ep)
+                ovf, used = ovf | eo, used | eu
+            return d, p, ovf, used
+
+        def eval_slot(slot: int, nodes, stack: Tuple, types) -> Tuple:
+            cyc_sig = tuple(
+                sorted((pr, stack.count(pr)) for pr in set(stack) if pr in cyclic)
+            )
+            key = (slot, id(nodes), types, cyc_sig)
+            got = memo.get(key)
+            if got is not None:
+                return got
+            zn = jnp.zeros(nodes.shape, bool)
+            d, p, ovf, used = zn, zn, zB, zB
+            if slot in rel_slots:
+                d, p, ovf, used = leaf(slot, nodes)
+            pd, pp, po, pu = eval_progs(slot, nodes, stack, types)
+            d, p = d | pd, p | pp
+            ovf, used = ovf | po, used | pu
+            pins.append(nodes)
+            memo[key] = (d, p, ovf, used)
+            return memo[key]
+
+        def eval_expr(ir: ExprIR, nodes, stack: Tuple, types) -> Tuple:
+            tag = ir[0]
+            if tag == "ref":
+                return eval_slot(ir[1], nodes, stack, types)
+            if tag == "nil":
+                z = jnp.zeros(nodes.shape, bool)
+                return z, z, zB, zB
+            if tag == "arrow":
+                ts_slot = plan.ts_slots[ir[1]]
+                child_types = arrow_child_types(ts_slot, types)
+                data_fan = dict(meta.ar_fanout_by_slot).get(ts_slot, 0)
+                if not child_types or data_fan == 0:
+                    # no reachable types / no edges of this tupleset at all
+                    z = jnp.zeros(nodes.shape, bool)
+                    return z, z, zB, zB
+                Ks = min(K, data_fan)
+                exists = nodes >= 0
+                ak = jnp.int32(ts_slot) * Nc + jnp.where(exists, nodes, 0)
+                lo, hi = range_of("arr", meta.arr_cap, meta.arr_gn, ak)
+                width = 1
+                for dim in nodes.shape[1:]:
+                    width *= dim
+                if width * Ks > cfg.flat_max_width:
+                    # lattice budget spent: don't expand — probe child
+                    # existence only; real deeper grants surface as
+                    # possible and resolve on the host oracle
+                    return (
+                        jnp.zeros(nodes.shape, bool),
+                        (hi > lo) & exists,
+                        zB, zB,
+                    )
+                ovf = reduceB(exists & ((hi - lo) > Ks))
+                idx = lo[..., None] + jnp.arange(Ks, dtype=jnp.int32)
+                valid = (idx < hi[..., None]) & exists[..., None]
+                idxc = jnp.clip(idx, 0, max(meta.ar_rows - 1, 0))
+                children = jnp.where(valid, arrs["ar_child"][idxc], -1)
+                gd, gp = gate2("ar", idxc, valid)
+                cd, cp, co, cu = eval_slot(ir[2], children, stack, child_types)
+                return (
+                    jnp.any(cd & gd, axis=-1),
+                    jnp.any(cp & gp, axis=-1),
+                    ovf | co,
+                    cu,
+                )
+            if tag == "union":
+                z = jnp.zeros(nodes.shape, bool)
+                d, p, ovf, used = z, z, zB, zB
+                for c in ir[1]:
+                    cd, cp, co, cu = eval_expr(c, nodes, stack, types)
+                    d, p = d | cd, p | cp
+                    ovf, used = ovf | co, used | cu
+                return d, p, ovf, used
+            if tag == "inter":
+                o = jnp.ones(nodes.shape, bool)
+                d, p, ovf, used = o, o, zB, zB
+                for c in ir[1]:
+                    cd, cp, co, cu = eval_expr(c, nodes, stack, types)
+                    d, p = d & cd, p & cp
+                    ovf, used = ovf | co, used | cu
+                return d, p, ovf, used
+            if tag == "excl":
+                bd, bp, bo, bu = eval_expr(ir[1], nodes, stack, types)
+                sd, sp, so, su = eval_expr(ir[2], nodes, stack, types)
+                return bd & ~sp, bp & ~sd, bo | so, bu | su
+            raise TypeError(f"bad expression IR {ir!r}")
+
+        # subject-closure overflow: the flattened table is incomplete for
+        # these sources, so any query that touched a userset probe falls
+        # back to the host oracle
+        if meta.ovf_n == 0:
+            q_cl_ovf = zB
+        else:
+            def ovf_probe(k):
+                return probe_rows(
+                    arrs["ovfh_off"], arrs["ovfh_rows"],
+                    (arrs["ovf_k"],), (k,), meta.ovf_cap, meta.ovf_n,
+                ) >= 0
+
+            q_cl_ovf = ovf_probe(q_k2) | ovf_probe(wcl_k)
+
+        valid_q = (q_res >= 0) & (q_perm >= 0)
+        # one dynamic-slot leaf site answers every query whose permission
+        # is (also) a stored relation; per-slot work below is programs only
+        if meta.e_n > 0 or meta.us_rows > 0:
+            d_out, p_out, lovf, lused = leaf(None, q_res)
+            ovf_out = lovf | (q_cl_ovf & lused)
+        else:
+            d_out, p_out, ovf_out = zB, zB, zB
+        for slot in slots:
+            if not perm_programs.get(slot):
+                continue
+            sd, sp, so, su = eval_progs(int(slot), q_res, (), all_types)
+            sel = q_perm == slot
+            d_out = d_out | (sel & sd)
+            p_out = p_out | (sel & sp)
+            ovf_out = ovf_out | (sel & (so | (q_cl_ovf & su)))
+
+        d_out = (d_out & valid_q) | q_self
+        p_out = (p_out & valid_q) | q_self
+        return d_out, p_out, ovf_out & ~q_self
+
+    return jax.jit(fn) if jit else fn

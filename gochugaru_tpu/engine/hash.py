@@ -1,0 +1,201 @@
+"""Bucketed hash indexes: host-built, device-probed in O(bucket cap).
+
+The round-2 engine answered every exact-match question with a ~17-step
+lexicographic binary search (engine/device.py _lex_search) — 17 dependent
+scalar gathers per probe is exactly the memory-latency-bound pattern TPUs
+hate.  A bucketed hash index answers the same question in ``cap`` (usually
+≤ 4) data-independent steps: hash the key, gather the bucket's row-index
+range, compare ``cap`` candidate rows.  Every step is a full-batch-wide
+vectorized gather, so XLA emits a handful of fused gather/compare ops per
+probe site regardless of table size.
+
+Layout (host build, all vectorized numpy):
+- keys live in the caller's existing sorted int32 columns (NOT copied —
+  the index stores only a permutation, halving HBM at 100M edges);
+- ``rows`` is the permutation grouping row indices by bucket;
+- ``off[b]:off[b+1]`` delimits bucket ``b``'s slice of ``rows``;
+- ``cap`` is the true max bucket size; the build doubles the table until
+  ``cap`` ≤ ``target_cap`` (duplicate full keys bound this from below, so
+  growth stops at ``max_factor`` × entries and accepts the larger cap).
+
+The device probe recomputes the same 32-bit mix (mix32 is written against
+the array-API surface shared by numpy and jax.numpy, so host and device
+hashes agree bit-for-bit) and unrolls ``cap`` gather+compare steps.
+
+No reference counterpart: gochugaru delegates lookups to SpiceDB's
+datastore indexes (client/client.go:238-266); this is their on-device
+replacement.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence, Tuple
+
+import numpy as np
+
+_FNV_OFFSET = 2166136261
+_FNV_PRIME = 16777619
+
+
+def mix32(cols: Sequence, xp=np):
+    """FNV-1a over int32 words + murmur3 finalizer, in uint32 wrap-around
+    arithmetic.  Identical on numpy and jax.numpy inputs."""
+    h = xp.uint32(_FNV_OFFSET)
+    for c in cols:
+        h = (h ^ c.astype(xp.uint32)) * xp.uint32(_FNV_PRIME)
+    h = h ^ (h >> xp.uint32(16))
+    h = h * xp.uint32(0x85EBCA6B)
+    h = h ^ (h >> xp.uint32(13))
+    h = h * xp.uint32(0xC2B2AE35)
+    h = h ^ (h >> xp.uint32(16))
+    return h
+
+
+@dataclass
+class HashIndex:
+    """Bucket offsets + row permutation over the caller's key columns."""
+
+    off: np.ndarray  # int32[size + 1]
+    rows: np.ndarray  # int32[max(n, 1)]
+    size: int  # pow2 bucket count
+    cap: int  # max bucket occupancy (device probe unroll count)
+    n: int  # number of entries
+
+
+def _ceil_pow2(n: int, minimum: int = 8) -> int:
+    m = minimum
+    while m < n:
+        m <<= 1
+    return m
+
+
+def build_hash(
+    key_cols: Sequence[np.ndarray],
+    *,
+    target_cap: int = 4,
+    min_size: int = 8,
+    max_factor: int = 8,
+) -> HashIndex:
+    """Index the rows of lock-step int32 key columns by hash bucket."""
+    n = int(key_cols[0].shape[0]) if key_cols else 0
+    if n == 0:
+        size = min_size
+        return HashIndex(
+            off=np.zeros(size + 1, np.int32),
+            rows=np.zeros(1, np.int32),
+            size=size,
+            cap=1,
+            n=0,
+        )
+    cols = [np.ascontiguousarray(c, np.int32) for c in key_cols]
+    h_full = mix32(cols, np)
+    size = _ceil_pow2(2 * n, min_size)
+    while True:
+        h = (h_full & np.uint32(size - 1)).astype(np.int64)
+        counts = np.bincount(h, minlength=size)
+        cap = int(counts.max())
+        if cap <= target_cap or size >= max_factor * _ceil_pow2(2 * n, min_size):
+            break
+        size <<= 1
+    rows = np.argsort(h, kind="stable").astype(np.int32)
+    off = np.zeros(size + 1, np.int64)
+    np.cumsum(counts, out=off[1:])
+    return HashIndex(
+        off=off.astype(np.int32), rows=rows, size=size, cap=cap, n=n
+    )
+
+
+@dataclass
+class RangeIndex:
+    """key → contiguous row range [lo, hi) in a key-sorted table.
+
+    The group keys/bounds are materialized per distinct key and themselves
+    hash-indexed, so a range lookup is one 1-column probe + two payload
+    gathers instead of two binary searches."""
+
+    gk: np.ndarray  # int32[G] distinct keys
+    glo: np.ndarray  # int32[G] range start in the underlying table
+    ghi: np.ndarray  # int32[G] range end
+    index: HashIndex  # over gk
+
+    @property
+    def max_run(self) -> int:
+        return int((self.ghi - self.glo).max()) if self.gk.shape[0] else 0
+
+
+def build_range_hash(k: np.ndarray, **kw) -> RangeIndex:
+    """Build a RangeIndex over a column already sorted ascending."""
+    n = int(k.shape[0])
+    if n == 0:
+        z = np.zeros(0, np.int32)
+        return RangeIndex(gk=z, glo=z, ghi=z, index=build_hash([]))
+    first = np.ones(n, bool)
+    first[1:] = k[1:] != k[:-1]
+    starts = np.nonzero(first)[0]
+    ends = np.concatenate([starts[1:], np.asarray([n])])
+    gk = np.ascontiguousarray(k[starts], np.int32)
+    return RangeIndex(
+        gk=gk,
+        glo=starts.astype(np.int32),
+        ghi=ends.astype(np.int32),
+        index=build_hash([gk], **kw),
+    )
+
+
+# ---------------------------------------------------------------------------
+# device-side probes (traced; arrays may be jnp, shapes arbitrary)
+# ---------------------------------------------------------------------------
+
+
+def _probe_rows_impl(off, rows, key_cols, q_cols, cap: int, n: int):
+    import jax.numpy as jnp
+
+    size = off.shape[0] - 1
+    h = (mix32(q_cols, jnp) & jnp.uint32(size - 1)).astype(jnp.int32)
+    start = off[h]
+    end = off[h + 1]
+    found = jnp.full(jnp.shape(h), -1, jnp.int32)
+    last = max(n - 1, 0)
+    for j in range(cap):
+        slot = start + j
+        valid = slot < end
+        idx = rows[jnp.clip(slot, 0, last)]
+        hit = valid
+        for kc, qc in zip(key_cols, q_cols):
+            hit = hit & (kc[idx] == qc)
+        found = jnp.where((found < 0) & hit, idx, found)
+    return found
+
+
+_probe_rows_jit = None
+
+
+def probe_rows(off, rows, key_cols: Sequence, q_cols: Sequence, cap: int, n: int):
+    """Row index of the entry whose key columns equal q_cols, else -1.
+    All q_cols share an arbitrary broadcast shape; the probe is elementwise
+    over it.  ``cap``/``n`` are static (from the host HashIndex).  The body
+    is a shared jitted subcomputation: a kernel with dozens of probe sites
+    traces/compiles each (table, shape) signature once."""
+    global _probe_rows_jit
+    if _probe_rows_jit is None:
+        import jax
+
+        _probe_rows_jit = jax.jit(_probe_rows_impl, static_argnums=(4, 5))
+    return _probe_rows_jit(off, rows, tuple(key_cols), tuple(q_cols), cap, n)
+
+
+def probe_range(ri_arrays, cap: int, n: int, q):
+    """Range [lo, hi) for key ``q`` in a RangeIndex; (0, 0) on miss.
+    ``ri_arrays`` is the dict of device arrays for one RangeIndex with keys
+    'gk', 'glo', 'ghi', 'off', 'rows'."""
+    import jax.numpy as jnp
+
+    gi = probe_rows(
+        ri_arrays["off"], ri_arrays["rows"], (ri_arrays["gk"],), (q,), cap, n
+    )
+    gic = jnp.clip(gi, 0, max(n - 1, 0))
+    hit = gi >= 0
+    lo = jnp.where(hit, ri_arrays["glo"][gic], 0)
+    hi = jnp.where(hit, ri_arrays["ghi"][gic], 0)
+    return lo, hi

@@ -51,6 +51,14 @@ class EngineConfig:
     us_leaf_cap: int = 8  # max userset grants tested per (node, relation)
     eval_iters: int = 2  # fixpoint iterations over the rewrite system
     batch_bucket_min: int = 8  # pad batch/unique-subject counts to pow2 ≥ this
+    # -- flat (hash-probe) engine caps (engine/flat.py) -----------------
+    use_flat: bool = True  # single-chip checks use the flat kernel
+    flat_recursion: int = 8  # inline budget per recursive (type, slot) pair
+    flat_max_slots: int = 8  # max distinct permissions per flat dispatch
+    closure_source_cap: int = 4096  # max flattened pairs per closure source
+    #: max product of arrow-child dims per query in the unrolled lattice;
+    #: beyond it an arrow probes child-existence only (possible → host)
+    flat_max_width: int = 256
 
     @staticmethod
     def for_schema(compiled: CompiledSchema, **overrides) -> "EngineConfig":
@@ -149,13 +157,12 @@ def _arrow_depth(compiled: CompiledSchema) -> int:
     return depth
 
 
-def _eval_recursion_bound(compiled: CompiledSchema) -> int:
-    """Cycle bound for the fixpoint ITERATION (not the closure): build the
-    evaluation-dependency graph over (type, item) where permissions depend
-    on same-type references and arrow targets, and relations are leaves
-    (their userset indirection is resolved by the closure phase, not the
-    fixpoint).  Returns 0 if acyclic, else the number of nodes observed on
-    cycles — an upper bound on the extra propagation steps a cycle needs."""
+def _eval_dep_graph(
+    compiled: CompiledSchema,
+) -> Dict[Tuple[str, str], List[Tuple[str, str]]]:
+    """Evaluation-dependency graph over (type, item): permissions depend on
+    same-type references and arrow targets; relations are leaves (their
+    userset indirection is resolved by the closure phase)."""
     schema = compiled.schema
     edges: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
     for tname, d in schema.definitions.items():
@@ -169,11 +176,27 @@ def _eval_recursion_bound(compiled: CompiledSchema) -> int:
                         if not a.wildcard and schema.definitions[a.type].item(ref.right):
                             deps.append((a.type, ref.right))
             edges[(tname, pname)] = deps
-    # relations are leaves: drop their outgoing edges entirely
-    depth, cyclic_nodes = _longest_path(edges)
+    return edges
+
+
+def _eval_recursion_bound(compiled: CompiledSchema) -> int:
+    """Cycle bound for the fixpoint ITERATION (not the closure).  Returns 0
+    if acyclic, else the number of nodes observed on cycles — an upper
+    bound on the extra propagation steps a cycle needs."""
+    depth, cyclic_nodes = _longest_path(_eval_dep_graph(compiled))
     if depth >= 0:
         return 0
     return max(1, len(cyclic_nodes))
+
+
+def _eval_cyclic_pairs(compiled: CompiledSchema) -> frozenset:
+    """(type_name, slot) pairs on an evaluation-dependency cycle — the
+    pairs whose static unrolling needs a recursion budget (engine/flat.py);
+    everything else terminates by schema acyclicity."""
+    _, cyclic_nodes = _longest_path(_eval_dep_graph(compiled))
+    return frozenset(
+        (tname, compiled.slot_of_name[iname]) for tname, iname in cyclic_nodes
+    )
 
 
 @dataclass(frozen=True)

@@ -13,3 +13,10 @@ if os.environ.get("GOCHUGARU_TEST_TPU") != "1":
     from gochugaru_tpu.utils.platform import force_cpu_platform
 
     force_cpu_platform(8)
+
+# persistent XLA compile cache: identical kernels (same schema shape
+# buckets) hit disk instead of recompiling across test runs
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/gochugaru_xla_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -1,0 +1,117 @@
+"""Tests for the bucketed hash indexes (engine/hash.py): host/device hash
+agreement, exact probes, range probes, duplicates, and empties."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from gochugaru_tpu.engine.hash import (
+    build_hash,
+    build_range_hash,
+    mix32,
+    probe_range,
+    probe_rows,
+)
+
+
+def test_mix32_host_device_agree():
+    rng = np.random.default_rng(0)
+    cols = [rng.integers(-(2**31), 2**31 - 1, 257).astype(np.int32) for _ in range(4)]
+    hn = mix32(cols, np)
+    hj = np.asarray(mix32([jnp.asarray(c) for c in cols], jnp))
+    np.testing.assert_array_equal(hn, hj)
+
+
+def _probe_host(idx, key_cols, q_cols):
+    dev = [jnp.asarray(c) for c in key_cols]
+    q = [jnp.asarray(c) for c in q_cols]
+    return np.asarray(
+        probe_rows(
+            jnp.asarray(idx.off), jnp.asarray(idx.rows), dev, q, idx.cap, idx.n
+        )
+    )
+
+
+def test_exact_probe_hits_and_misses():
+    rng = np.random.default_rng(1)
+    n = 5000
+    k1 = rng.permutation(n).astype(np.int32)
+    k2 = rng.integers(0, 50, n).astype(np.int32)
+    k3 = rng.integers(-5, 5, n).astype(np.int32)
+    idx = build_hash([k1, k2, k3])
+    assert idx.cap <= 4 or idx.size >= 2 * n
+    # every present key found at its own row
+    got = _probe_host(idx, [k1, k2, k3], [k1, k2, k3])
+    np.testing.assert_array_equal(got, np.arange(n))
+    # absent keys miss
+    qa = (k1 + np.int32(n)).astype(np.int32)  # k1 values all < n, so +n misses
+    got = _probe_host(idx, [k1, k2, k3], [qa, k2, k3])
+    assert (got == -1).all()
+
+
+def test_duplicate_keys_probe_returns_a_matching_row():
+    k1 = np.asarray([7, 7, 7, 3], np.int32)
+    k2 = np.asarray([1, 1, 1, 2], np.int32)
+    idx = build_hash([k1, k2])
+    got = _probe_host(idx, [k1, k2], [np.asarray([7, 3], np.int32),
+                                      np.asarray([1, 2], np.int32)])
+    assert k1[got[0]] == 7 and k2[got[0]] == 1
+    assert got[1] == 3
+
+
+def test_empty_table_probes_miss():
+    idx = build_hash([])
+    got = _probe_host(
+        idx,
+        [np.zeros(1, np.int32)],
+        [np.asarray([5, 0, -1], np.int32)],
+    )
+    assert (got == -1).all()
+
+
+def test_probe_broadcast_shapes():
+    k1 = np.arange(100, dtype=np.int32)
+    k2 = (np.arange(100) % 7).astype(np.int32)
+    idx = build_hash([k1, k2])
+    q1 = np.arange(12, dtype=np.int32).reshape(3, 4)
+    q2 = (np.arange(12) % 7).astype(np.int32).reshape(3, 4)
+    got = _probe_host(idx, [k1, k2], [q1, q2])
+    assert got.shape == (3, 4)
+    ok = (np.arange(12) % 7) == (np.arange(12) % 7)  # by construction all hit
+    assert (got.ravel()[ok] == np.arange(12)[ok]).all()
+
+
+def test_range_index_matches_searchsorted():
+    rng = np.random.default_rng(3)
+    G, reps = 200, 6
+    k = np.repeat(rng.choice(100000, G, replace=False), reps)
+    k = np.sort(k).astype(np.int32)
+    ri = build_range_hash(k)
+    assert ri.max_run == reps
+    arrays = {
+        "gk": jnp.asarray(ri.gk),
+        "glo": jnp.asarray(ri.glo), "ghi": jnp.asarray(ri.ghi),
+        "off": jnp.asarray(ri.index.off), "rows": jnp.asarray(ri.index.rows),
+    }
+    # probe every distinct key + some misses
+    q = np.concatenate([ri.gk, np.asarray([123456789, -7], np.int32)])
+    lo, hi = probe_range(arrays, ri.index.cap, ri.index.n, jnp.asarray(q))
+    lo, hi = np.asarray(lo), np.asarray(hi)
+    for i in range(len(ri.gk)):
+        assert lo[i] == np.searchsorted(k, q[i], "left")
+        assert hi[i] == np.searchsorted(k, q[i], "right")
+    assert (lo[-2:] == 0).all() and (hi[-2:] == 0).all()
+
+
+def test_range_index_empty():
+    ri = build_range_hash(np.zeros(0, np.int32))
+    assert ri.max_run == 0
+    arrays = {
+        "gk": jnp.asarray(np.zeros(1, np.int32)),
+        "glo": jnp.asarray(np.zeros(1, np.int32)),
+        "ghi": jnp.asarray(np.zeros(1, np.int32)),
+        "off": jnp.asarray(ri.index.off), "rows": jnp.asarray(ri.index.rows),
+    }
+    lo, hi = probe_range(arrays, ri.index.cap, ri.index.n,
+                         jnp.asarray([3], dtype=jnp.int32))
+    assert int(lo[0]) == 0 and int(hi[0]) == 0
