@@ -8,8 +8,14 @@ edges must observe the new revision immediately.
 
 Metrics: delta re-index latency (materialize + device upload) and
 sustained updates/sec, at a base graph scaled by ``--edges`` (the full
-config is 1B edges on v5e-16; one chip holds the 100M-class slice —
-sharded, each host applies the same delta to its row shard).
+config is 1B edges on v5e-16; one chip holds the 100M-class slice).
+
+Multi-host status, honestly: ShardedEngine.prepare re-ships the full
+padded edge columns on every revision (parallel/sharded.py) — per-shard
+incremental delta application (re-shipping only changed blocks) is NOT
+implemented yet, so the multi-host cost per revision is a full
+re-materialize + re-ship, measured here on one chip.  The host-side delta
+materialization (store/delta.py) is incremental; the device upload is not.
 """
 
 import argparse

@@ -23,6 +23,7 @@ Check resolution is a three-tier cascade:
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Tuple
 
@@ -55,7 +56,13 @@ from .utils.retry import retry_retriable_errors
 CHECK_CHUNK = 1000
 READ_PAGE = 512
 DELETE_BATCH = 10_000
-IMPORT_CHUNK = 1000
+#: Import accumulation before flushing to the store: at least the store's
+#: columnar threshold (store/store.py COLUMNAR_IMPORT_MIN), so bulk
+#: restores land as immutable column segments instead of per-object dict
+#: entries — the reference streams chunks of 1000 over gRPC
+#: (client/client.go:448), but our "wire" is a function call, so the
+#: buffer can be as large as segment efficiency wants.
+IMPORT_BUFFER = 262_144
 
 
 class _Options:
@@ -64,6 +71,7 @@ class _Options:
         self.engine_config: Optional[EngineConfig] = None
         self.store: Optional[Store] = None
         self.use_device = True
+        self.profile_dir: Optional[str] = None
 
 
 Option = Callable[[_Options], None]
@@ -108,6 +116,18 @@ def with_host_only_evaluation() -> Option:
     return opt
 
 
+def with_profiling(trace_dir: str) -> Option:
+    """Capture a ``jax.profiler`` trace around every check dispatch into
+    ``trace_dir`` and publish a ``checks.device_time_s`` timer — the deep
+    analogue of the interceptors the reference admits through WithDialOpts
+    (client/client.go:95-97; SURVEY.md §5 tracing/profiling)."""
+
+    def opt(o: _Options) -> None:
+        o.profile_dir = trace_dir
+
+    return opt
+
+
 class Client:
     """An in-process authorization client with the gochugaru surface."""
 
@@ -119,6 +139,7 @@ class Client:
         self._overlap_required = o.overlap_required
         self._engine_config = o.engine_config
         self._use_device = o.use_device
+        self._profile_dir = o.profile_dir
         self._lock = threading.Lock()
         self._engine: Optional[DeviceEngine] = None
         self._engine_schema = None  # CompiledSchema the engine was built for
@@ -151,14 +172,33 @@ class Client:
                 self._dsnap_cache.clear()
             return self._engine
 
+    #: prepared-snapshot / oracle cache capacity per client
+    SNAPSHOT_CACHE_MAX = 4
+
+    @staticmethod
+    def _lru_get(cache: Dict[int, Any], key: int):
+        """LRU access: move the hit to the back (dicts preserve order)."""
+        v = cache.pop(key, None)
+        if v is not None:
+            cache[key] = v
+        return v
+
+    @classmethod
+    def _lru_put(cls, cache: Dict[int, Any], key: int, v: Any) -> None:
+        """Insert + evict least-recently-USED (round-2 Weak #5: evicting
+        the lowest revision thrashed Snapshot-pinned readers under head
+        writes — a pinned generation stays warm because every read
+        refreshes it)."""
+        cache[key] = v
+        while len(cache) > cls.SNAPSHOT_CACHE_MAX:
+            cache.pop(next(iter(cache)))
+
     def _dsnap_for(self, engine: DeviceEngine, snap: Snapshot) -> DeviceSnapshot:
         with self._lock:
-            ds = self._dsnap_cache.get(snap.revision)
+            ds = self._lru_get(self._dsnap_cache, snap.revision)
             if ds is None or ds.snapshot is not snap:
                 ds = engine.prepare(snap)
-                self._dsnap_cache[snap.revision] = ds
-                while len(self._dsnap_cache) > 4:
-                    self._dsnap_cache.pop(min(self._dsnap_cache))
+                self._lru_put(self._dsnap_cache, snap.revision, ds)
             return ds
 
     def _oracle_for(self, snap: Snapshot) -> Oracle:
@@ -166,7 +206,7 @@ class Client:
         the snapshot's sorted columns lazily, so the first conditional or
         overflowed check costs O(log E), not an O(E) Python prebuild."""
         with self._lock:
-            o = self._oracle_cache.get(snap.revision)
+            o = self._lru_get(self._oracle_cache, snap.revision)
             if o is None:
                 o = SnapshotOracle(
                     snap,
@@ -175,9 +215,7 @@ class Client:
                         for name in snap.compiled.schema.caveats
                     },
                 )
-                self._oracle_cache[snap.revision] = o
-                while len(self._oracle_cache) > 4:
-                    self._oracle_cache.pop(min(self._oracle_cache))
+                self._lru_put(self._oracle_cache, snap.revision, o)
             return o
 
     # ------------------------------------------------------------------
@@ -239,8 +277,15 @@ class Client:
                     oracle = self._oracle_for(snap)
                     return [oracle.check_relationship(r) == T for r in rels]
                 dsnap = self._dsnap_for(engine, snap)
+                if self._profile_dir is not None:
+                    import jax
+
+                    prof = jax.profiler.trace(self._profile_dir)
+                else:
+                    prof = contextlib.nullcontext()
                 try:
-                    d, p, ovf = engine.check_batch(dsnap, rels)
+                    with prof, self._metrics.timer("checks.device_time_s"):
+                        d, p, ovf = engine.check_batch(dsnap, rels)
                 except Exception as e:  # classify device dispatch failures
                     msg = str(e)
                     if "RESOURCE_EXHAUSTED" in msg or "UNAVAILABLE" in msg:
@@ -383,9 +428,11 @@ class Client:
     def import_relationships(
         self, ctx: Context, rs: Iterable[RelationshipLike]
     ) -> None:
-        """Bulk restore, optimized over Write.  Chunks of 1000; a chunk
-        that already exists falls back to a retried TOUCH transaction —
-        the same recovery the reference performs on AlreadyExists
+        """Bulk restore, optimized over Write.  Accumulates IMPORT_BUFFER
+        relationships per store flush so restores land on the columnar
+        bulk path (store/store.py COLUMNAR_IMPORT_MIN); a batch that
+        already exists falls back to a retried TOUCH import — the same
+        recovery the reference performs on AlreadyExists
         (client/client.go:448-463)."""
         chunk: List[Relationship] = []
 
@@ -395,18 +442,15 @@ class Client:
             try:
                 self._store.import_relationships(chunk)
             except AlreadyExistsError:
-                def touch_all() -> str:
-                    txn = Txn()
-                    for r in chunk:
-                        txn.touch(r)
-                    return self._store.write(txn)
-
-                retry_retriable_errors(ctx, touch_all)
+                retry_retriable_errors(
+                    ctx,
+                    lambda: self._store.import_relationships(chunk, touch=True),
+                )
             chunk.clear()
 
         for r in rs:
             chunk.append(as_relationship(r))
-            if len(chunk) >= IMPORT_CHUNK:
+            if len(chunk) >= IMPORT_BUFFER:
                 flush()
         flush()
 

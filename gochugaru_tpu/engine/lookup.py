@@ -72,8 +72,10 @@ class LookupIndex:
     #: arrow edges keyed by child node, sorted
     ra_child: np.ndarray  # int32[A]
     ra_res: np.ndarray  # int32[A]
-    #: all edges keyed by resource node, sorted
+    #: all edges keyed by resource node, sorted (stable → within a run the
+    #: residual order is the primary (rel, subj, srel1))
     er_res: np.ndarray  # int32[E]
+    er_rel: np.ndarray  # int32[E]
     er_subj: np.ndarray  # int32[E]
     er_srel1: np.ndarray  # int32[E]
     #: primary view packed (rel, res) — already sorted by construction
@@ -86,18 +88,9 @@ class LookupIndex:
     perm_slots_of_tid: Dict[int, np.ndarray]
 
 
-def lookup_index(snap: Snapshot) -> LookupIndex:
-    idx = getattr(snap, "_lookup_index", None)
-    if idx is not None:
-        return idx
-    NS1 = snap.num_slots + 1
-    order = lexsort2(snap.e_subj, snap.e_srel1)
-    rs_key = (
-        snap.e_subj[order].astype(np.int64) * NS1
-        + snap.e_srel1[order].astype(np.int64)
-    )
-    ra_order = argsort1(snap.ar_child)
-    er_order = argsort1(snap.e_res)
+def _perm_tables(snap: Snapshot):
+    """Per-interner-type permission tables, sized to the CURRENT interner
+    (a delta can intern the first node of a schema type, growing it)."""
     interner = snap.interner
     compiled = snap.compiled
     perm_table = np.zeros((max(interner.num_types, 1), snap.num_slots), bool)
@@ -112,6 +105,22 @@ def lookup_index(snap: Snapshot) -> LookupIndex:
         if slots.size:
             perm_table[itid, slots] = True
             perm_slots_of_tid[itid] = slots
+    return perm_table, perm_slots_of_tid
+
+
+def lookup_index(snap: Snapshot) -> LookupIndex:
+    idx = getattr(snap, "_lookup_index", None)
+    if idx is not None:
+        return idx
+    NS1 = snap.num_slots + 1
+    order = lexsort2(snap.e_subj, snap.e_srel1)
+    rs_key = (
+        snap.e_subj[order].astype(np.int64) * NS1
+        + snap.e_srel1[order].astype(np.int64)
+    )
+    ra_order = argsort1(snap.ar_child)
+    er_order = argsort1(snap.e_res)
+    perm_table, perm_slots_of_tid = _perm_tables(snap)
     idx = LookupIndex(
         rs_key=rs_key,
         rs_res=snap.e_res[order],
@@ -119,6 +128,7 @@ def lookup_index(snap: Snapshot) -> LookupIndex:
         ra_child=snap.ar_child[ra_order],
         ra_res=snap.ar_res[ra_order],
         er_res=snap.e_res[er_order],
+        er_rel=snap.e_rel[er_order],
         er_subj=snap.e_subj[er_order],
         er_srel1=snap.e_srel1[er_order],
         e_relres=snap.e_rel.astype(np.int64) * _B32 + snap.e_res.astype(np.int64),
@@ -479,3 +489,136 @@ def lookup_subjects_device(
         oracle_check=oracle_check,
     )
     return sorted(interner.key_of(int(n))[1] for n in granted)
+
+
+# ---------------------------------------------------------------------------
+# incremental index maintenance (Watch-driven re-index, BASELINE config 5)
+# ---------------------------------------------------------------------------
+
+
+def advance_lookup_index(
+    prev: Snapshot,
+    nxt: Snapshot,
+    *,
+    gone_rows: np.ndarray,
+    a_rel: np.ndarray,
+    a_res: np.ndarray,
+    a_subj: np.ndarray,
+    a_srel1: np.ndarray,
+) -> None:
+    """Produce ``nxt._lookup_index`` from ``prev``'s by removing the
+    tombstoned identities and merging the sorted additions into each
+    transposed view — O(E + D log E) per revision instead of the full
+    O(E log E) rebuild (store/delta.py calls this from apply_delta when
+    the previous revision's index exists)."""
+    from ..store.delta import find_in_view, merge_positions
+
+    idx: LookupIndex = prev._lookup_index
+    NS1 = np.int64(prev.num_slots + 1)
+    g_rel = prev.e_rel[gone_rows].astype(np.int64)
+    g_res = prev.e_res[gone_rows].astype(np.int64)
+    g_subj = prev.e_subj[gone_rows].astype(np.int64)
+    g_srel1 = prev.e_srel1[gone_rows].astype(np.int64)
+    a_rel = a_rel.astype(np.int64)
+    a_res = a_res.astype(np.int64)
+    a_subj = a_subj.astype(np.int64)
+    a_srel1 = a_srel1.astype(np.int64)
+
+    def pack_rr(rel, res):
+        return rel * _B32 + res
+
+    def advance_view(old_k1, old_k2, cols_old, rem_k1, rem_k2,
+                     new_k1, new_k2, cols_new):
+        """Merged (k1, cols...) of one lexsorted view after the delta."""
+        pos = find_in_view(old_k1, old_k2, rem_k1, rem_k2)
+        keep = np.ones(old_k1.shape[0], dtype=bool)
+        keep[pos[pos >= 0]] = False
+        n_ord = np.lexsort((new_k2, new_k1))
+        po, pn = merge_positions(
+            old_k1[keep], old_k2[keep], new_k1[n_ord], new_k2[n_ord]
+        )
+        total = po.shape[0] + pn.shape[0]
+        mk1 = np.empty(total, old_k1.dtype)
+        mk1[po] = old_k1[keep]
+        mk1[pn] = new_k1[n_ord]
+        out = []
+        for co, cn in zip(cols_old, cols_new):
+            m = np.empty(total, co.dtype)
+            m[po] = co[keep]
+            m[pn] = cn[n_ord].astype(co.dtype)
+            out.append(m)
+        return mk1, out
+
+    # rs view: keyed (subj, srel1); residual order (rel, res)
+    rs_key, (rs_res, rs_rel) = advance_view(
+        idx.rs_key, pack_rr(idx.rs_rel.astype(np.int64), idx.rs_res),
+        (idx.rs_res, idx.rs_rel),
+        g_subj * NS1 + g_srel1, pack_rr(g_rel, g_res),
+        a_subj * NS1 + a_srel1, pack_rr(a_rel, a_res),
+        (a_res, a_rel),
+    )
+
+    # er view: keyed res; residual order (rel, subj, srel1)
+    def pack_rss(rel, subj, srel1):
+        return (rel << np.int64(47)) | (subj << np.int64(16)) | srel1
+
+    er_res, (er_rel, er_subj, er_srel1) = advance_view(
+        idx.er_res.astype(np.int64),
+        pack_rss(
+            idx.er_rel.astype(np.int64),
+            idx.er_subj.astype(np.int64),
+            idx.er_srel1.astype(np.int64),
+        ),
+        (idx.er_rel, idx.er_subj, idx.er_srel1),
+        g_res, pack_rss(g_rel, g_subj, g_srel1),
+        a_res, pack_rss(a_rel, a_subj, a_srel1),
+        (a_rel, a_subj, a_srel1),
+    )
+
+    # ra view: arrow rows only (tupleset relation, direct subject), keyed
+    # child node; residual order (rel, res)
+    ts = np.asarray(sorted(prev.compiled.tupleset_slots), np.int64)
+    g_ar = np.isin(g_rel, ts) & (g_srel1 == 0)
+    a_ar = np.isin(a_rel, ts) & (a_srel1 == 0)
+    prev_ra_rel = _ra_rel_of(prev, idx)
+    ra_child, (ra_res, ra_rel) = advance_view(
+        idx.ra_child.astype(np.int64),
+        pack_rr(prev_ra_rel, idx.ra_res.astype(np.int64)),
+        (idx.ra_res, prev_ra_rel),
+        g_subj[g_ar], pack_rr(g_rel[g_ar], g_res[g_ar]),
+        a_subj[a_ar], pack_rr(a_rel[a_ar], a_res[a_ar]),
+        (a_res[a_ar], a_rel[a_ar]),
+    )
+
+    # the delta may have interned the FIRST node of a schema type, growing
+    # the interner's type space — a carried perm_table would be undersized
+    # and index out of bounds; the rebuild is O(types × permissions)
+    if idx.perm_table.shape[0] >= max(nxt.interner.num_types, 1):
+        perm_table, perm_slots = idx.perm_table, idx.perm_slots_of_tid
+    else:
+        perm_table, perm_slots = _perm_tables(nxt)
+    new_idx = LookupIndex(
+        rs_key=rs_key,
+        rs_res=rs_res, rs_rel=rs_rel,
+        ra_child=ra_child.astype(np.int32), ra_res=ra_res,
+        er_res=er_res.astype(np.int32), er_rel=er_rel,
+        er_subj=er_subj, er_srel1=er_srel1,
+        e_relres=nxt.e_rel.astype(np.int64) * _B32 + nxt.e_res.astype(np.int64),
+        ar_relres=nxt.ar_rel.astype(np.int64) * _B32 + nxt.ar_res.astype(np.int64),
+        perm_table=perm_table,
+        perm_slots_of_tid=perm_slots,
+    )
+    new_idx._ra_rel = ra_rel  # keep chained advances O(E + D log E)
+    nxt._lookup_index = new_idx
+
+
+def _ra_rel_of(snap: Snapshot, idx: LookupIndex) -> np.ndarray:
+    """rel column of the ra view (child-sorted arrow rows), recovered from
+    the snapshot's ar view once and cached on the index."""
+    cached = getattr(idx, "_ra_rel", None)
+    if cached is not None:
+        return cached
+    ra_order = argsort1(snap.ar_child)
+    rel = snap.ar_rel[ra_order].astype(np.int64)
+    idx._ra_rel = rel
+    return rel

@@ -63,6 +63,60 @@ def _grouped(inverse: np.ndarray) -> "list[np.ndarray]":
     return np.split(order, np.cumsum(counts)[:-1])
 
 
+def find_in_view(
+    old_k1: np.ndarray, old_k2: np.ndarray, q1: np.ndarray, q2: np.ndarray
+) -> np.ndarray:
+    """Row index of each (q1, q2) in a view lexsorted by (k1, k2); -1 when
+    absent.  Two-level binary search vectorized over the k1 runs."""
+    D = q1.shape[0]
+    out = np.full(D, -1, dtype=np.int64)
+    if D == 0 or old_k1.shape[0] == 0:
+        return out
+    lo = np.searchsorted(old_k1, q1, side="left")
+    hi = np.searchsorted(old_k1, q1, side="right")
+    run = hi > lo
+    if np.any(run):
+        runs, inverse = np.unique(lo[run], return_inverse=True)
+        idx_run = np.nonzero(run)[0]
+        for run_lo, group in zip(runs, _grouped(inverse)):
+            members = idx_run[group]
+            run_hi = hi[members[0]]
+            seg = old_k2[run_lo:run_hi]
+            pos = run_lo + np.searchsorted(seg, q2[members], side="left")
+            ok = (pos < run_hi) & (old_k2[np.clip(pos, 0, old_k2.shape[0] - 1)] == q2[members])
+            out[members[ok]] = pos[ok]
+    return out
+
+
+def merge_positions(
+    old_k1: np.ndarray, old_k2: np.ndarray, new_k1: np.ndarray, new_k2: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Interleave positions merging two (k1, k2)-lexsorted row sets:
+    returns (pos_old, pos_new) into the merged array of len(old)+len(new).
+    O(E + D log E) — the argsort-free merge the Watch-driven re-index
+    depends on (BASELINE config 5)."""
+    E0, A = old_k1.shape[0], new_k1.shape[0]
+    ins = np.searchsorted(old_k1, new_k1, side="left")
+    hi = np.searchsorted(old_k1, new_k1, side="right")
+    run = hi > ins
+    if np.any(run):
+        runs, inverse = np.unique(ins[run], return_inverse=True)
+        idx_run = np.nonzero(run)[0]
+        for run_lo, group in zip(runs, _grouped(inverse)):
+            members = idx_run[group]
+            run_hi = hi[members[0]]
+            seg = old_k2[run_lo:run_hi]
+            ins[members] = run_lo + np.searchsorted(
+                seg, new_k2[members], side="left"
+            )
+    add_before = np.zeros(E0 + 1, dtype=np.int64)
+    np.add.at(add_before, ins, 1)
+    add_before = np.cumsum(add_before)[: E0 + 1]
+    pos_old = np.arange(E0, dtype=np.int64) + add_before[:E0]
+    pos_new = ins + np.arange(A, dtype=np.int64)
+    return pos_old, pos_new
+
+
 def _locate(
     prev: Snapshot, rel: np.ndarray, res: np.ndarray,
     subj: np.ndarray, srel1: np.ndarray,
@@ -175,28 +229,8 @@ def apply_delta(
     new_ss = _pack_ss(a_subj, a_srel1)[a_order]
     E0, A = old_rr.shape[0], new_rr.shape[0]
 
-    # insertion index of each addition among surviving old rows (two-level:
-    # run by (rel,res), then (subj,srel1) within the run)
-    ins = np.searchsorted(old_rr, new_rr, side="left")
-    hi = np.searchsorted(old_rr, new_rr, side="right")
-    run = hi > ins
-    if np.any(run):
-        runs, inverse = np.unique(ins[run], return_inverse=True)
-        idx_run = np.nonzero(run)[0]
-        for run_lo, group in zip(runs, _grouped(inverse)):
-            members = idx_run[group]
-            run_hi = hi[members[0]]
-            seg = old_ss[run_lo:run_hi]
-            ins[members] = run_lo + np.searchsorted(seg, new_ss[members], side="left")
-
-    # final position of old row i: i + (#additions inserted before it);
-    # of addition j (sorted): ins[j] + j (stable: adds after equal olds —
-    # identities are unique so ties cannot occur anyway)
-    add_before = np.zeros(E0 + 1, dtype=np.int64)
-    np.add.at(add_before, ins, 1)
-    add_before = np.cumsum(add_before)[:E0 + 1]
-    pos_old = np.arange(E0, dtype=np.int64) + add_before[:E0]
-    pos_new = ins + np.arange(A, dtype=np.int64)
+    # interleave positions: two-level merge by (rel,res | subj,srel1)
+    pos_old, pos_new = merge_positions(old_rr, old_ss, new_rr, new_ss)
 
     def interleave(old: np.ndarray, new: np.ndarray) -> np.ndarray:
         out = np.empty(E0 + A, dtype=old.dtype)
@@ -224,9 +258,21 @@ def apply_delta(
     else:
         contexts = []
 
-    return finish_snapshot(
+    nxt = finish_snapshot(
         revision, compiled, interner,
         e_rel=e_rel, e_res=e_res, e_subj=e_subj, e_srel1=e_srel1,
         e_caveat=e_cav, e_ctx=e_ctx, e_exp=e_exp, e_exp_us=e_exp_us,
         contexts=contexts, epoch_us=prev.epoch_us,
     )
+    # carry the lookup index forward: when the previous snapshot has one,
+    # advance it by the delta (O(E + D log E) merges) instead of letting
+    # the next lookup pay a full O(E log E) rebuild (round-2 Weak #4)
+    if getattr(prev, "_lookup_index", None) is not None:
+        from ..engine.lookup import advance_lookup_index
+
+        advance_lookup_index(
+            prev, nxt,
+            gone_rows=np.unique(gone[gone >= 0]) if gone.size else gone,
+            a_rel=a_rel, a_res=a_res, a_subj=a_subj, a_srel1=a_srel1,
+        )
+    return nxt

@@ -658,10 +658,19 @@ class Store:
                 rev, self._require_schema(), self.interner, list(self._live.values())
             )
         self._snapshots[rev] = snap
-        if len(self._snapshots) > self._keep_generations:
-            for old in sorted(self._snapshots)[: len(self._snapshots) - self._keep_generations]:
-                del self._snapshots[old]
+        # evict least-recently-USED, not lowest revision: a Snapshot-pinned
+        # reader that keeps querying an old generation must not be thrashed
+        # by concurrent head writes (round-2 Weak #5) — every access moves
+        # its generation to the back via _snap_touch
+        while len(self._snapshots) > self._keep_generations:
+            self._snapshots.pop(next(iter(self._snapshots)))
         return snap
+
+    def _snap_touch(self, rev: int) -> Snapshot:
+        """LRU access to a materialized generation (dicts keep order)."""
+        s = self._snapshots.pop(rev)
+        self._snapshots[rev] = s
+        return s
 
     def _materialize_columnar_locked(self, rev: int) -> Snapshot:
         """Full materialization straight from the columnar base + the live
@@ -748,11 +757,11 @@ class Store:
             latest = max(self._snapshots) if self._snapshots else None
             if req == Requirement.FULL:
                 if latest == self._head_rev:
-                    return self._snapshots[latest]
+                    return self._snap_touch(latest)
                 return self._materialize_locked(self._head_rev)
             if req == Requirement.MIN_LATENCY:
                 if latest is not None:
-                    return self._snapshots[latest]
+                    return self._snap_touch(latest)
                 return self._materialize_locked(self._head_rev)
             if req == Requirement.AT_LEAST:
                 want = parse_revision(strategy.revision or "")
@@ -761,12 +770,12 @@ class Store:
                         f"revision {strategy.revision} is in the future"
                     )
                 if latest is not None and latest >= want:
-                    return self._snapshots[latest]
+                    return self._snap_touch(latest)
                 return self._materialize_locked(self._head_rev)
             if req == Requirement.SNAPSHOT:
                 want = parse_revision(strategy.revision or "")
                 if want in self._snapshots:
-                    return self._snapshots[want]
+                    return self._snap_touch(want)
                 if want == self._head_rev:
                     return self._materialize_locked(self._head_rev)
                 raise RevisionUnavailableError(

@@ -1,0 +1,91 @@
+"""Client cache-pinning (round-2 Weak #5) and the jax.profiler escape
+hatch (SURVEY.md §5 tracing/profiling)."""
+
+import os
+
+from gochugaru_tpu import consistency, rel
+from gochugaru_tpu.client import Client, with_profiling
+from gochugaru_tpu.utils import metrics
+from gochugaru_tpu.utils.context import background
+
+SCHEMA = """
+definition user {}
+definition doc {
+    relation reader: user
+    permission view = reader
+}
+"""
+
+
+def seeded_client():
+    c = Client()
+    ctx = background()
+    c.write_schema(ctx, SCHEMA)
+    txn = rel.Txn()
+    txn.create(rel.must_from_triple("doc:d", "reader", "user:u"))
+    rev = c.write(ctx, txn)
+    return c, ctx, rev
+
+
+def test_snapshot_pinned_reader_survives_head_writes():
+    c, ctx, rev = seeded_client()
+    pinned = consistency.snapshot(rev)
+    assert c.check_one(ctx, pinned, rel.must_from_triple("doc:d", "view", "user:u"))
+    snap = c._store.snapshot_for(pinned)
+    held = c._dsnap_cache[snap.revision]
+    for i in range(10):
+        txn = rel.Txn()
+        txn.create(rel.must_from_triple(f"doc:w{i}", "reader", f"user:x{i}"))
+        c.write(ctx, txn)
+        # a head reader churns the cache with fresh revisions…
+        assert c.check_one(
+            ctx, consistency.full(),
+            rel.must_from_triple(f"doc:w{i}", "view", f"user:x{i}"),
+        )
+        # …but the pinned generation stays warm: same prepared object
+        assert c.check_one(
+            ctx, pinned, rel.must_from_triple("doc:d", "view", "user:u")
+        )
+        assert c._dsnap_cache.get(snap.revision) is held, (
+            f"pinned generation evicted after write {i}"
+        )
+    assert len(c._dsnap_cache) <= Client.SNAPSHOT_CACHE_MAX
+
+
+def test_lowest_revision_not_preferentially_evicted():
+    c, ctx, rev = seeded_client()
+    pinned = consistency.snapshot(rev)
+    c.check_one(ctx, pinned, rel.must_from_triple("doc:d", "view", "user:u"))
+    snap = c._store.snapshot_for(pinned)
+    for i in range(6):
+        txn = rel.Txn()
+        txn.create(rel.must_from_triple(f"doc:y{i}", "reader", "user:u"))
+        c.write(ctx, txn)
+        c.check_one(
+            ctx, consistency.full(),
+            rel.must_from_triple(f"doc:y{i}", "view", "user:u"),
+        )
+        c.check_one(ctx, pinned, rel.must_from_triple("doc:d", "view", "user:u"))
+    # the oracle cache follows the same LRU policy
+    assert snap.revision in c._dsnap_cache
+
+
+def test_profiling_option_writes_trace_and_metric(tmp_path):
+    trace_dir = str(tmp_path / "trace")
+    c = Client(with_profiling(trace_dir))
+    ctx = background()
+    c.write_schema(ctx, SCHEMA)
+    txn = rel.Txn()
+    txn.create(rel.must_from_triple("doc:d", "reader", "user:u"))
+    rev = c.write(ctx, txn)
+    before = metrics.default.snapshot().get("checks.device_time_s.count", 0)
+    assert c.check_one(
+        ctx, consistency.at_least(rev),
+        rel.must_from_triple("doc:d", "view", "user:u"),
+    )
+    found = []
+    for root, _dirs, files in os.walk(trace_dir):
+        found.extend(files)
+    assert found, "profiler trace directory is empty"
+    after = metrics.default.snapshot().get("checks.device_time_s.count", 0)
+    assert after > before

@@ -221,3 +221,77 @@ def test_delta_checks_agree_with_oracle():
     assert not ovf.any()
     for i, q in enumerate(checks):
         assert bool(d[i]) == (oracle.check_relationship(q) == T), str(q)
+
+
+def test_lookup_index_carried_across_delta():
+    """round-2 Weak #4: apply_delta must advance the previous revision's
+    LookupIndex incrementally, never forcing a full O(E log E) rebuild,
+    and the carried index must equal a from-scratch build bit for bit."""
+    from gochugaru_tpu.engine.lookup import lookup_index
+    from gochugaru_tpu.schema import compile_schema, parse_schema
+    from gochugaru_tpu.store.interner import Interner
+
+    rng = random.Random(9)
+    cs = compile_schema(parse_schema(SCHEMA))
+    interner = Interner()
+    rels = list({ _random_rel(rng).key(): _random_rel(rng) for _ in range(120) }.values())
+    prev = build_snapshot(1, cs, interner, rels, epoch_us=1_700_000_000_000_000)
+    lookup_index(prev)  # force the index on the base revision
+
+    adds = [
+        rel.must_from_tuple("doc:dX#reader", "user:zed"),
+        rels[0],  # upsert of an existing identity
+        rel.must_from_tuple("doc:d0#owner", "team:t9"),  # arrow row
+    ]
+    deletes = [rels[3], rels[7]]
+    adds = [a for a in adds if a.key() not in {d.key() for d in deletes}]
+    nxt = apply_delta(prev, 2, adds, deletes, interner=interner)
+
+    carried = getattr(nxt, "_lookup_index", None)
+    assert carried is not None, "delta did not carry the lookup index"
+
+    # equality with a from-scratch build on the same snapshot
+    del nxt._lookup_index
+    fresh = lookup_index(nxt)
+    for field in ("rs_key", "rs_res", "rs_rel", "ra_child", "ra_res",
+                  "er_res", "er_rel", "er_subj", "er_srel1",
+                  "e_relres", "ar_relres"):
+        np.testing.assert_array_equal(
+            getattr(carried, field), getattr(fresh, field), err_msg=field
+        )
+
+    # chained delta: the carried index advances again, staying consistent
+    nxt._lookup_index = carried
+    adds2 = [rel.must_from_tuple("doc:dY#reader", "user:amy")]
+    deletes2 = [rels[11]]
+    n2 = apply_delta(nxt, 3, adds2, deletes2, interner=interner)
+    carried2 = getattr(n2, "_lookup_index", None)
+    assert carried2 is not None
+    del n2._lookup_index
+    fresh2 = lookup_index(n2)
+    np.testing.assert_array_equal(carried2.rs_key, fresh2.rs_key)
+    np.testing.assert_array_equal(carried2.rs_res, fresh2.rs_res)
+    np.testing.assert_array_equal(carried2.ra_child, fresh2.ra_child)
+    np.testing.assert_array_equal(carried2.er_res, fresh2.er_res)
+
+
+def test_delta_interning_new_type_grows_perm_table():
+    """Review regression: a delta adding the first node of a schema type
+    must not leave a stale undersized perm_table on the carried index."""
+    from gochugaru_tpu.engine.lookup import lookup_index
+    from gochugaru_tpu.schema import compile_schema, parse_schema
+    from gochugaru_tpu.store.interner import Interner
+
+    cs = compile_schema(parse_schema(SCHEMA))
+    interner = Interner()
+    base = [rel.must_from_tuple("doc:d0#reader", "user:u0")]
+    prev = build_snapshot(1, cs, interner, base, epoch_us=1_700_000_000_000_000)
+    lookup_index(prev)
+    # first team node ever: grows the interner's type space
+    adds = [rel.must_from_tuple("doc:d0#owner", "team:t0")]
+    nxt = apply_delta(prev, 2, adds, [], interner=interner)
+    carried = nxt._lookup_index
+    assert carried.perm_table.shape[0] >= nxt.interner.num_types
+    del nxt._lookup_index
+    fresh = lookup_index(nxt)
+    np.testing.assert_array_equal(carried.perm_table, fresh.perm_table)
