@@ -17,7 +17,7 @@ import sys as _sys
 
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
-from benchmarks.common import NORTH_STAR_P99_MS, emit, note
+from benchmarks.common import maybe_force_cpu, NORTH_STAR_P99_MS, emit, note
 
 from gochugaru_tpu import consistency, rel
 from gochugaru_tpu.client import new_tpu_evaluator
@@ -34,6 +34,7 @@ definition document {
 
 
 def main() -> None:
+    note(f"platform={maybe_force_cpu()}")
     client = new_tpu_evaluator()
     ctx = background()
     client.write_schema(ctx, SCHEMA)

@@ -16,6 +16,7 @@ import sys as _sys
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
 from benchmarks.common import (
+    maybe_force_cpu,
     NORTH_STAR_P99_MS,
     NORTH_STAR_RATE,
     emit,
@@ -39,10 +40,16 @@ definition document {
 }
 """
 
-N_USERS = 100_000
-N_GROUPS = 10_000
-N_FOLDERS = 50_000
-N_DOCS = 1_000_000
+import argparse as _argparse
+
+_scale_args = _argparse.ArgumentParser()
+_scale_args.add_argument("--scale", type=float, default=1.0)
+_SCALE = _scale_args.parse_known_args()[0].scale
+
+N_USERS = max(int(100_000 * _SCALE), 100)
+N_GROUPS = max(int(10_000 * _SCALE), 20)
+N_FOLDERS = max(int(50_000 * _SCALE), 50)
+N_DOCS = max(int(1_000_000 * _SCALE), 1_000)
 BATCH = 100_000
 SEED = 23
 EPOCH = 1_700_000_000_000_000
@@ -105,12 +112,19 @@ def build_world():
     bulk(docs, folder_rel, rng.choice(folders, N_DOCS), -1)
     extra = rng.random(N_DOCS) < 0.2
     bulk(docs[extra], viewer, rng.choice(users, int(extra.sum())), -1)
-    # top up with group-viewer docs to reach ~10M edges
+    # top up with group-viewer docs to reach ~10M edges, spread evenly so
+    # per-(doc, viewer) userset fan-in stays within the engine's leaf cap
+    # (a doc with 30 viewer-groups is a modeling smell, not a workload)
     cur = sum(a.shape[0] for a in res)
-    want = 10_000_000
+    want = int(10_000_000 * _SCALE)
     if cur < want:
         k = want - cur
-        bulk(rng.choice(docs, k), viewer, rng.choice(groups, k), member)
+        per_doc = k // N_DOCS  # uniform: stays within the us leaf cap
+        dd = np.repeat(docs, per_doc)
+        bulk(dd, viewer, rng.choice(groups, dd.shape[0]), member)
+        rem = k - dd.shape[0]
+        if rem:  # remainder as DIRECT viewers: no userset fan-in cap risk
+            bulk(docs[:rem], viewer, rng.choice(users, rem), -1)
 
     snap = build_snapshot_from_columns(
         1, cs, interner,
@@ -122,6 +136,7 @@ def build_world():
 
 
 def main() -> None:
+    note(f"platform={maybe_force_cpu()}")
     from gochugaru_tpu.engine.device import DeviceEngine
 
     cs, snap, users, docs, slot = build_world()
@@ -157,6 +172,37 @@ def main() -> None:
     p50, p99, mean = latency_percentiles(roundtrip, reps=20)
     emit("docs_5hop_batch_p99_latency", p99, "ms", NORTH_STAR_P99_MS / max(p99, 1e-9))
     note(f"p50={p50:.2f}ms p99={p99:.2f}ms mean={mean:.2f}ms")
+
+    # device-lookup latency at config-3 scale: backs engine/lookup.py's
+    # "at 1M docs this is milliseconds of device time" claim with a number
+    import time
+
+    from gochugaru_tpu.engine.lookup import lookup_resources_device
+    from gochugaru_tpu.engine.oracle import SnapshotOracle
+
+    oracle = SnapshotOracle(snap, {})
+    uid = snap.interner.key_of(int(users[0]))[1]
+    t0 = time.perf_counter()
+    ids = lookup_resources_device(
+        engine, dsnap, "document", "view", "user", uid,
+        now_us=EPOCH, oracle_factory=lambda: oracle,
+    )
+    warm_build = (time.perf_counter() - t0) * 1000
+    ts = []
+    for i in (1, 2, 3):
+        uid = snap.interner.key_of(int(users[i]))[1]
+        t0 = time.perf_counter()
+        ids = lookup_resources_device(
+            engine, dsnap, "document", "view", "user", uid,
+            now_us=EPOCH, oracle_factory=lambda: oracle,
+        )
+        ts.append((time.perf_counter() - t0) * 1000)
+    warm = float(np.median(ts))
+    emit("docs_lookup_resources_latency", warm, "ms", NORTH_STAR_P99_MS / max(warm, 1e-9))
+    note(
+        f"lookup_resources @1M docs: first={warm_build:.0f}ms (builds the"
+        f" transposed index), warm={warm:.1f}ms, |result|={len(ids)}"
+    )
 
 
 if __name__ == "__main__":

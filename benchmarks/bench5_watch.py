@@ -28,7 +28,7 @@ import sys as _sys
 
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
-from benchmarks.common import emit, note
+from benchmarks.common import maybe_force_cpu, emit, note
 
 SCHEMA = """
 definition user {}
@@ -93,6 +93,7 @@ def main() -> None:
     ap.add_argument("--delta", type=int, default=1000)
     ap.add_argument("--rounds", type=int, default=10)
     args = ap.parse_args()
+    note(f"platform={maybe_force_cpu()}")
 
     from gochugaru_tpu import rel as relmod
     from gochugaru_tpu.engine.device import DeviceEngine

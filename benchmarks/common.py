@@ -175,3 +175,20 @@ def latency_percentiles(
         ts.append((time.perf_counter() - t0) * 1000)
     a = np.asarray(ts)
     return float(np.percentile(a, 50)), float(np.percentile(a, 99)), float(a.mean())
+
+
+def maybe_force_cpu() -> str:
+    """Benches honor GOCHUGARU_FORCE_CPU=1 (set by run_all.py when its
+    bounded TPU probe fails) — the axon TPU backend can hang on init, and
+    a hung child records nothing.  Returns the active platform name."""
+    import os
+
+    if os.environ.get("GOCHUGARU_FORCE_CPU") == "1":
+        from gochugaru_tpu.utils.platform import force_cpu_platform
+
+        force_cpu_platform()
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/gochugaru_xla_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return jax.default_backend()

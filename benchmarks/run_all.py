@@ -1,0 +1,153 @@
+"""Run every BASELINE config (BASELINE.md:25-32) and write BENCHMARKS.md.
+
+Each config runs as a bounded child process (a hung TPU tunnel must never
+hang the suite — the same contract as bench.py).  A bounded backend probe
+decides the platform once: if the default (TPU) backend is unusable,
+children run with GOCHUGARU_FORCE_CPU=1 and the report says so per row.
+
+Usage:  python benchmarks/run_all.py [--out BENCHMARKS.md] [--quick]
+
+``--quick`` shrinks configs 3/4/5 (CI-sized smoke run); the committed
+BENCHMARKS.md should come from a full run.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROBE_TIMEOUT_S = int(os.environ.get("GOCHUGARU_BENCH_PROBE_TIMEOUT", "75"))
+
+
+def probe_backend() -> str:
+    """'tpu'/'cpu'/... from a bounded child, or 'cpu' when unusable."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S, cwd=ROOT,
+        )
+        if r.returncode == 0 and r.stdout.strip():
+            return r.stdout.strip().splitlines()[-1]
+    except subprocess.TimeoutExpired:
+        pass
+    return "cpu"
+
+
+def run_config(name, cmd, timeout_s, env):
+    """Run one config; returns (json_lines, notes, failure_reason)."""
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s,
+            cwd=ROOT, env=env,
+        )
+        stdout, stderr = r.stdout, r.stderr
+        reason = None if r.returncode == 0 else f"rc={r.returncode}"
+    except subprocess.TimeoutExpired as e:
+        stdout = e.stdout.decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        stderr = e.stderr.decode() if isinstance(e.stderr, bytes) else (e.stderr or "")
+        reason = f"timed out after {timeout_s}s"
+    lines = []
+    for line in (stdout or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "metric" in parsed:
+                lines.append(parsed)
+    notes = [
+        ln[1:].strip() for ln in (stderr or "").splitlines() if ln.startswith("#")
+    ]
+    if reason and not lines:
+        tail = (stderr or "").strip().splitlines()
+        reason += f": {tail[-1][:160]}" if tail else ""
+    print(f"[{name}] {time.time()-t0:.0f}s {len(lines)} metrics"
+          + (f" ({reason})" if reason else ""), file=sys.stderr, flush=True)
+    return lines, notes, reason
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(ROOT, "BENCHMARKS.md"))
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    backend = probe_backend()
+    env = dict(os.environ)
+    if backend != "tpu":
+        env["GOCHUGARU_FORCE_CPU"] = "1"
+        backend = "cpu (TPU backend unusable at run time)"
+    py = sys.executable
+
+    q = args.quick
+    configs = [
+        ("1 — founders CheckAll (client round trip)",
+         [py, "benchmarks/bench1_founders.py"], 420),
+        ("2 — GitHub RBAC 2-hop, 100k batch (driver headline)",
+         [py, "bench.py"], 700),
+        ("3 — Google-Docs nested groups, 1M docs / 10M edges, 5-hop",
+         [py, "benchmarks/bench3_docs.py"], 1500),
+        ("4 — multi-tenant caveats" + (" (quick)" if q else ", 100M edges"),
+         [py, "benchmarks/bench4_caveats.py"]
+         + (["--edges", "2000000"] if q else ["--edges", "100000000"]),
+         2400),
+        ("5 — Watch-driven incremental re-index" + (" (quick)" if q else ""),
+         [py, "benchmarks/bench5_watch.py"]
+         + (["--edges", "1000000"] if q else ["--edges", "10000000"]),
+         1500),
+    ]
+    if q:
+        configs[2] = (
+            "3 — Google-Docs nested groups (quick, 5% scale)",
+            [py, "benchmarks/bench3_docs.py", "--scale", "0.05"], 900,
+        )
+
+    rows = []
+    all_notes = []
+    for name, cmd, timeout_s in configs:
+        lines, notes, reason = run_config(name, cmd, timeout_s, env)
+        all_notes.append((name, notes))
+        if not lines:
+            rows.append((name, "—", "failed", "—", reason or "no output"))
+            continue
+        for parsed in lines:
+            rows.append((
+                name,
+                parsed.get("metric", "?"),
+                f"{parsed.get('value', 0):,.1f}",
+                parsed.get("unit", ""),
+                parsed.get("note", ""),
+            ))
+
+    stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
+    with open(args.out, "w") as f:
+        f.write("# BENCHMARKS\n\n")
+        f.write(
+            f"All five BASELINE configs (BASELINE.md:25-32), run {stamp} on"
+            f" platform **{backend}** via `python benchmarks/run_all.py"
+            + (" --quick" if q else "") + "`.\n\n"
+            "North star: ≥10M checks/sec/chip, p99 < 2 ms @ 100M edges"
+            " (BASELINE.md:20-23).  The reference publishes no numbers"
+            " (BASELINE.md:3-8); the target is the denominator for"
+            " vs_baseline in each bench's JSON output.\n\n"
+        )
+        f.write("| Config | Metric | Value | Unit | Note |\n|---|---|---|---|---|\n")
+        for r in rows:
+            f.write("| " + " | ".join(str(x) for x in r) + " |\n")
+        f.write("\n## Runner notes (stderr `#` lines)\n\n")
+        for name, notes in all_notes:
+            f.write(f"### {name}\n\n")
+            for n in notes:
+                f.write(f"- {n}\n")
+            f.write("\n")
+    print(f"wrote {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -140,6 +140,9 @@ class Client:
         self._engine_config = o.engine_config
         self._use_device = o.use_device
         self._profile_dir = o.profile_dir
+        # jax.profiler allows one active trace per process: profiled
+        # dispatches serialize so concurrent check() calls don't collide
+        self._profile_lock = threading.Lock()
         self._lock = threading.Lock()
         self._engine: Optional[DeviceEngine] = None
         self._engine_schema = None  # CompiledSchema the engine was built for
@@ -280,9 +283,12 @@ class Client:
                 if self._profile_dir is not None:
                     import jax
 
+                    self._profile_lock.acquire()
                     prof = jax.profiler.trace(self._profile_dir)
+                    unlock = self._profile_lock.release
                 else:
                     prof = contextlib.nullcontext()
+                    unlock = lambda: None
                 try:
                     with prof, self._metrics.timer("checks.device_time_s"):
                         d, p, ovf = engine.check_batch(dsnap, rels)
@@ -291,6 +297,8 @@ class Client:
                     if "RESOURCE_EXHAUSTED" in msg or "UNAVAILABLE" in msg:
                         raise UnavailableError(msg) from e
                     raise
+                finally:
+                    unlock()
                 needs_host = (p & ~d) | ovf
                 if not needs_host.any():
                     self._metrics.inc("checks.device_definite", len(rels))

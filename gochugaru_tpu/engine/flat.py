@@ -49,6 +49,7 @@ from .hash import (
     build_range_hash,
     probe_range,
     probe_rows,
+    take_in_bounds,
 )
 from .plan import DevicePlan, EngineConfig, ExprIR, _eval_cyclic_pairs
 
@@ -65,12 +66,17 @@ class FlatMeta:
     Keys are PACKED into ≤2 int32 columns (``N``/``S1`` radices) — every
     probe step then costs 3 gathers (rows + 2 keys) instead of 5, and
     range probes cost 2.  Graphs too large to pack (num_nodes·num_slots ≥
-    2³¹) skip the flat engine and use the legacy two-phase kernel."""
+    2³¹) skip the flat engine and use the legacy two-phase kernel.
 
-    N: int  # node-id radix (num_nodes)
+    Every count is a pow2 BUCKET (padded array length), not an exact row
+    count, and the node radix rounds to pow2 — so Watch-driven deltas keep
+    the same FlatMeta (and the same compiled kernel) until a table crosses
+    a pow2 boundary, instead of recompiling on every revision."""
+
+    N: int  # node-id packing radix: pow2 ≥ num_nodes
     S1: int  # num_slots + 1 (srel1 radix)
     e_cap: int
-    e_n: int
+    e_n: int  # padded primary-row bucket
     usr_cap: int  # userset (rel, res) range-group table
     usr_gn: int
     us_rows: int
@@ -79,10 +85,12 @@ class FlatMeta:
     ar_rows: int
     cl_cap: int  # flattened closure pair table
     cl_n: int
+    has_closure: bool
     pus_cap: int
     pus_n: int
     ovf_cap: int  # closure-overflow source table
     ovf_n: int
+    has_ovf: bool
     #: ((rel_slot, max_fanout_pow2), ...) actual max children per (slot,
     #: resource) in the arrow view — folder trees have 1 parent, so the
     #: unrolled lattice stays narrow regardless of the config cap
@@ -110,6 +118,19 @@ class FlatMeta:
 
 
 def _round_cap(c: int) -> int:
+    """Hash-probe caps bucket to pow2 with a floor of 4: a few extra
+    unrolled probe steps are cheaper than recompiling the kernel every
+    time a delta nudges a table's max bucket occupancy between 1, 2, 4."""
+    for p in (4, 8, 16, 32):
+        if c <= p:
+            return p
+    return c
+
+
+def _round_fan(c: int) -> int:
+    """Arrow/userset fan-outs bucket to pow2 with NO floor: a folder tree
+    with 1 parent must keep its width-1 lattice (4^depth would blow the
+    flat_max_width budget and degrade deep grants to host fallbacks)."""
     for p in (1, 2, 4, 8, 16, 32):
         if c <= p:
             return p
@@ -131,7 +152,8 @@ def build_flat_arrays(
     num_slots ≥ 2³¹; such graphs use the legacy engine)."""
     from ..store.closure import NEVER, build_closure
 
-    N = max(snap.num_nodes, 1)
+    # pow2 radix: stable across deltas until the node count doubles
+    N = _ceil_pow2(max(snap.num_nodes, 1), 8)
     S1 = snap.num_slots + 1
     if N * snap.num_slots >= 2**31 or N * S1 >= 2**31:
         return None
@@ -204,19 +226,23 @@ def build_flat_arrays(
             first[1:] = slots_of[1:] != slots_of[:-1]
             starts = np.nonzero(first)[0]
             for s, m in zip(slots_of[starts], np.maximum.reduceat(lens, starts)):
-                fans[int(s)] = _round_cap(int(m))
+                fans[int(s)] = _round_fan(int(m))
         return fans
 
     meta = FlatMeta(
         N=N, S1=S1,
-        e_cap=_round_cap(eh.cap), e_n=eh.n,
-        usr_cap=_round_cap(usr.index.cap), usr_gn=usr.index.n,
-        us_rows=int(snap.us_rel.shape[0]),
-        arr_cap=_round_cap(arr.index.cap), arr_gn=arr.index.n,
-        ar_rows=int(snap.ar_rel.shape[0]),
-        cl_cap=_round_cap(clh.cap), cl_n=clh.n,
-        pus_cap=_round_cap(push.cap), pus_n=push.n,
-        ovf_cap=_round_cap(ovfh.cap), ovf_n=ovfh.n,
+        e_cap=_round_cap(eh.cap), e_n=_ceil_pow2(max(eh.n, 1)),
+        usr_cap=_round_cap(usr.index.cap),
+        usr_gn=_ceil_pow2(max(usr.index.n, 1)),
+        us_rows=_ceil_pow2(max(int(snap.us_rel.shape[0]), 1)),
+        arr_cap=_round_cap(arr.index.cap),
+        arr_gn=_ceil_pow2(max(arr.index.n, 1)),
+        ar_rows=_ceil_pow2(max(int(snap.ar_rel.shape[0]), 1)),
+        cl_cap=_round_cap(clh.cap), cl_n=_ceil_pow2(max(clh.n, 1)),
+        has_closure=clh.n > 0,
+        pus_cap=_round_cap(push.cap), pus_n=_ceil_pow2(max(push.n, 1)),
+        ovf_cap=_round_cap(ovfh.cap), ovf_n=_ceil_pow2(max(ovfh.n, 1)),
+        has_ovf=ovfh.n > 0,
         ar_fanout_by_slot=tuple(sorted(run_maxes(arr.gk, arr.glo, arr.ghi).items())),
         us_fanout_by_slot=tuple(sorted(run_maxes(usr.gk, usr.glo, usr.ghi).items())),
         e_hascav=bool(snap.e_caveat.any()),
@@ -298,6 +324,14 @@ def make_flat_fn(
         else:
             tables = None
         node_type = arrs["node_type"]
+        Nc0 = jnp.int32(meta.N)
+        # ids interned AFTER this snapshot (shared append-only interner,
+        # older pinned generation) exceed the packing radix: treat them as
+        # invalid (-1) — they have no edges at this revision, so every
+        # probe must miss, and aliased packed keys must never be formed
+        q_res = jnp.where(q_res < Nc0, q_res, -1)
+        q_subj = jnp.where(q_subj < Nc0, q_subj, -1)
+        q_wc = jnp.where(q_wc < Nc0, q_wc, -1)
         # wildcard closure-source only applies to direct-object subjects
         q_wcc = jnp.where(q_srel1 == 0, q_wc, -1)
 
@@ -307,6 +341,8 @@ def make_flat_fn(
 
         def reduceB(x):
             return x if x.ndim == 1 else jnp.any(x, axis=tuple(range(1, x.ndim)))
+
+        tk = take_in_bounds  # indices below are clipped non-negative
 
         _view_flags = {
             "e": (meta.e_hascav, meta.e_hasexp),
@@ -324,15 +360,15 @@ def make_flat_fn(
             rc = jnp.clip(rowidx, 0, arrs[prefix + "_caveat"].shape[0] - 1)
             live = hit
             if hasexp:
-                exp = arrs[prefix + "_exp"][rc]
+                exp = tk(arrs[prefix + "_exp"], rc)
                 live = hit & ((exp == 0) | (exp > now))
             if not hascav:
                 return live, live
-            cav = arrs[prefix + "_caveat"][rc]
+            cav = tk(arrs[prefix + "_caveat"], rc)
             if tri is None:
                 d = live & (cav == 0)
                 return d, live
-            ctxc = arrs[prefix + "_ctx"][rc]
+            ctxc = tk(arrs[prefix + "_ctx"], rc)
             qb = jnp.broadcast_to(bq(q_ctx, rowidx.ndim), cav.shape)
             t = tri(cav, ctxc, qb, tables)
             return live & (t == 2), live & (t >= 1)
@@ -347,7 +383,7 @@ def make_flat_fn(
         def cl_probe(srck, gk):
             """Closure containment per plane via until-value comparison.
             Keys are packed (src·S1+srel1, g·S1+grel+1); -1 never matches."""
-            if meta.cl_n == 0:
+            if not meta.has_closure:
                 z = jnp.zeros(
                     jnp.broadcast_shapes(jnp.shape(srck), jnp.shape(gk)), bool
                 )
@@ -360,8 +396,8 @@ def make_flat_fn(
             rc = jnp.clip(row, 0, arrs["cl_k1"].shape[0] - 1)
             hit = row >= 0
             return (
-                hit & (arrs["cl_d_until"][rc] > now),
-                hit & (arrs["cl_p_until"][rc] > now),
+                hit & (tk(arrs["cl_d_until"], rc) > now),
+                hit & (tk(arrs["cl_p_until"], rc) > now),
             )
 
         zB = jnp.zeros(q_res.shape, bool)
@@ -395,7 +431,7 @@ def make_flat_fn(
             # by `exists` wherever the (possibly aliased) probe lands
             k1 = sc * Nc + jnp.where(exists, nodes, 0)
 
-            if (meta.e_n > 0) if dyn else (slot in meta.e_slots):
+            if bool(meta.e_slots) if dyn else (slot in meta.e_slots):
                 ecols = (arrs["e_k1"], arrs["e_k2"])
                 row = probe_rows(
                     arrs["eh_off"], arrs["eh_rows"], ecols,
@@ -421,8 +457,8 @@ def make_flat_fn(
                 valid = (idx < hi[..., None]) & exists[..., None]
                 used = used | reduceB(valid)
                 idxc = jnp.clip(idx, 0, max(meta.us_rows - 1, 0))
-                s = arrs["us_subj"][idxc]
-                r = arrs["us_srel"][idxc]
+                s = tk(arrs["us_subj"], idxc)
+                r = tk(arrs["us_srel"], idxc)
                 gk = s * S1c + (r + 1)  # padded rows (-1, -1) → negative
                 nd2 = nd + 1
                 in_d, in_p = cl_probe(bq(q_k2, nd2), gk)
@@ -431,7 +467,7 @@ def make_flat_fn(
                     in_d, in_p = in_d | win_d, in_p | win_p
                 refl = (gk == bq(q_k2, nd2)) & (bq(q_k2, nd2) >= 0)
                 if plan.has_permission_usersets:
-                    permf = arrs["us_perm"][idxc] != 0
+                    permf = tk(arrs["us_perm"], idxc) != 0
                     in_pus = probe_rows(
                         arrs["push_off"], arrs["push_rows"],
                         (arrs["pus_k"],), (gk,),
@@ -460,7 +496,11 @@ def make_flat_fn(
                 if tname in types
             ]
             if progs:
-                ntype = jnp.where(nodes >= 0, node_type[jnp.clip(nodes, 0)], -1)
+                ntype = jnp.where(
+                nodes >= 0,
+                tk(node_type, jnp.clip(nodes, 0, node_type.shape[0] - 1)),
+                -1,
+            )
             for (tname, tid, expr) in progs:
                 mask = ntype == tid_map[tid]
                 if (tname, slot) in cyclic and stack.count(
@@ -532,7 +572,7 @@ def make_flat_fn(
                 idx = lo[..., None] + jnp.arange(Ks, dtype=jnp.int32)
                 valid = (idx < hi[..., None]) & exists[..., None]
                 idxc = jnp.clip(idx, 0, max(meta.ar_rows - 1, 0))
-                children = jnp.where(valid, arrs["ar_child"][idxc], -1)
+                children = jnp.where(valid, tk(arrs["ar_child"], idxc), -1)
                 gd, gp = gate2("ar", idxc, valid)
                 cd, cp, co, cu = eval_slot(ir[2], children, stack, child_types)
                 return (
@@ -566,7 +606,7 @@ def make_flat_fn(
         # subject-closure overflow: the flattened table is incomplete for
         # these sources, so any query that touched a userset probe falls
         # back to the host oracle
-        if meta.ovf_n == 0:
+        if not meta.has_ovf:
             q_cl_ovf = zB
         else:
             def ovf_probe(k):
@@ -580,7 +620,7 @@ def make_flat_fn(
         valid_q = (q_res >= 0) & (q_perm >= 0)
         # one dynamic-slot leaf site answers every query whose permission
         # is (also) a stored relation; per-slot work below is programs only
-        if meta.e_n > 0 or meta.us_rows > 0:
+        if meta.e_slots or meta.us_fanout_by_slot:
             d_out, p_out, lovf, lused = leaf(None, q_res)
             ovf_out = lovf | (q_cl_ovf & lused)
         else:

@@ -148,22 +148,32 @@ def build_range_hash(k: np.ndarray, **kw) -> RangeIndex:
 # ---------------------------------------------------------------------------
 
 
+def take_in_bounds(a, i):
+    """Gather with mode=promise_in_bounds: for indices that are in range
+    BY CONSTRUCTION (hash & mask, clipped slots, row ids), skipping the
+    per-gather negative-index normalization chains XLA otherwise emits.
+    Callers must clip/mask — out-of-range indices are undefined behavior."""
+    return a.at[i].get(mode="promise_in_bounds")
+
+
 def _probe_rows_impl(off, rows, key_cols, q_cols, cap: int, n: int):
     import jax.numpy as jnp
 
+    take = take_in_bounds
+
     size = off.shape[0] - 1
     h = (mix32(q_cols, jnp) & jnp.uint32(size - 1)).astype(jnp.int32)
-    start = off[h]
-    end = off[h + 1]
+    start = take(off, h)
+    end = take(off, h + 1)
     found = jnp.full(jnp.shape(h), -1, jnp.int32)
     last = max(n - 1, 0)
     for j in range(cap):
         slot = start + j
         valid = slot < end
-        idx = rows[jnp.clip(slot, 0, last)]
+        idx = take(rows, jnp.clip(slot, 0, last))
         hit = valid
         for kc, qc in zip(key_cols, q_cols):
-            hit = hit & (kc[idx] == qc)
+            hit = hit & (take(kc, idx) == qc)
         found = jnp.where((found < 0) & hit, idx, found)
     return found
 
@@ -196,6 +206,6 @@ def probe_range(ri_arrays, cap: int, n: int, q):
     )
     gic = jnp.clip(gi, 0, max(n - 1, 0))
     hit = gi >= 0
-    lo = jnp.where(hit, ri_arrays["glo"][gic], 0)
-    hi = jnp.where(hit, ri_arrays["ghi"][gic], 0)
+    lo = jnp.where(hit, take_in_bounds(ri_arrays["glo"], gic), 0)
+    hi = jnp.where(hit, take_in_bounds(ri_arrays["ghi"], gic), 0)
     return lo, hi

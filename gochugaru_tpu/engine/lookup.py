@@ -29,8 +29,11 @@ adjacency"):
 
 Cost: candidate expansion is O(result-neighborhood · log E) host work
 with no per-edge Python; the exact filter is one device dispatch over
-|candidates| queries — at 1M docs this is milliseconds of device time,
-vs minutes of recursive host checks.
+|candidates| queries.  Measured at BASELINE config-3 scale (1M docs /
+~10M edges, benchmarks/bench3_docs.py, single-core host): ~180 ms warm
+per lookup for a ~7k-result subject — vs minutes of recursive host
+checks.  The first lookup on a revision additionally builds (or, after
+a delta, incrementally advances) the transposed index.
 """
 
 from __future__ import annotations

@@ -663,7 +663,11 @@ class Store:
         # by concurrent head writes (round-2 Weak #5) — every access moves
         # its generation to the back via _snap_touch
         while len(self._snapshots) > self._keep_generations:
-            self._snapshots.pop(next(iter(self._snapshots)))
+            # never evict the newest materialized generation: MIN_LATENCY
+            # reads must not move backwards in revision
+            newest = max(self._snapshots)
+            victim = next(k for k in self._snapshots if k != newest)
+            self._snapshots.pop(victim)
         return snap
 
     def _snap_touch(self, rev: int) -> Snapshot:
