@@ -113,14 +113,16 @@ def main() -> int:
         lines, notes, reason = run_config(name, cmd, timeout_s, env)
         all_notes.append((name, notes))
         if not lines:
-            rows.append((name, "—", "failed", "—", reason or "no output"))
+            rows.append((name, "—", "failed", "—", "—", reason or "no output"))
             continue
         for parsed in lines:
+            vs = parsed.get("vs_baseline")
             rows.append((
                 name,
                 parsed.get("metric", "?"),
                 f"{parsed.get('value', 0):,.1f}",
                 parsed.get("unit", ""),
+                f"{vs:.4f}" if isinstance(vs, (int, float)) else "—",
                 parsed.get("note", ""),
             ))
 
@@ -136,7 +138,10 @@ def main() -> int:
             " (BASELINE.md:3-8); the target is the denominator for"
             " vs_baseline in each bench's JSON output.\n\n"
         )
-        f.write("| Config | Metric | Value | Unit | Note |\n|---|---|---|---|---|\n")
+        f.write(
+            "| Config | Metric | Value | Unit | vs north star | Note |\n"
+            "|---|---|---|---|---|---|\n"
+        )
         for r in rows:
             f.write("| " + " | ".join(str(x) for x in r) + " |\n")
         f.write("\n## Runner notes (stderr `#` lines)\n\n")

@@ -115,6 +115,16 @@ class FlatMeta:
     #: per (slot, resource) — org⟶2 teams means 2 closure probes, not the
     #: config cap of 8
     us_fanout_by_slot: Tuple[Tuple[int, int], ...] = ()
+    #: T-index: the materialized (slot·N+res, member-key) → until-values
+    #: join of userset edges with the closure — a userset grant test is
+    #: ONE probe.  ``t_slots`` are the slots it covers (no caveated /
+    #: permission-valued userset rows); ``t_all`` = it covers every
+    #: us-bearing slot, so the dynamic root leaf can skip the KU path
+    has_tindex: bool = False
+    t_cap: int = 4
+    t_n: int = 8
+    t_slots: Tuple[int, ...] = ()
+    t_all: bool = False
 
 
 def _round_cap(c: int) -> int:
@@ -150,7 +160,7 @@ def build_flat_arrays(
     padded host arrays (merged into DeviceSnapshot.arrays) and the static
     FlatMeta — or None when keys don't pack into int32 (num_nodes ·
     num_slots ≥ 2³¹; such graphs use the legacy engine)."""
-    from ..store.closure import NEVER, build_closure
+    from ..store.closure import NEVER, NO_EXP, _expand_join, build_closure
 
     # pow2 radix: stable across deltas until the node count doubles
     N = _ceil_pow2(max(snap.num_nodes, 1), 8)
@@ -213,6 +223,76 @@ def build_flat_arrays(
     out["pus_k"] = _pad(pus_k, _ceil_pow2(max(pus_k.shape[0], 1)), -1)
     out["ovf_k"] = _pad(ovf_k, _ceil_pow2(max(ovf_k.shape[0], 1)), -1)
 
+    # ---- T-index: userset edges ⋈ closure-by-target ---------------------
+    # For slots whose userset rows carry no caveats and no permission-
+    # valued subjects, fold {edge expiry × closure semiring} into ONE
+    # (slot·N+res, member-key) → (d_until, p_until) table: the kernel's
+    # userset block becomes a single hash probe.  Size-capped; ineligible
+    # or oversized → the KU probe path still answers.
+    t_kw = dict(has_tindex=False, t_cap=4, t_n=8, t_slots=(), t_all=False)
+    if config.flat_tindex and snap.us_rel.shape[0]:
+        ok = (snap.us_caveat == 0) & (snap.us_perm == 0)
+        pe_all = pk(snap.us_subj, S1, snap.us_srel + 1)
+        if snap.pus_n.shape[0]:
+            pus_sorted = np.sort(pus_k)
+            pos = np.clip(
+                np.searchsorted(pus_sorted, pe_all), 0, pus_sorted.shape[0] - 1
+            )
+            ok &= ~(pus_sorted[pos] == pe_all)
+        bad_slots = np.unique(snap.us_rel[~ok])
+        elig = ~np.isin(snap.us_rel, bad_slots)
+        if elig.any():
+            tgt = cl_k2
+            t_order = np.argsort(tgt, kind="stable")
+            pe = pe_all[elig]
+            ek1 = us_gk[elig]
+            w = np.where(
+                snap.us_exp[elig] == 0, np.int64(NO_EXP),
+                snap.us_exp[elig].astype(np.int64),
+            ).astype(np.int32)
+            cap_rows = config.flat_tindex_factor * max(
+                int(snap.us_rel.shape[0]), 1024
+            )
+            # size the join BEFORE materializing it: a popular group with
+            # a huge closure in-degree must disable the index, not OOM
+            tgt_sorted = tgt[t_order]
+            join_rows = int(
+                (
+                    np.searchsorted(tgt_sorted, pe, "right")
+                    - np.searchsorted(tgt_sorted, pe, "left")
+                ).sum()
+            )
+            if join_rows + pe.shape[0] <= cap_rows:
+                reps, ii = _expand_join(tgt_sorted, pe)
+                jj = t_order[ii]
+                T_k1 = np.concatenate([ek1, ek1[reps]])
+                T_k2 = np.concatenate([pe, cl_k1[jj]])
+                T_d = np.concatenate([w, np.minimum(w[reps], cl.c_d_until[jj])])
+                T_p = np.concatenate([w, np.minimum(w[reps], cl.c_p_until[jj])])
+                o2 = np.lexsort((T_k2, T_k1))
+                T_k1, T_k2 = T_k1[o2], T_k2[o2]
+                T_d, T_p = T_d[o2], T_p[o2]
+                first = np.ones(T_k1.shape[0], bool)
+                first[1:] = (T_k1[1:] != T_k1[:-1]) | (T_k2[1:] != T_k2[:-1])
+                st = np.nonzero(first)[0]
+                T_k1, T_k2 = T_k1[first], T_k2[first]
+                T_d = np.maximum.reduceat(T_d, st)
+                T_p = np.maximum.reduceat(T_p, st)
+                th = build_hash([T_k1, T_k2])
+                put_hash("th", th)
+                TP = _ceil_pow2(max(T_k1.shape[0], 1))
+                out["t_k1"] = _pad(T_k1, TP, -1)
+                out["t_k2"] = _pad(T_k2, TP, -1)
+                out["t_d"] = _pad(T_d, TP, NEVER)
+                out["t_p"] = _pad(T_p, TP, NEVER)
+                t_kw = dict(
+                    has_tindex=True,
+                    t_cap=_round_cap(th.cap),
+                    t_n=_ceil_pow2(max(th.n, 1)),
+                    t_slots=tuple(int(s) for s in np.unique(snap.us_rel[elig])),
+                    t_all=bad_slots.size == 0,
+                )
+
     wc_nodes = snap.wildcard_node_of_type[snap.wildcard_node_of_type >= 0]
 
     def run_maxes(gk: np.ndarray, glo: np.ndarray, ghi: np.ndarray):
@@ -245,6 +325,7 @@ def build_flat_arrays(
         has_ovf=ovfh.n > 0,
         ar_fanout_by_slot=tuple(sorted(run_maxes(arr.gk, arr.glo, arr.ghi).items())),
         us_fanout_by_slot=tuple(sorted(run_maxes(usr.gk, usr.glo, usr.ghi).items())),
+        **t_kw,
         e_hascav=bool(snap.e_caveat.any()),
         e_hasexp=bool(snap.e_exp.any()),
         us_hascav=bool(snap.us_caveat.any()),
@@ -447,8 +528,42 @@ def make_flat_fn(
                     wd, wp = gate2("e", wrow, (wrow >= 0) & exists)
                     d, p = d | wd, p | wp
 
+            # T-index fast path: one probe folds {userset edge × closure}
+            use_t = meta.has_tindex and (
+                meta.t_all if dyn else (slot in meta.t_slots)
+            )
+            if use_t:
+                trow = probe_rows(
+                    arrs["th_off"], arrs["th_rows"],
+                    (arrs["t_k1"], arrs["t_k2"]), (k1, bq(q_k2, nd)),
+                    meta.t_cap, meta.t_n,
+                )
+                trc = jnp.clip(trow, 0, arrs["t_k1"].shape[0] - 1)
+                thit = (trow >= 0) & exists
+                d = d | (thit & (tk(arrs["t_d"], trc) > now))
+                p = p | (thit & (tk(arrs["t_p"], trc) > now))
+                if meta.has_wc_closure:
+                    wtrow = probe_rows(
+                        arrs["th_off"], arrs["th_rows"],
+                        (arrs["t_k1"], arrs["t_k2"]), (k1, bq(wcl_k, nd)),
+                        meta.t_cap, meta.t_n,
+                    )
+                    wtrc = jnp.clip(wtrow, 0, arrs["t_k1"].shape[0] - 1)
+                    wthit = (wtrow >= 0) & exists
+                    d = d | (wthit & (tk(arrs["t_d"], wtrc) > now))
+                    p = p | (wthit & (tk(arrs["t_p"], wtrc) > now))
+                if meta.has_ovf:
+                    # T is incomplete for overflowed closure sources: flag
+                    # queries whose (slot, node) has userset rows at all
+                    lo2, hi2 = range_of("usr", meta.usr_cap, meta.usr_gn, k1)
+                    used = used | reduceB(exists & (hi2 > lo2))
+
+            # KU probe path: ineligible slots, or — for the dynamic root
+            # leaf on a mixed schema — every slot (eligible ones repeat
+            # the T answer, which is sound under OR)
+            run_ku = (not use_t) or (dyn and not meta.t_all)
             KU_site = min(KU, us_fan_max if dyn else us_fans.get(slot, 0))
-            if KU_site > 0:
+            if run_ku and KU_site > 0:
                 # userset grants: gather the (slot, node) edge block, test
                 # each subject pair against the flattened closure
                 lo, hi = range_of("usr", meta.usr_cap, meta.usr_gn, k1)

@@ -59,6 +59,12 @@ class EngineConfig:
     #: max product of arrow-child dims per query in the unrolled lattice;
     #: beyond it an arrow probes child-existence only (possible → host)
     flat_max_width: int = 256
+    #: materialize the userset-grant join index (engine/flat.py T-index):
+    #: us-edges ⋈ closure, so a userset grant test is ONE hash probe
+    flat_tindex: bool = True
+    #: T-index size budget as a multiple of the userset row count;
+    #: exceeding it disables the index (KU probe path still answers)
+    flat_tindex_factor: int = 64
 
     @staticmethod
     def for_schema(compiled: CompiledSchema, **overrides) -> "EngineConfig":

@@ -248,8 +248,17 @@ def test_userset_fanout_overflow_flags():
         rel.must_from_tuple("doc:d#reader", f"group:g{i}#member") for i in range(12)
     ]
     rels.append(rel.must_from_tuple("group:g11#member", "user:u"))
-    engine, dsnap, oracle = world(FEATURES, rels, us_leaf_cap=4)
     checks = [rel.must_from_triple("doc:d", "read", "user:u")]
+    # the T-index has no per-(slot, resource) fanout cap: 12 userset edges
+    # answer exactly in one probe
+    engine, dsnap, oracle = world(FEATURES, rels, us_leaf_cap=4)
+    assert dsnap.flat_meta.has_tindex
+    d, p, ovf = engine.check_batch(dsnap, checks, now_us=NOW)
+    assert bool(d[0]) and not ovf[0]
+    # the KU probe path must flag the capped fanout instead
+    engine, dsnap, oracle = world(
+        FEATURES, rels, us_leaf_cap=4, flat_tindex=False
+    )
     d, p, ovf = engine.check_batch(dsnap, checks, now_us=NOW)
     assert ovf[0]
 
@@ -327,3 +336,69 @@ def test_empty_world_and_empty_batch():
     checks = [rel.must_from_triple("doc:d", "read", "user:u")]
     d, p, ovf = engine.check_batch(dsnap, checks, now_us=NOW)
     assert not d[0] and not p[0] and not ovf[0]
+
+
+def test_tindex_matches_ku_path_and_oracle():
+    """The T-index (userset edges ⋈ closure) must answer identically to
+    the KU probe path on eligible worlds."""
+    rng = random.Random(21)
+    rels = [r for r in build_feature_world(rng) if not r.caveat_name]
+    checks = [c for c in make_checks(rng, 10, 10)]
+    eng_t, ds_t, oracle = world(FEATURES, rels)
+    assert ds_t.flat_meta.has_tindex
+    eng_k, ds_k, _ = world(FEATURES, rels, flat_tindex=False)
+    assert not ds_k.flat_meta.has_tindex
+    td, tp, tovf = eng_t.check_batch(ds_t, checks, now_us=NOW)
+    kd, kp, kovf = eng_k.check_batch(ds_k, checks, now_us=NOW)
+    for i, q in enumerate(checks):
+        assert bool(td[i]) == bool(kd[i]), q
+        assert bool(tp[i]) == bool(kp[i]), q
+    assert_sound_cascade(eng_t, ds_t, oracle, checks)
+
+
+def test_tindex_ineligible_slots_fall_back():
+    # a caveated userset row makes its slot ineligible; a permission-
+    # valued userset slot likewise — answers stay correct via KU/pus
+    schema = """
+    caveat c(x int) { x > 0 }
+    definition user {}
+    definition team {
+        relation lead: user
+        permission heads = lead
+    }
+    definition group { relation member: user }
+    definition doc {
+        relation reader: group#member with c
+        relation auditor: team#heads
+        relation viewer: group#member
+        permission read = reader
+        permission audit = auditor
+        permission view = viewer
+    }
+    """
+    rels = [
+        rel.must_from_tuple("group:g#member", "user:u"),
+        rel.must_from_tuple("doc:d#reader", "group:g#member").with_caveat("c", {"x": 1}),
+        rel.must_from_tuple("team:t#lead", "user:v"),
+        rel.must_from_tuple("doc:d#auditor", "team:t#heads"),
+        rel.must_from_tuple("doc:d#viewer", "group:g#member"),
+    ]
+    engine, dsnap, oracle = world(schema, rels)
+    meta = dsnap.flat_meta
+    viewer = engine.compiled.slot_of_name["viewer"]
+    reader = engine.compiled.slot_of_name["reader"]
+    auditor = engine.compiled.slot_of_name["auditor"]
+    if meta.has_tindex:
+        assert viewer in meta.t_slots
+        assert reader not in meta.t_slots
+        assert auditor not in meta.t_slots
+        assert not meta.t_all
+    checks = [
+        rel.must_from_triple("doc:d", "view", "user:u"),
+        rel.must_from_triple("doc:d", "read", "user:u").with_caveat("", {"x": 5}),
+        rel.must_from_triple("doc:d", "audit", "user:v"),
+        rel.must_from_triple("doc:d", "audit", "user:u"),
+    ]
+    assert_sound_cascade(engine, dsnap, oracle, checks)
+    d, p, ovf = engine.check_batch(dsnap, checks, now_us=NOW)
+    assert d[0]  # T-index slot decides on device
