@@ -91,11 +91,17 @@ def build_hash(
     cols = [np.ascontiguousarray(c, np.int32) for c in key_cols]
     h_full = mix32(cols, np)
     size = _ceil_pow2(2 * n, min_size)
+    # growth chases a small max bucket, but the max of n Poisson draws
+    # grows with log n: beyond ~16M rows target_cap=4 is statistically
+    # unreachable and doubling would only balloon the offsets array (the
+    # 100M-edge table would hit 2^31 buckets) — freeze size and accept
+    # the larger probe cap instead
+    limit = size if n > (1 << 24) else size * max_factor
     while True:
         h = (h_full & np.uint32(size - 1)).astype(np.int64)
         counts = np.bincount(h, minlength=size)
         cap = int(counts.max())
-        if cap <= target_cap or size >= max_factor * _ceil_pow2(2 * n, min_size):
+        if cap <= target_cap or size >= limit:
             break
         size <<= 1
     rows = np.argsort(h, kind="stable").astype(np.int32)
