@@ -341,10 +341,26 @@ class Client:
     # ------------------------------------------------------------------
     # Deletes (client/client.go:317-358)
     # ------------------------------------------------------------------
+    @staticmethod
+    def _as_preconditioned(pf) -> PreconditionedFilter:
+        """Accept a bare Filter where the reference's signature takes a
+        *PreconditionedFilter (client/client.go:319,340) — Go's type system
+        makes the wrapping explicit; here a filter with no preconditions
+        means the same thing, so wrap instead of failing deep in the
+        store."""
+        if isinstance(pf, PreconditionedFilter):
+            return pf
+        if isinstance(pf, Filter):
+            return PreconditionedFilter(pf)
+        raise TypeError(
+            f"expected Filter or PreconditionedFilter, got {type(pf).__name__}"
+        )
+
     def delete_atomic(self, ctx: Context, pf: PreconditionedFilter) -> str:
         """Remove all matching relationships in one transaction.
         Explicitly NO retry (client/client.go:322)."""
         self._check_overlap(ctx)
+        pf = self._as_preconditioned(pf)
         revision, complete = self._store.delete_by_filter(pf, limit=0)
         if not complete:
             raise PartialDeletionError(
@@ -356,6 +372,7 @@ class Client:
         """Remove all matching relationships in batches of 10,000 with
         retry (client/client.go:340-358)."""
         self._check_overlap(ctx)
+        pf = self._as_preconditioned(pf)
         while True:
             _, complete = retry_retriable_errors(
                 ctx, lambda: self._store.delete_by_filter(pf, limit=DELETE_BATCH)

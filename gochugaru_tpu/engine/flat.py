@@ -47,8 +47,12 @@ from .hash import (
     _ceil_pow2,
     build_hash,
     build_range_hash,
+    interleave_buckets,
+    interleave_rows,
+    probe_block,
     probe_range,
     probe_rows,
+    slice_blocks,
     take_in_bounds,
 )
 from .plan import DevicePlan, EngineConfig, ExprIR, _eval_cyclic_pairs
@@ -125,6 +129,39 @@ class FlatMeta:
     t_n: int = 8
     t_slots: Tuple[int, ...] = ()
     t_all: bool = False
+    #: any permission-valued userset rows in THIS snapshot (drives whether
+    #: the interleaved userset view carries a ``perm`` column)
+    us_hasperm: bool = False
+    #: block-slice layout active (bucket-ordered interleaved tables probed
+    #: with one contiguous [cap, w] slice per query — see engine/hash.py)
+    blockslice: bool = False
+
+
+def _gate_cols(hascav: bool, hasexp: bool) -> list:
+    return (["cav", "ctx"] if hascav else []) + (["exp"] if hasexp else [])
+
+
+def _lay(names: list) -> Dict[str, int]:
+    return {n: i for i, n in enumerate(names)}
+
+
+def e_layout(meta: "FlatMeta") -> Dict[str, int]:
+    """Column layout of the interleaved primary-edge bucket table."""
+    return _lay(["k1", "k2"] + _gate_cols(meta.e_hascav, meta.e_hasexp))
+
+
+def us_layout(meta: "FlatMeta") -> Dict[str, int]:
+    """Column layout of the interleaved userset-view row table."""
+    return _lay(
+        ["subj", "srel"]
+        + _gate_cols(meta.us_hascav, meta.us_hasexp)
+        + (["perm"] if meta.us_hasperm else [])
+    )
+
+
+def ar_layout(meta: "FlatMeta") -> Dict[str, int]:
+    """Column layout of the interleaved arrow-view row table."""
+    return _lay(["child"] + _gate_cols(meta.ar_hascav, meta.ar_hasexp))
 
 
 def _round_cap(c: int) -> int:
@@ -190,6 +227,15 @@ def build_flat_arrays(
     ovfh = build_hash([ovf_k])
 
     out: Dict[str, np.ndarray] = {}
+    BS = config.flat_blockslice
+    # view flags, computed up front: they pick the interleaved layouts
+    e_hascav = bool(snap.e_caveat.any())
+    e_hasexp = bool(snap.e_exp.any())
+    us_hascav = bool(snap.us_caveat.any())
+    us_hasexp = bool(snap.us_exp.any())
+    us_hasperm = bool(snap.us_perm.any())
+    ar_hascav = bool(snap.ar_caveat.any())
+    ar_hasexp = bool(snap.ar_exp.any())
 
     def put_hash(prefix: str, h) -> None:
         # off keeps its exact size+1 length: the device probe derives the
@@ -205,23 +251,61 @@ def build_flat_arrays(
         out[prefix + "_ghi"] = _pad(r.ghi, G, 0)
         put_hash(prefix, r.index)
 
-    put_hash("eh", eh)
-    put_range("usr", usr)
-    put_range("arr", arr)
-    put_hash("clh", clh)
-    put_hash("push", push)
-    put_hash("ovfh", ovfh)
+    if BS:
+        # block-slice layout: per point-probe table, the bucket offsets +
+        # ONE bucket-ordered interleaved matrix (keys ++ payloads); per
+        # range view, the group table interleaved by bucket and the row
+        # view interleaved in its existing key-sorted order
+        out["eh_off"] = eh.off
+        out["ehx"] = interleave_buckets(
+            eh,
+            [e_k1, e_k2]
+            + ([snap.e_caveat, snap.e_ctx] if e_hascav else [])
+            + ([snap.e_exp] if e_hasexp else []),
+        )
+        out["usr_off"] = usr.index.off
+        out["usgx"] = interleave_buckets(usr.index, [usr.gk, usr.glo, usr.ghi])
+        out["usx"] = interleave_rows(
+            [snap.us_subj, snap.us_srel]
+            + ([snap.us_caveat, snap.us_ctx] if us_hascav else [])
+            + ([snap.us_exp] if us_hasexp else [])
+            + ([snap.us_perm] if us_hasperm else []),
+            pad=max(64, config.us_leaf_cap),
+        )
+        out["arr_off"] = arr.index.off
+        out["argx"] = interleave_buckets(arr.index, [arr.gk, arr.glo, arr.ghi])
+        out["arx"] = interleave_rows(
+            [snap.ar_child]
+            + ([snap.ar_caveat, snap.ar_ctx] if ar_hascav else [])
+            + ([snap.ar_exp] if ar_hasexp else []),
+            pad=max(64, config.arrow_fanout),
+        )
+        out["clh_off"] = clh.off
+        out["clx"] = interleave_buckets(
+            clh, [cl_k1, cl_k2, cl.c_d_until, cl.c_p_until]
+        )
+        out["push_off"] = push.off
+        out["pusx"] = interleave_buckets(push, [pus_k])
+        out["ovfh_off"] = ovfh.off
+        out["ovfx"] = interleave_buckets(ovfh, [ovf_k])
+    else:
+        put_hash("eh", eh)
+        put_range("usr", usr)
+        put_range("arr", arr)
+        put_hash("clh", clh)
+        put_hash("push", push)
+        put_hash("ovfh", ovfh)
 
-    E = _ceil_pow2(max(e_k1.shape[0], 1))
-    out["e_k1"] = _pad(e_k1, E, -1)
-    out["e_k2"] = _pad(e_k2, E, -1)
-    P = _ceil_pow2(max(cl.num_pairs, 1))
-    out["cl_k1"] = _pad(cl_k1, P, -1)
-    out["cl_k2"] = _pad(cl_k2, P, -1)
-    out["cl_d_until"] = _pad(cl.c_d_until, P, NEVER)
-    out["cl_p_until"] = _pad(cl.c_p_until, P, NEVER)
-    out["pus_k"] = _pad(pus_k, _ceil_pow2(max(pus_k.shape[0], 1)), -1)
-    out["ovf_k"] = _pad(ovf_k, _ceil_pow2(max(ovf_k.shape[0], 1)), -1)
+        E = _ceil_pow2(max(e_k1.shape[0], 1))
+        out["e_k1"] = _pad(e_k1, E, -1)
+        out["e_k2"] = _pad(e_k2, E, -1)
+        P = _ceil_pow2(max(cl.num_pairs, 1))
+        out["cl_k1"] = _pad(cl_k1, P, -1)
+        out["cl_k2"] = _pad(cl_k2, P, -1)
+        out["cl_d_until"] = _pad(cl.c_d_until, P, NEVER)
+        out["cl_p_until"] = _pad(cl.c_p_until, P, NEVER)
+        out["pus_k"] = _pad(pus_k, _ceil_pow2(max(pus_k.shape[0], 1)), -1)
+        out["ovf_k"] = _pad(ovf_k, _ceil_pow2(max(ovf_k.shape[0], 1)), -1)
 
     # ---- T-index: userset edges ⋈ closure-by-target ---------------------
     # For slots whose userset rows carry no caveats and no permission-
@@ -279,12 +363,16 @@ def build_flat_arrays(
                 T_d = np.maximum.reduceat(T_d, st)
                 T_p = np.maximum.reduceat(T_p, st)
                 th = build_hash([T_k1, T_k2])
-                put_hash("th", th)
-                TP = _ceil_pow2(max(T_k1.shape[0], 1))
-                out["t_k1"] = _pad(T_k1, TP, -1)
-                out["t_k2"] = _pad(T_k2, TP, -1)
-                out["t_d"] = _pad(T_d, TP, NEVER)
-                out["t_p"] = _pad(T_p, TP, NEVER)
+                if BS:
+                    out["th_off"] = th.off
+                    out["tx"] = interleave_buckets(th, [T_k1, T_k2, T_d, T_p])
+                else:
+                    put_hash("th", th)
+                    TP = _ceil_pow2(max(T_k1.shape[0], 1))
+                    out["t_k1"] = _pad(T_k1, TP, -1)
+                    out["t_k2"] = _pad(T_k2, TP, -1)
+                    out["t_d"] = _pad(T_d, TP, NEVER)
+                    out["t_p"] = _pad(T_p, TP, NEVER)
                 t_kw = dict(
                     has_tindex=True,
                     t_cap=_round_cap(th.cap),
@@ -326,12 +414,14 @@ def build_flat_arrays(
         ar_fanout_by_slot=tuple(sorted(run_maxes(arr.gk, arr.glo, arr.ghi).items())),
         us_fanout_by_slot=tuple(sorted(run_maxes(usr.gk, usr.glo, usr.ghi).items())),
         **t_kw,
-        e_hascav=bool(snap.e_caveat.any()),
-        e_hasexp=bool(snap.e_exp.any()),
-        us_hascav=bool(snap.us_caveat.any()),
-        us_hasexp=bool(snap.us_exp.any()),
-        ar_hascav=bool(snap.ar_caveat.any()),
-        ar_hasexp=bool(snap.ar_exp.any()),
+        e_hascav=e_hascav,
+        e_hasexp=e_hasexp,
+        us_hascav=us_hascav,
+        us_hasexp=us_hasexp,
+        us_hasperm=us_hasperm,
+        ar_hascav=ar_hascav,
+        ar_hasexp=ar_hasexp,
+        blockslice=BS,
         e_slots=tuple(int(s) for s in np.unique(snap.e_rel)),
         us_slots=tuple(int(s) for s in np.unique(snap.us_rel)),
         has_wc_edges=bool(np.isin(snap.e_subj, wc_nodes).any()),
@@ -424,6 +514,8 @@ def make_flat_fn(
             return x if x.ndim == 1 else jnp.any(x, axis=tuple(range(1, x.ndim)))
 
         tk = take_in_bounds  # indices below are clipped non-negative
+        BS = meta.blockslice
+        eL, usL, arL = e_layout(meta), us_layout(meta), ar_layout(meta)
 
         _view_flags = {
             "e": (meta.e_hascav, meta.e_hasexp),
@@ -454,7 +546,40 @@ def make_flat_fn(
             t = tri(cav, ctxc, qb, tables)
             return live & (t == 2), live & (t >= 1)
 
+        def gate2_blk(prefix: str, blk, lay: Dict[str, int], hit):
+            """gate2 over an interleaved block's payload columns: the gate
+            values ride in the SAME contiguous slice as the keys, so no
+            second gather happens.  Padded/overshoot rows are neutralized
+            through ``hit`` (their gate inputs are clamped first — they may
+            hold -1 or a neighbouring bucket's payloads)."""
+            hascav, hasexp = _view_flags[prefix]
+            if not hascav and not hasexp:
+                return hit, hit
+            live = hit
+            if hasexp:
+                exp = jnp.where(hit, blk[..., lay["exp"]], 0)
+                live = hit & ((exp == 0) | (exp > now))
+            if not hascav:
+                return live, live
+            cav = jnp.where(hit, blk[..., lay["cav"]], 0)
+            if tri is None:
+                return live & (cav == 0), live
+            ctxc = jnp.where(hit, blk[..., lay["ctx"]], -1)
+            qb = jnp.broadcast_to(bq(q_ctx, cav.ndim), cav.shape)
+            t = tri(cav, ctxc, qb, tables)
+            return live & (t == 2), live & (t >= 1)
+
         def range_of(prefix: str, cap: int, n: int, q):
+            if BS:
+                blk = probe_block(
+                    arrs[prefix + "_off"],
+                    arrs[{"usr": "usgx", "arr": "argx"}[prefix]],
+                    cap, (q,),
+                )
+                hit = (blk[..., 0] == q[..., None]) & (q >= 0)[..., None]
+                lo = jnp.max(jnp.where(hit, blk[..., 1], 0), axis=-1)
+                hi = jnp.max(jnp.where(hit, blk[..., 2], 0), axis=-1)
+                return lo, hi
             ri = {
                 k: arrs[prefix + "_" + k]
                 for k in ("gk", "glo", "ghi", "off", "rows")
@@ -469,6 +594,19 @@ def make_flat_fn(
                     jnp.broadcast_shapes(jnp.shape(srck), jnp.shape(gk)), bool
                 )
                 return z, z
+            if BS:
+                blk = probe_block(
+                    arrs["clh_off"], arrs["clx"], meta.cl_cap, (srck, gk)
+                )
+                hit = (
+                    (blk[..., 0] == srck[..., None])
+                    & (blk[..., 1] == gk[..., None])
+                    & ((srck >= 0) & (gk >= 0))[..., None]
+                )
+                return (
+                    jnp.any(hit & (blk[..., 2] > now), axis=-1),
+                    jnp.any(hit & (blk[..., 3] > now), axis=-1),
+                )
             row = probe_rows(
                 arrs["clh_off"], arrs["clh_rows"],
                 (arrs["cl_k1"], arrs["cl_k2"]), (srck, gk),
@@ -513,45 +651,78 @@ def make_flat_fn(
             k1 = sc * Nc + jnp.where(exists, nodes, 0)
 
             if bool(meta.e_slots) if dyn else (slot in meta.e_slots):
-                ecols = (arrs["e_k1"], arrs["e_k2"])
-                row = probe_rows(
-                    arrs["eh_off"], arrs["eh_rows"], ecols,
-                    (k1, bq(q_k2, nd)), meta.e_cap, meta.e_n,
-                )
-                d, p = gate2("e", row, (row >= 0) & exists)
-                if meta.has_wc_edges:
-                    # wildcard edges only grant direct-object subjects
-                    wrow = probe_rows(
+                if BS:
+                    def e_site(k2q):
+                        blk = probe_block(
+                            arrs["eh_off"], arrs["ehx"], meta.e_cap,
+                            (k1, k2q),
+                        )
+                        hit = (
+                            (blk[..., 0] == k1[..., None])
+                            & (blk[..., 1] == k2q[..., None])
+                            & exists[..., None]
+                            & (k2q >= 0)[..., None]
+                        )
+                        hd, hp = gate2_blk("e", blk, eL, hit)
+                        return jnp.any(hd, axis=-1), jnp.any(hp, axis=-1)
+
+                    d, p = e_site(bq(q_k2, nd))
+                    if meta.has_wc_edges:
+                        wd, wp = e_site(bq(w_k2, nd))
+                        d, p = d | wd, p | wp
+                else:
+                    ecols = (arrs["e_k1"], arrs["e_k2"])
+                    row = probe_rows(
                         arrs["eh_off"], arrs["eh_rows"], ecols,
-                        (k1, bq(w_k2, nd)), meta.e_cap, meta.e_n,
+                        (k1, bq(q_k2, nd)), meta.e_cap, meta.e_n,
                     )
-                    wd, wp = gate2("e", wrow, (wrow >= 0) & exists)
-                    d, p = d | wd, p | wp
+                    d, p = gate2("e", row, (row >= 0) & exists)
+                    if meta.has_wc_edges:
+                        # wildcard edges only grant direct-object subjects
+                        wrow = probe_rows(
+                            arrs["eh_off"], arrs["eh_rows"], ecols,
+                            (k1, bq(w_k2, nd)), meta.e_cap, meta.e_n,
+                        )
+                        wd, wp = gate2("e", wrow, (wrow >= 0) & exists)
+                        d, p = d | wd, p | wp
 
             # T-index fast path: one probe folds {userset edge × closure}
             use_t = meta.has_tindex and (
                 meta.t_all if dyn else (slot in meta.t_slots)
             )
             if use_t:
-                trow = probe_rows(
-                    arrs["th_off"], arrs["th_rows"],
-                    (arrs["t_k1"], arrs["t_k2"]), (k1, bq(q_k2, nd)),
-                    meta.t_cap, meta.t_n,
-                )
-                trc = jnp.clip(trow, 0, arrs["t_k1"].shape[0] - 1)
-                thit = (trow >= 0) & exists
-                d = d | (thit & (tk(arrs["t_d"], trc) > now))
-                p = p | (thit & (tk(arrs["t_p"], trc) > now))
-                if meta.has_wc_closure:
-                    wtrow = probe_rows(
+                def t_site(k2q):
+                    if BS:
+                        blk = probe_block(
+                            arrs["th_off"], arrs["tx"], meta.t_cap, (k1, k2q)
+                        )
+                        hit = (
+                            (blk[..., 0] == k1[..., None])
+                            & (blk[..., 1] == k2q[..., None])
+                            & exists[..., None]
+                            & (k2q >= 0)[..., None]
+                        )
+                        return (
+                            jnp.any(hit & (blk[..., 2] > now), axis=-1),
+                            jnp.any(hit & (blk[..., 3] > now), axis=-1),
+                        )
+                    trow = probe_rows(
                         arrs["th_off"], arrs["th_rows"],
-                        (arrs["t_k1"], arrs["t_k2"]), (k1, bq(wcl_k, nd)),
+                        (arrs["t_k1"], arrs["t_k2"]), (k1, k2q),
                         meta.t_cap, meta.t_n,
                     )
-                    wtrc = jnp.clip(wtrow, 0, arrs["t_k1"].shape[0] - 1)
-                    wthit = (wtrow >= 0) & exists
-                    d = d | (wthit & (tk(arrs["t_d"], wtrc) > now))
-                    p = p | (wthit & (tk(arrs["t_p"], wtrc) > now))
+                    trc = jnp.clip(trow, 0, arrs["t_k1"].shape[0] - 1)
+                    thit = (trow >= 0) & exists
+                    return (
+                        thit & (tk(arrs["t_d"], trc) > now),
+                        thit & (tk(arrs["t_p"], trc) > now),
+                    )
+
+                td, tp = t_site(bq(q_k2, nd))
+                d, p = d | td, p | tp
+                if meta.has_wc_closure:
+                    wtd, wtp = t_site(bq(wcl_k, nd))
+                    d, p = d | wtd, p | wtp
                 if meta.has_ovf:
                     # T is incomplete for overflowed closure sources: flag
                     # queries whose (slot, node) has userset rows at all
@@ -568,13 +739,20 @@ def make_flat_fn(
                 # each subject pair against the flattened closure
                 lo, hi = range_of("usr", meta.usr_cap, meta.usr_gn, k1)
                 ovf = ovf | reduceB(exists & ((hi - lo) > KU_site))
-                idx = lo[..., None] + jnp.arange(KU_site, dtype=jnp.int32)
-                valid = (idx < hi[..., None]) & exists[..., None]
+                valid = (
+                    jnp.arange(KU_site, dtype=jnp.int32) < (hi - lo)[..., None]
+                ) & exists[..., None]
                 used = used | reduceB(valid)
-                idxc = jnp.clip(idx, 0, max(meta.us_rows - 1, 0))
-                s = tk(arrs["us_subj"], idxc)
-                r = tk(arrs["us_srel"], idxc)
-                gk = s * S1c + (r + 1)  # padded rows (-1, -1) → negative
+                if BS:
+                    ublk = slice_blocks(arrs["usx"], lo, KU_site)
+                    s = jnp.where(valid, ublk[..., usL["subj"]], -1)
+                    r = jnp.where(valid, ublk[..., usL["srel"]], -1)
+                else:
+                    idx = lo[..., None] + jnp.arange(KU_site, dtype=jnp.int32)
+                    idxc = jnp.clip(idx, 0, max(meta.us_rows - 1, 0))
+                    s = tk(arrs["us_subj"], idxc)
+                    r = tk(arrs["us_srel"], idxc)
+                gk = s * S1c + (r + 1)  # invalid rows (-1, -1) → negative
                 nd2 = nd + 1
                 in_d, in_p = cl_probe(bq(q_k2, nd2), gk)
                 if meta.has_wc_closure:
@@ -582,18 +760,36 @@ def make_flat_fn(
                     in_d, in_p = in_d | win_d, in_p | win_p
                 refl = (gk == bq(q_k2, nd2)) & (bq(q_k2, nd2) >= 0)
                 if plan.has_permission_usersets:
-                    permf = tk(arrs["us_perm"], idxc) != 0
-                    in_pus = probe_rows(
-                        arrs["push_off"], arrs["push_rows"],
-                        (arrs["pus_k"],), (gk,),
-                        meta.pus_cap, meta.pus_n,
-                    ) >= 0
+                    if BS:
+                        permf = (
+                            (jnp.where(valid, ublk[..., usL["perm"]], 0) != 0)
+                            if meta.us_hasperm
+                            else jnp.zeros(valid.shape, bool)
+                        )
+                        pblk = probe_block(
+                            arrs["push_off"], arrs["pusx"], meta.pus_cap, (gk,)
+                        )
+                        in_pus = jnp.any(
+                            (pblk[..., 0] == gk[..., None])
+                            & (gk >= 0)[..., None],
+                            axis=-1,
+                        )
+                    else:
+                        permf = tk(arrs["us_perm"], idxc) != 0
+                        in_pus = probe_rows(
+                            arrs["push_off"], arrs["push_rows"],
+                            (arrs["pus_k"],), (gk,),
+                            meta.pus_cap, meta.pus_n,
+                        ) >= 0
                     in_d = (in_d | refl) & ~permf
                     in_p = in_p | refl | in_pus | permf
                 else:
                     in_d = in_d | refl
                     in_p = in_p | refl
-                ugd, ugp = gate2("us", idxc, valid)
+                if BS:
+                    ugd, ugp = gate2_blk("us", ublk, usL, valid)
+                else:
+                    ugd, ugp = gate2("us", idxc, valid)
                 d = d | jnp.any(ugd & in_d, axis=-1)
                 p = p | jnp.any(ugp & in_p, axis=-1)
             return d, p, ovf, used
@@ -684,11 +880,18 @@ def make_flat_fn(
                         zB, zB,
                     )
                 ovf = reduceB(exists & ((hi - lo) > Ks))
-                idx = lo[..., None] + jnp.arange(Ks, dtype=jnp.int32)
-                valid = (idx < hi[..., None]) & exists[..., None]
-                idxc = jnp.clip(idx, 0, max(meta.ar_rows - 1, 0))
-                children = jnp.where(valid, tk(arrs["ar_child"], idxc), -1)
-                gd, gp = gate2("ar", idxc, valid)
+                valid = (
+                    jnp.arange(Ks, dtype=jnp.int32) < (hi - lo)[..., None]
+                ) & exists[..., None]
+                if BS:
+                    ablk = slice_blocks(arrs["arx"], lo, Ks)
+                    children = jnp.where(valid, ablk[..., arL["child"]], -1)
+                    gd, gp = gate2_blk("ar", ablk, arL, valid)
+                else:
+                    idx = lo[..., None] + jnp.arange(Ks, dtype=jnp.int32)
+                    idxc = jnp.clip(idx, 0, max(meta.ar_rows - 1, 0))
+                    children = jnp.where(valid, tk(arrs["ar_child"], idxc), -1)
+                    gd, gp = gate2("ar", idxc, valid)
                 cd, cp, co, cu = eval_slot(ir[2], children, stack, child_types)
                 return (
                     jnp.any(cd & gd, axis=-1),
@@ -725,6 +928,14 @@ def make_flat_fn(
             q_cl_ovf = zB
         else:
             def ovf_probe(k):
+                if BS:
+                    oblk = probe_block(
+                        arrs["ovfh_off"], arrs["ovfx"], meta.ovf_cap, (k,)
+                    )
+                    return jnp.any(
+                        (oblk[..., 0] == k[..., None]) & (k >= 0)[..., None],
+                        axis=-1,
+                    )
                 return probe_rows(
                     arrs["ovfh_off"], arrs["ovfh_rows"],
                     (arrs["ovf_k"],), (k,), meta.ovf_cap, meta.ovf_n,

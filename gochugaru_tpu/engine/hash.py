@@ -215,3 +215,85 @@ def probe_range(ri_arrays, cap: int, n: int, q):
     lo = jnp.where(hit, take_in_bounds(ri_arrays["glo"], gic), 0)
     hi = jnp.where(hit, take_in_bounds(ri_arrays["ghi"], gic), 0)
     return lo, hi
+
+
+# ---------------------------------------------------------------------------
+# block-slice layout: bucket-ordered interleaved tables
+# ---------------------------------------------------------------------------
+#
+# The scatter probes above cost 2 + cap·(1 + nkey) independent 1-D gathers
+# per site — dozens of scattered 32-bit reads per query.  TPUs gather at
+# ~one row per cycle regardless of width, so the TPU-shaped layout stores
+# each bucket's entries CONTIGUOUSLY with keys and payloads interleaved:
+# one [cap, w] dynamic-slice per query fetches the whole bucket (a single
+# HBM line or two), and every compare afterwards is elementwise VPU work.
+# Probe cost per site drops to 2 gathers (bucket offset + block) total.
+
+
+def interleave_buckets(
+    h: HashIndex, cols: Sequence[np.ndarray], pad: int = 64
+) -> np.ndarray:
+    """Bucket-ordered interleaved matrix int32[n_pad, w]: row j holds
+    ``cols[:][h.rows[j]]``.  Padded to pow2(n + max(pad, h.cap)) rows of -1
+    so a slice of up to ``max(pad, h.cap)`` rows starting at any real
+    bucket offset stays in bounds without clipping (padded keys are -1 and
+    match nothing).  Callers slicing more than ``h.cap`` rows must pass
+    their slice cap as ``pad`` — slice_blocks' clamp would otherwise SHIFT
+    the block and break the lane↔row mapping."""
+    w = max(len(cols), 1)
+    n = int(h.rows.shape[0]) if h.n else 0
+    n_pad = _ceil_pow2(max(n, 1) + max(pad, h.cap))
+    out = np.full((n_pad, w), -1, np.int32)
+    if h.n:
+        for j, c in enumerate(cols):
+            out[:n, j] = np.ascontiguousarray(c, np.int32)[h.rows]
+    return out
+
+
+def interleave_rows(
+    cols: Sequence[np.ndarray], pad: int = 64, pad_fill: int = -1
+) -> np.ndarray:
+    """Row-order interleaved matrix int32[n_pad, w] over lock-step columns
+    (for range views whose rows are already grouped contiguously by key).
+    Padded to pow2(n + pad) rows of ``pad_fill``; ``pad`` must be ≥ the
+    largest row-slice cap any probe site uses (slice_blocks clamps starts,
+    which would silently shift an undersized table's lane↔row mapping)."""
+    w = max(len(cols), 1)
+    n = int(cols[0].shape[0]) if cols else 0
+    n_pad = _ceil_pow2(max(n, 1) + max(pad, 1))
+    out = np.full((n_pad, w), pad_fill, np.int32)
+    for j, c in enumerate(cols):
+        out[:n, j] = np.ascontiguousarray(c, np.int32)
+    return out
+
+
+def slice_blocks(tbl, start, cap: int):
+    """Contiguous [cap, w] block per element of ``start`` (any shape):
+    returns int32[..., cap, w].  ``start`` must satisfy 0 ≤ start ≤
+    tbl.shape[0] - cap (interleave_* pad enough rows for any real bucket
+    offset); a vmapped dynamic_slice lowers to ONE gather with contiguous
+    slice_sizes=(cap, w) instead of cap·w scattered element gathers."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    w = tbl.shape[1]
+    flat = jnp.clip(start, 0, tbl.shape[0] - cap).reshape(-1)
+    blk = jax.vmap(lambda s: lax.dynamic_slice(tbl, (s, 0), (cap, w)))(flat)
+    return blk.reshape(tuple(jnp.shape(start)) + (cap, w))
+
+
+def probe_block(off, tbl, cap: int, q_cols: Sequence):
+    """Bucket block for the hash of ``q_cols``: int32[..., cap, w].
+
+    The block starts at the bucket's first entry and spans ``cap`` rows
+    (the build's max bucket occupancy), so every entry of the bucket is in
+    the block; overshoot rows belong to LATER buckets and cannot equal the
+    query key (equal keys hash to the same bucket), so callers just compare
+    key columns exactly — no per-slot validity mask is needed."""
+    import jax.numpy as jnp
+
+    size = off.shape[0] - 1
+    h = (mix32(q_cols, jnp) & jnp.uint32(size - 1)).astype(jnp.int32)
+    start = take_in_bounds(off, h)
+    return slice_blocks(tbl, start, cap)

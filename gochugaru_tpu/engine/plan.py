@@ -65,6 +65,12 @@ class EngineConfig:
     #: T-index size budget as a multiple of the userset row count;
     #: exceeding it disables the index (KU probe path still answers)
     flat_tindex_factor: int = 64
+    #: block-slice table layout: bucket-ordered interleaved tables probed
+    #: with ONE contiguous [cap, w] slice per query (engine/hash.py) — ~2
+    #: gathers per probe site instead of 2 + cap·(1 + nkey) scattered ones.
+    #: TPU gathers cost ~a row per cycle regardless of width, so this is
+    #: the TPU-shaped layout; False falls back to scattered 1-D probes
+    flat_blockslice: bool = True
 
     @staticmethod
     def for_schema(compiled: CompiledSchema, **overrides) -> "EngineConfig":

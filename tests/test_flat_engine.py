@@ -402,3 +402,24 @@ def test_tindex_ineligible_slots_fall_back():
     assert_sound_cascade(engine, dsnap, oracle, checks)
     d, p, ovf = engine.check_batch(dsnap, checks, now_us=NOW)
     assert d[0]  # T-index slot decides on device
+
+
+def test_blockslice_scatter_parity():
+    """The interleaved block-slice layout (flat_blockslice=True, the
+    default) and the scattered 1-D probe layout must agree plane-for-plane
+    on identical worlds/queries — both layouts stay covered by CI."""
+    for seed in (7, 8):
+        rng = random.Random(seed)
+        rels = build_feature_world(rng)
+        checks = make_checks(rng, 10, 10, n=48)
+        engine_b, dsnap_b, _ = world(FEATURES, rels)
+        assert engine_b.config.flat_blockslice
+        assert dsnap_b.flat_meta.blockslice
+        engine_s, dsnap_s, _ = world(FEATURES, rels, flat_blockslice=False)
+        assert not dsnap_s.flat_meta.blockslice
+        db, pb, ob = engine_b.check_batch(dsnap_b, checks, now_us=NOW)
+        ds, ps, osc = engine_s.check_batch(dsnap_s, checks, now_us=NOW)
+        for i, q in enumerate(checks):
+            assert bool(db[i]) == bool(ds[i]), f"definite differs for {q}"
+            assert bool(pb[i]) == bool(ps[i]), f"possible differs for {q}"
+            assert bool(ob[i]) == bool(osc[i]), f"overflow differs for {q}"
