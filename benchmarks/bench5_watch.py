@@ -2,21 +2,23 @@
 
 Measures the path that keeps a live index fresh: a stream of relationship
 updates (the Watch feed, client/client.go:364-413) is folded into the
-current snapshot via O(E + D log D) delta materialization
-(store/delta.py) and re-shipped to the device, and a check on the touched
-edges must observe the new revision immediately.
+current snapshot via O(E + D log E) delta materialization
+(store/delta.py), and the DEVICE side advances incrementally — the base
+revision's resident tables are reused and only small ``dl_*`` overlay
+tables (delta adds + tombstones) ship per revision (engine/flat.py
+DeltaMeta, engine/device.py _prepare_delta).  A check on the touched
+edges must observe the new revision immediately (asserted every round).
 
-Metrics: delta re-index latency (materialize + device upload) and
+Metrics: delta re-index latency (host materialize + device overlay) and
 sustained updates/sec, at a base graph scaled by ``--edges`` (the full
 config is 1B edges on v5e-16; one chip holds the 100M-class slice).
 
 Multi-host status, honestly: ShardedEngine.prepare re-ships the full
 padded edge columns on every revision (parallel/sharded.py) — per-shard
-incremental delta application (re-shipping only changed blocks) is NOT
-implemented yet, so the multi-host cost per revision is a full
-re-materialize + re-ship, measured here on one chip.  The host-side delta
-materialization (store/delta.py) is incremental; the device upload is not.
-"""
+delta overlays are single-chip only so far, so the multi-host cost per
+revision is a full re-materialize + re-ship, measured here on one chip.
+The remaining O(E) cost per revision is the HOST-side column merge in
+apply_delta; the device cost is O(delta)."""
 
 import argparse
 import time
@@ -106,6 +108,7 @@ def main() -> None:
 
     rng = np.random.default_rng(5)
     lat_mat, lat_ship = [], []
+    incremental = 0
     for rnd in range(args.rounds):
         adds = [
             relmod.must_from_triple(
@@ -118,7 +121,9 @@ def main() -> None:
         t0 = time.perf_counter()
         snap = apply_delta(snap, snap.revision + 1, adds, deletes, interner=interner)
         t1 = time.perf_counter()
-        dsnap = engine.prepare(snap)
+        dsnap = engine.prepare(snap, prev=dsnap)
+        if dsnap.flat_meta is not None and dsnap.flat_meta.delta is not None:
+            incremental += 1
         # freshness probe: a just-added edge must be visible at the new
         # revision
         probe = relmod.must_from_triple(
@@ -139,7 +144,8 @@ def main() -> None:
     emit("watch_reindex_updates_per_sec", rate, "updates/sec", rate / 1_000_000)
     note(
         f"delta={args.delta} materialize={mat.mean():.1f}ms "
-        f"ship+probe={ship.mean():.1f}ms total={total_ms:.1f}ms/delta"
+        f"device-overlay+probe={ship.mean():.1f}ms total={total_ms:.1f}ms/delta "
+        f"incremental={incremental}/{args.rounds} rounds"
     )
 
 

@@ -200,7 +200,16 @@ class Client:
         with self._lock:
             ds = self._lru_get(self._dsnap_cache, snap.revision)
             if ds is None or ds.snapshot is not snap:
-                ds = engine.prepare(snap)
+                # incremental prepare when the previous revision is still
+                # resident: base tables stay on device, only the delta
+                # overlay ships (engine/device.py _prepare_delta)
+                di = getattr(snap, "delta_info", None)
+                prev = (
+                    self._dsnap_cache.get(di.prev_revision)
+                    if di is not None
+                    else None
+                )
+                ds = engine.prepare(snap, prev=prev)
                 self._lru_put(self._dsnap_cache, snap.revision, ds)
             return ds
 

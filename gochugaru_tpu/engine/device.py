@@ -614,6 +614,9 @@ class DeviceSnapshot:
     #: static geometry of the flat engine's hash/closure tables (None when
     #: the flat kernel is disabled); see engine/flat.py
     flat_meta: Optional[Any] = None
+    #: accumulated host-side delta state since the last FULL prepare (set
+    #: on delta-prepared snapshots; engine/flat.py _acc_collapse)
+    delta_acc: Optional[Dict[str, np.ndarray]] = None
 
 
 class DeviceEngine:
@@ -658,7 +661,9 @@ class DeviceEngine:
         MS = _ceil_pow2(snap.ms_subj.shape[0])
         MP = _ceil_pow2(snap.mp_subj.shape[0])
         AR = _ceil_pow2(snap.ar_rel.shape[0])
-        NN = _ceil_pow2(snap.num_nodes)
+        # 2x headroom: Watch-driven deltas intern fresh nodes, and the
+        # delta-prepare reuses this buffer until the bucket would grow
+        NN = _ceil_pow2(2 * snap.num_nodes)
         return {
             "e_rel": _pad_sorted(snap.e_rel, E),
             "e_res": _pad_sorted(snap.e_res, E),
@@ -707,7 +712,9 @@ class DeviceEngine:
             return {}, None
         strings = dict(self.caveat_plan.base_strings)
         table = encode_contexts(self.caveat_plan, snap.contexts, strings)
-        NC = _ceil_pow2(table.vi.shape[0], 1)
+        # 2x headroom: Watch-driven deltas append stored contexts, and the
+        # delta-prepare re-encodes in place only while the bucket holds
+        NC = _ceil_pow2(2 * max(table.vi.shape[0], 1), 4)
 
         def padrows(a: np.ndarray, fill=0) -> np.ndarray:
             out = np.full((NC,) + a.shape[1:], fill, a.dtype)
@@ -721,7 +728,18 @@ class DeviceEngine:
             "ectx_host": padrows(table.host),
         }, strings
 
-    def prepare(self, snap: Snapshot) -> DeviceSnapshot:
+    def prepare(
+        self, snap: Snapshot, prev: Optional[DeviceSnapshot] = None
+    ) -> DeviceSnapshot:
+        """Ship a snapshot to the device.  With ``prev`` (the DeviceSnapshot
+        of the revision this one was delta-derived from), try the
+        incremental path first: base tables stay resident, only small
+        ``dl_*`` overlays ship (engine/flat.py build_delta_arrays) — the
+        Watch-driven re-index costs O(delta), not O(E), per revision."""
+        if prev is not None:
+            out = self._prepare_delta(snap, prev)
+            if out is not None:
+                return out
         arrays = self._host_arrays(snap)
         ectx, strings = self._ectx_tables(snap)
         arrays.update(ectx)
@@ -744,6 +762,63 @@ class DeviceEngine:
             snapshot=snap,
             strings=strings,
             flat_meta=flat_meta,
+        )
+
+    def _prepare_delta(
+        self, snap: Snapshot, prev: DeviceSnapshot
+    ) -> Optional[DeviceSnapshot]:
+        """The incremental prepare, or None → caller does a full one.
+
+        The produced DeviceSnapshot REUSES prev's device buffers for every
+        base table (no re-ship); only the delta overlays, a possibly-grown
+        node_type column, and re-encoded stored-context tables move.  The
+        legacy (non-flat) kernel columns inside are left at the BASE
+        revision — a delta-prepared snapshot serves the flat path, and the
+        engine's check paths only fall back to the legacy kernel when
+        flat_meta is None, which is never the case here."""
+        if not (self.config.use_flat and self.config.flat_blockslice):
+            return None
+        from dataclasses import replace as _dc_replace
+
+        from .flat import build_delta_arrays
+
+        built = build_delta_arrays(snap, prev, self.compiled, self.config)
+        if built is None:
+            return None
+        dl_arrays, dmeta, acc = built
+        arrays = dict(prev.arrays)
+        # drop the previous overlay's tables: the new overlay replaces them
+        # (a shrunk accumulated delta must not leave stale tables behind)
+        for k in [k for k in arrays if k.startswith("dl_")]:
+            del arrays[k]
+        strings = prev.strings
+        if len(snap.contexts) != len(prev.snapshot.contexts):
+            ectx, strings = self._ectx_tables(snap)
+            old = prev.arrays.get("ectx_vi")
+            if old is not None and ectx["ectx_vi"].shape[0] != old.shape[0]:
+                return None  # context bucket grew: shapes change, rebuild
+            arrays.update({k: jnp.asarray(v) for k, v in ectx.items()})
+        if snap.num_nodes > prev.snapshot.num_nodes:
+            NN = int(prev.arrays["node_type"].shape[0])
+            if snap.num_nodes > NN:
+                return None  # node bucket outgrown: every node shape moves
+            arrays["node_type"] = jnp.asarray(
+                _pad_payload(snap.node_type, NN, -1)
+            )
+        arrays.update({k: jnp.asarray(v) for k, v in dl_arrays.items()})
+        # an empty collapsed delta (or one that cancelled out) compiles as
+        # the plain base kernel — don't pay a retrace for DeltaMeta()
+        meta = _dc_replace(
+            prev.flat_meta, delta=dmeta if dl_arrays else None
+        )
+        return DeviceSnapshot(
+            revision=snap.revision,
+            arrays=arrays,
+            tid_map=prev.tid_map,
+            snapshot=snap,
+            strings=strings,
+            flat_meta=meta,
+            delta_acc=acc,
         )
 
     # -- query lowering --------------------------------------------------

@@ -64,6 +64,42 @@ from .plan import DevicePlan, EngineConfig, ExprIR, _eval_cyclic_pairs
 
 
 @dataclass(frozen=True)
+class DeltaMeta:
+    """Static geometry of the LSM-style delta level (Watch-driven
+    incremental re-index, BASELINE config 5).
+
+    A delta-prepared DeviceSnapshot reuses the base revision's resident
+    tables untouched and adds small per-view overlays: an adds level
+    (probed exactly like the base, OR-ed in) and tombstone sets (exact
+    identity keys that void base hits).  All caps/flags here are pow2/
+    stable-bucketed so consecutive deltas reuse the compiled kernel."""
+
+    has_adds: bool = False  # any delta primary rows
+    e_cap: int = 4  # delta primary hash bucket cap
+    e_slots: Tuple[int, ...] = ()  # slots with delta primary rows
+    has_tombs: bool = False  # any removed-row identities
+    tb_cap: int = 4
+    has_us: bool = False  # delta userset-view rows
+    us_cap: int = 4  # delta us group-hash bucket cap
+    us_fan: int = 1  # delta us max rows per (slot, res)
+    us_slots: Tuple[int, ...] = ()
+    has_ustomb: bool = False  # tombstoned userset rows
+    utb_cap: int = 4
+    t_dirty: bool = False  # tombstoned us rows under T-covered slots
+    td_cap: int = 4
+    has_ar: bool = False  # delta arrow-view rows
+    ar_cap: int = 4
+    ar_fan: int = 1
+    ar_slots: Tuple[int, ...] = ()
+    has_artomb: bool = False
+    atb_cap: int = 4
+    # delta gate-column presence (the delta tables reuse the BASE layouts,
+    # so these can only be true when the base flags are)
+    e_hascav: bool = False
+    e_hasexp: bool = False
+
+
+@dataclass(frozen=True)
 class FlatMeta:
     """Static per-snapshot table geometry the kernel closes over.
 
@@ -135,6 +171,9 @@ class FlatMeta:
     #: block-slice layout active (bucket-ordered interleaved tables probed
     #: with one contiguous [cap, w] slice per query — see engine/hash.py)
     blockslice: bool = False
+    #: LSM delta level riding on this snapshot's base tables (None = the
+    #: snapshot was fully prepared)
+    delta: Optional[DeltaMeta] = None
 
 
 def _gate_cols(hascav: bool, hasexp: bool) -> list:
@@ -204,6 +243,11 @@ def build_flat_arrays(
     S1 = snap.num_slots + 1
     if N * snap.num_slots >= 2**31 or N * S1 >= 2**31:
         return None
+    # headroom for Watch-driven deltas: new nodes (fresh users/resources)
+    # must stay under the packing radix or every delta-prepare bails to a
+    # full rebuild — double N whenever the key space still fits int32
+    if N < 2 * snap.num_nodes and 2 * N * S1 < 2**31 and 2 * N * snap.num_slots < 2**31:
+        N *= 2
 
     cl = build_closure(snap, per_source_cap=config.closure_source_cap)
 
@@ -434,6 +478,291 @@ def build_flat_arrays(
 
 
 # ---------------------------------------------------------------------------
+# delta level (Watch-driven incremental re-index)
+# ---------------------------------------------------------------------------
+
+
+def _perm_table(compiled: CompiledSchema, interner) -> np.ndarray:
+    """bool[interner types, slots]: slot is a *permission* on the type."""
+    num_slots = max(compiled.num_slots, 1)
+    t = np.zeros((max(interner.num_types, 1), num_slots), bool)
+    for tname, d in compiled.schema.definitions.items():
+        itid = interner.type_lookup(tname)
+        if itid < 0:
+            continue
+        for pname in d.permissions:
+            t[itid, compiled.slot_of_name[pname]] = True
+    return t
+
+
+_ACC_COLS = ("rel", "res", "subj", "srel1", "cav", "ctx", "exp")
+
+
+def _acc_collapse(acc: Optional[Dict], di, N: int, S1: int) -> Dict:
+    """Fold one revision's DeltaInfo into the accumulated delta state.
+
+    ``acc`` holds the collapsed adds (payload columns keyed by primary
+    identity) and tombstone identities since the base revision; identities
+    pack into one int64 (both halves < 2³¹ by the FlatMeta radix check)."""
+
+    def pack(rel, res, subj, srel1):
+        k1 = rel.astype(np.int64) * N + res.astype(np.int64)
+        k2 = subj.astype(np.int64) * S1 + srel1.astype(np.int64)
+        return (k1 << np.int64(31)) | k2
+
+    if acc is None:
+        acc = {
+            "a_key": np.empty(0, np.int64),
+            **{f"a_{c}": np.empty(0, np.int32) for c in _ACC_COLS},
+            "g_key": np.empty(0, np.int64),
+            **{f"g_{c}": np.empty(0, np.int32) for c in _ACC_COLS[:4]},
+        }
+    a_key = pack(di.a_rel, di.a_res, di.a_subj, di.a_srel1)
+    g_key = pack(di.g_rel, di.g_res, di.g_subj, di.g_srel1)
+
+    # Invariant: device view = (base − tombstones) ∪ adds.  EVERY touched
+    # identity — deleted OR upserted — goes into the tombstone set: an
+    # upsert of a row that lives in the base must void the base copy (its
+    # stale payload would otherwise answer alongside the new one), and
+    # tombstoning an identity the base never had is a harmless probe miss.
+    touched = np.concatenate([g_key, a_key])
+    keep = ~np.isin(acc["a_key"], touched)
+    out = {"a_key": acc["a_key"][keep]}
+    for c in _ACC_COLS:
+        out[f"a_{c}"] = acc[f"a_{c}"][keep]
+    gk = np.concatenate([acc["g_key"], g_key, a_key])
+    gcols = {
+        f"g_{c}": np.concatenate(
+            [acc[f"g_{c}"], getattr(di, f"g_{c}"), getattr(di, f"a_{c}")]
+        )
+        for c in _ACC_COLS[:4]
+    }
+    order = np.argsort(gk, kind="stable")
+    gk_sorted = gk[order]
+    first = np.ones(gk_sorted.shape[0], bool)
+    first[1:] = gk_sorted[1:] != gk_sorted[:-1]
+    res = {"g_key": gk_sorted[first]}
+    for c in _ACC_COLS[:4]:
+        res[f"g_{c}"] = gcols[f"g_{c}"][order][first]
+    new_cols = {
+        "rel": di.a_rel, "res": di.a_res, "subj": di.a_subj,
+        "srel1": di.a_srel1, "cav": di.a_cav, "ctx": di.a_ctx,
+        "exp": di.a_exp,
+    }
+    merged_key = np.concatenate([out["a_key"], a_key])
+    order = np.argsort(merged_key, kind="stable")
+    res["a_key"] = merged_key[order]
+    for c in _ACC_COLS:
+        res[f"a_{c}"] = np.concatenate(
+            [out[f"a_{c}"], new_cols[c].astype(np.int32)]
+        )[order]
+    return res
+
+
+def build_delta_arrays(
+    snap, prev_dsnap, compiled: CompiledSchema, config: EngineConfig
+) -> Optional[Tuple[Dict[str, np.ndarray], "DeltaMeta", Dict]]:
+    """Advance a blockslice-prepared DeviceSnapshot by one revision's
+    delta: returns the small ``dl_*`` overlay arrays, the static DeltaMeta,
+    and the new accumulated-delta state — or None when the delta cannot be
+    applied incrementally (caller does a full prepare).
+
+    Sound-bail conditions (every one falls back to a FULL rebuild, never
+    to wrong answers): membership-subgraph rows (the closure/T-index would
+    change), newly-used userset subjects, permission-valued userset rows,
+    node-radix overflow, wildcard introduction, renumbered contexts, gate
+    columns the base layout lacks, and accumulated-delta size beyond the
+    compaction threshold."""
+    di = getattr(snap, "delta_info", None)
+    meta = prev_dsnap.flat_meta
+    if (
+        di is None
+        or meta is None
+        or not meta.blockslice
+        or di.prev_revision != prev_dsnap.revision
+        or di.contexts_renumbered
+    ):
+        return None
+    prev_snap = prev_dsnap.snapshot
+    used = getattr(prev_snap, "us_used_keys", None)
+    if used is None:
+        return None
+    if snap.num_nodes > meta.N:
+        return None  # node radix outgrown: repack
+    if not np.array_equal(
+        snap.wildcard_node_of_type, prev_snap.wildcard_node_of_type
+    ):
+        return None
+    num_slots = snap.num_slots
+    all_rel = np.concatenate([di.a_rel, di.g_rel])
+    all_res = np.concatenate([di.a_res, di.g_res])
+    all_subj = np.concatenate([di.a_subj, di.g_subj])
+    all_srel1 = np.concatenate([di.a_srel1, di.g_srel1])
+    # membership-subgraph test: a row FEEDS the closure when the userset
+    # it grants is used as a subject anywhere
+    edge_key = all_res.astype(np.int64) * num_slots + all_rel.astype(np.int64)
+    if np.isin(edge_key, used).any():
+        return None
+    us_rows = all_srel1 > 0
+    if us_rows.any():
+        subj_key = (
+            all_subj[us_rows].astype(np.int64) * num_slots
+            + (all_srel1[us_rows].astype(np.int64) - 1)
+        )
+        # a userset subject not already used would need new ms/mp rows
+        if not np.isin(subj_key, used).all():
+            return None
+        pt = _perm_table(compiled, snap.interner)
+        stypes = snap.node_type[all_subj[us_rows]]
+        if pt[stypes, np.clip(all_srel1[us_rows] - 1, 0, pt.shape[1] - 1)].any():
+            return None
+    # gate columns ride the BASE layouts, PER VIEW: a caveated/expiring
+    # delta row landing in a view whose base layout lacks that column
+    # would silently evaluate ungated — bail instead
+    a_is_us = di.a_srel1 > 0
+    ts_set = np.asarray(sorted(compiled.tupleset_slots), np.int64)
+    a_is_ar = np.isin(di.a_rel, ts_set) & (di.a_srel1 == 0)
+    for mask, hascav, hasexp in (
+        (slice(None), meta.e_hascav, meta.e_hasexp),  # primary: all adds
+        (a_is_us, meta.us_hascav, meta.us_hasexp),
+        (a_is_ar, meta.ar_hascav, meta.ar_hasexp),
+    ):
+        if di.a_cav[mask].any() and not hascav:
+            return None
+        if di.a_exp[mask].any() and not hasexp:
+            return None
+    # a wildcard-subject add is invisible unless the base kernel compiled
+    # its wildcard probe sites
+    if not meta.has_wc_edges:
+        wc_nodes = snap.wildcard_node_of_type[snap.wildcard_node_of_type >= 0]
+        if wc_nodes.size and np.isin(di.a_subj, wc_nodes).any():
+            return None
+
+    S1 = meta.S1
+    N = meta.N
+    acc = _acc_collapse(getattr(prev_dsnap, "delta_acc", None), di, N, S1)
+    n_adds = acc["a_key"].shape[0]
+    n_tombs = acc["g_key"].shape[0]
+    if n_adds + n_tombs > max(
+        config.flat_delta_min_compact, snap.num_edges // 8
+    ):
+        return None  # compaction: fold the delta into a fresh base
+
+    out: Dict[str, np.ndarray] = {}
+
+    def pk(a, radix, b):
+        return (a.astype(np.int64) * radix + b).astype(np.int32)
+
+    a_k1 = pk(acc["a_rel"], N, acc["a_res"])
+    a_k2 = pk(acc["a_subj"], S1, acc["a_srel1"])
+    g_k1 = pk(acc["g_rel"], N, acc["g_res"])
+    g_k2 = pk(acc["g_subj"], S1, acc["g_srel1"])
+
+    kw = {}
+    if n_adds:
+        eh = build_hash([a_k1, a_k2])
+        out["dl_eh_off"] = eh.off
+        out["dl_ehx"] = interleave_buckets(
+            eh,
+            [a_k1, a_k2]
+            + ([acc["a_cav"], acc["a_ctx"]] if meta.e_hascav else [])
+            + ([acc["a_exp"]] if meta.e_hasexp else []),
+        )
+        kw.update(
+            has_adds=True,
+            e_cap=_round_cap(eh.cap),
+            e_slots=tuple(int(s) for s in np.unique(acc["a_rel"])),
+            e_hascav=meta.e_hascav,
+            e_hasexp=meta.e_hasexp,
+        )
+    if n_tombs:
+        tb = build_hash([g_k1, g_k2])
+        out["dl_tb_off"] = tb.off
+        out["dl_tbx"] = interleave_buckets(tb, [g_k1, g_k2])
+        kw.update(has_tombs=True, tb_cap=_round_cap(tb.cap))
+
+    # delta userset view (adds with a subject relation)
+    am = acc["a_srel1"] > 0
+    if am.any():
+        gk_all = a_k1[am]
+        order = np.argsort(gk_all, kind="stable")
+        u_gk = gk_all[order]
+        usr = build_range_hash(u_gk)
+        out["dl_usr_off"] = usr.index.off
+        out["dl_usgx"] = interleave_buckets(
+            usr.index, [usr.gk, usr.glo, usr.ghi]
+        )
+        cols = [acc["a_subj"][am][order], (acc["a_srel1"][am] - 1)[order]]
+        if meta.us_hascav:
+            cols += [acc["a_cav"][am][order], acc["a_ctx"][am][order]]
+        if meta.us_hasexp:
+            cols += [acc["a_exp"][am][order]]
+        if meta.us_hasperm:
+            # permission-valued delta rows bail above: flag column is 0
+            cols += [np.zeros(int(am.sum()), np.int32)]
+        fan = _round_fan(min(usr.max_run, 32))
+        out["dl_usx"] = interleave_rows(cols, pad=max(64, fan))
+        kw.update(
+            has_us=True,
+            us_cap=_round_cap(usr.index.cap),
+            us_fan=fan,
+            us_slots=tuple(int(s) for s in np.unique(acc["a_rel"][am])),
+        )
+    gm = acc["g_srel1"] > 0
+    if gm.any():
+        utb = build_hash([g_k1[gm], g_k2[gm]])
+        out["dl_utb_off"] = utb.off
+        out["dl_utbx"] = interleave_buckets(utb, [g_k1[gm], g_k2[gm]])
+        kw.update(has_ustomb=True, utb_cap=_round_cap(utb.cap))
+        if meta.has_tindex:
+            dirty = np.unique(
+                g_k1[gm][
+                    np.isin(acc["g_rel"][gm], np.asarray(meta.t_slots, np.int64))
+                ]
+            )
+            if dirty.size:
+                td = build_hash([dirty])
+                out["dl_td_off"] = td.off
+                out["dl_tdx"] = interleave_buckets(td, [dirty])
+                kw.update(t_dirty=True, td_cap=_round_cap(td.cap))
+
+    # delta arrow view (tupleset relations, direct subjects)
+    ts = np.asarray(sorted(compiled.tupleset_slots), np.int64)
+    aam = np.isin(acc["a_rel"], ts) & (acc["a_srel1"] == 0)
+    if aam.any():
+        gk_all = a_k1[aam]
+        order = np.argsort(gk_all, kind="stable")
+        arr = build_range_hash(gk_all[order])
+        out["dl_arr_off"] = arr.index.off
+        out["dl_argx"] = interleave_buckets(
+            arr.index, [arr.gk, arr.glo, arr.ghi]
+        )
+        cols = [acc["a_subj"][aam][order]]
+        if meta.ar_hascav:
+            cols += [acc["a_cav"][aam][order], acc["a_ctx"][aam][order]]
+        if meta.ar_hasexp:
+            cols += [acc["a_exp"][aam][order]]
+        fan = _round_fan(min(arr.max_run, 32))
+        out["dl_arx"] = interleave_rows(cols, pad=max(64, fan))
+        kw.update(
+            has_ar=True,
+            ar_cap=_round_cap(arr.index.cap),
+            ar_fan=fan,
+            ar_slots=tuple(int(s) for s in np.unique(acc["a_rel"][aam])),
+        )
+    gam = np.isin(acc["g_rel"], ts) & (acc["g_srel1"] == 0)
+    if gam.any():
+        # identity for arrow-candidate masking is (group key, child node) —
+        # the kernel holds the child id, not the packed subject key
+        atb = build_hash([g_k1[gam], acc["g_subj"][gam]])
+        out["dl_atb_off"] = atb.off
+        out["dl_atbx"] = interleave_buckets(atb, [g_k1[gam], acc["g_subj"][gam]])
+        kw.update(has_artomb=True, atb_cap=_round_cap(atb.cap))
+
+    return out, DeltaMeta(**kw), acc
+
+
+# ---------------------------------------------------------------------------
 # kernel codegen
 # ---------------------------------------------------------------------------
 
@@ -569,17 +898,35 @@ def make_flat_fn(
             t = tri(cav, ctxc, qb, tables)
             return live & (t == 2), live & (t >= 1)
 
+        dm = meta.delta
+
+        def blk_hit(blk, q_cols):
+            """Exact-key hit mask over a probe block's candidates, with
+            ≥0 validity guards on every query column (padded/overshoot
+            rows hold -1 keys or other buckets' keys and never match)."""
+            h = jnp.ones(blk.shape[:-1], bool)
+            g = None
+            for j, qc in enumerate(q_cols):
+                h = h & (blk[..., j] == qc[..., None])
+                g = (qc >= 0) if g is None else (g & (qc >= 0))
+            return h & g[..., None]
+
+        def range_probe(off, tbl, cap: int, q):
+            """(lo, hi) row range of group key ``q`` in an interleaved
+            (gk, glo, ghi) group table; (0, 0) on miss."""
+            blk = probe_block(off, tbl, cap, (q,))
+            hit = blk_hit(blk, (q,))
+            lo = jnp.max(jnp.where(hit, blk[..., 1], 0), axis=-1)
+            hi = jnp.max(jnp.where(hit, blk[..., 2], 0), axis=-1)
+            return lo, hi
+
         def range_of(prefix: str, cap: int, n: int, q):
             if BS:
-                blk = probe_block(
+                return range_probe(
                     arrs[prefix + "_off"],
                     arrs[{"usr": "usgx", "arr": "argx"}[prefix]],
-                    cap, (q,),
+                    cap, q,
                 )
-                hit = (blk[..., 0] == q[..., None]) & (q >= 0)[..., None]
-                lo = jnp.max(jnp.where(hit, blk[..., 1], 0), axis=-1)
-                hi = jnp.max(jnp.where(hit, blk[..., 2], 0), axis=-1)
-                return lo, hi
             ri = {
                 k: arrs[prefix + "_" + k]
                 for k in ("gk", "glo", "ghi", "off", "rows")
@@ -650,41 +997,61 @@ def make_flat_fn(
             # by `exists` wherever the (possibly aliased) probe lands
             k1 = sc * Nc + jnp.where(exists, nodes, 0)
 
-            if bool(meta.e_slots) if dyn else (slot in meta.e_slots):
-                if BS:
-                    def e_site(k2q):
+            run_e = bool(meta.e_slots) if dyn else (slot in meta.e_slots)
+            run_ed = dm is not None and dm.has_adds and (
+                bool(dm.e_slots) if dyn else (slot in dm.e_slots)
+            )
+            if run_e and BS or run_ed:
+                def e_site(k2q):
+                    """Direct-edge test: (base hit minus tombstones) OR
+                    delta-level hit — exact replacement semantics, since
+                    tombstones carry full primary identities."""
+                    hd = hp = jnp.zeros(nodes.shape, bool)
+                    if run_e:
                         blk = probe_block(
                             arrs["eh_off"], arrs["ehx"], meta.e_cap,
                             (k1, k2q),
                         )
-                        hit = (
-                            (blk[..., 0] == k1[..., None])
-                            & (blk[..., 1] == k2q[..., None])
-                            & exists[..., None]
-                            & (k2q >= 0)[..., None]
+                        hit = blk_hit(blk, (k1, k2q)) & exists[..., None]
+                        bd, bp = gate2_blk("e", blk, eL, hit)
+                        hd, hp = jnp.any(bd, axis=-1), jnp.any(bp, axis=-1)
+                        if dm is not None and dm.has_tombs:
+                            tb = probe_block(
+                                arrs["dl_tb_off"], arrs["dl_tbx"],
+                                dm.tb_cap, (k1, k2q),
+                            )
+                            tomb = jnp.any(blk_hit(tb, (k1, k2q)), axis=-1)
+                            hd, hp = hd & ~tomb, hp & ~tomb
+                    if run_ed:
+                        dblk = probe_block(
+                            arrs["dl_eh_off"], arrs["dl_ehx"], dm.e_cap,
+                            (k1, k2q),
                         )
-                        hd, hp = gate2_blk("e", blk, eL, hit)
-                        return jnp.any(hd, axis=-1), jnp.any(hp, axis=-1)
+                        dhit = blk_hit(dblk, (k1, k2q)) & exists[..., None]
+                        dd, dp = gate2_blk("e", dblk, eL, dhit)
+                        hd = hd | jnp.any(dd, axis=-1)
+                        hp = hp | jnp.any(dp, axis=-1)
+                    return hd, hp
 
-                    d, p = e_site(bq(q_k2, nd))
-                    if meta.has_wc_edges:
-                        wd, wp = e_site(bq(w_k2, nd))
-                        d, p = d | wd, p | wp
-                else:
-                    ecols = (arrs["e_k1"], arrs["e_k2"])
-                    row = probe_rows(
+                d, p = e_site(bq(q_k2, nd))
+                if meta.has_wc_edges:
+                    # wildcard edges only grant direct-object subjects
+                    wd, wp = e_site(bq(w_k2, nd))
+                    d, p = d | wd, p | wp
+            elif run_e:
+                ecols = (arrs["e_k1"], arrs["e_k2"])
+                row = probe_rows(
+                    arrs["eh_off"], arrs["eh_rows"], ecols,
+                    (k1, bq(q_k2, nd)), meta.e_cap, meta.e_n,
+                )
+                d, p = gate2("e", row, (row >= 0) & exists)
+                if meta.has_wc_edges:
+                    wrow = probe_rows(
                         arrs["eh_off"], arrs["eh_rows"], ecols,
-                        (k1, bq(q_k2, nd)), meta.e_cap, meta.e_n,
+                        (k1, bq(w_k2, nd)), meta.e_cap, meta.e_n,
                     )
-                    d, p = gate2("e", row, (row >= 0) & exists)
-                    if meta.has_wc_edges:
-                        # wildcard edges only grant direct-object subjects
-                        wrow = probe_rows(
-                            arrs["eh_off"], arrs["eh_rows"], ecols,
-                            (k1, bq(w_k2, nd)), meta.e_cap, meta.e_n,
-                        )
-                        wd, wp = gate2("e", wrow, (wrow >= 0) & exists)
-                        d, p = d | wd, p | wp
+                    wd, wp = gate2("e", wrow, (wrow >= 0) & exists)
+                    d, p = d | wd, p | wp
 
             # T-index fast path: one probe folds {userset edge × closure}
             use_t = meta.has_tindex and (
@@ -719,39 +1086,105 @@ def make_flat_fn(
                     )
 
                 td, tp = t_site(bq(q_k2, nd))
-                d, p = d | td, p | tp
                 if meta.has_wc_closure:
                     wtd, wtp = t_site(bq(wcl_k, nd))
-                    d, p = d | wtd, p | wtp
+                    td, tp = td | wtd, tp | wtp
+                if dm is not None and dm.t_dirty:
+                    # groups with tombstoned userset rows: the base T rows
+                    # may cite deleted edges — void them; the forced KU
+                    # pass below re-derives the live union exactly
+                    dtb = probe_block(
+                        arrs["dl_td_off"], arrs["dl_tdx"], dm.td_cap, (k1,)
+                    )
+                    dirty = jnp.any(blk_hit(dtb, (k1,)), axis=-1)
+                    td, tp = td & ~dirty, tp & ~dirty
+                d, p = d | td, p | tp
                 if meta.has_ovf:
                     # T is incomplete for overflowed closure sources: flag
                     # queries whose (slot, node) has userset rows at all
                     lo2, hi2 = range_of("usr", meta.usr_cap, meta.usr_gn, k1)
                     used = used | reduceB(exists & (hi2 > lo2))
 
-            # KU probe path: ineligible slots, or — for the dynamic root
-            # leaf on a mixed schema — every slot (eligible ones repeat
-            # the T answer, which is sound under OR)
-            run_ku = (not use_t) or (dyn and not meta.t_all)
+            def ku_eval(ublk, lo, hi, fan, tombstoned: bool):
+                """Userset-grant evaluation over one level's candidate
+                block: per-candidate closure/reflexivity/permission tests
+                gated by the row's caveat/expiry columns.  Returns the
+                (d, p, used) contributions (any-reduced over candidates)."""
+                valid = (
+                    jnp.arange(fan, dtype=jnp.int32) < (hi - lo)[..., None]
+                ) & exists[..., None]
+                s = jnp.where(valid, ublk[..., usL["subj"]], -1)
+                r = jnp.where(valid, ublk[..., usL["srel"]], -1)
+                gk = s * S1c + (r + 1)  # invalid rows (-1, -1) → negative
+                if tombstoned:
+                    # mask deleted base rows by exact (group, subject) id
+                    tb = probe_block(
+                        arrs["dl_utb_off"], arrs["dl_utbx"], dm.utb_cap,
+                        (k1[..., None], gk),
+                    )
+                    tomb = jnp.any(
+                        blk_hit(tb, (k1[..., None], gk)), axis=-1
+                    )
+                    valid = valid & ~tomb
+                    gk = jnp.where(valid, gk, -1)
+                nd2 = nd + 1
+                in_d, in_p = cl_probe(bq(q_k2, nd2), gk)
+                if meta.has_wc_closure:
+                    win_d, win_p = cl_probe(bq(wcl_k, nd2), gk)
+                    in_d, in_p = in_d | win_d, in_p | win_p
+                refl = (gk == bq(q_k2, nd2)) & (bq(q_k2, nd2) >= 0)
+                if plan.has_permission_usersets:
+                    permf = (
+                        (jnp.where(valid, ublk[..., usL["perm"]], 0) != 0)
+                        if meta.us_hasperm
+                        else jnp.zeros(valid.shape, bool)
+                    )
+                    pblk = probe_block(
+                        arrs["push_off"], arrs["pusx"], meta.pus_cap, (gk,)
+                    )
+                    in_pus = jnp.any(blk_hit(pblk, (gk,)), axis=-1)
+                    in_d = (in_d | refl) & ~permf
+                    in_p = in_p | refl | in_pus | permf
+                else:
+                    in_d = in_d | refl
+                    in_p = in_p | refl
+                ugd, ugp = gate2_blk("us", ublk, usL, valid)
+                return (
+                    jnp.any(ugd & in_d, axis=-1),
+                    jnp.any(ugp & in_p, axis=-1),
+                    reduceB(valid),
+                )
+
+            # KU probe path: ineligible slots; the dynamic root leaf on a
+            # mixed schema (eligible slots repeat the T answer, sound
+            # under OR); or a delta level with tombstoned userset rows
+            # (the forced pass replaces voided T answers)
+            run_ku = (
+                (not use_t)
+                or (dyn and not meta.t_all)
+                or (dm is not None and dm.t_dirty)
+            )
             KU_site = min(KU, us_fan_max if dyn else us_fans.get(slot, 0))
-            if run_ku and KU_site > 0:
-                # userset grants: gather the (slot, node) edge block, test
-                # each subject pair against the flattened closure
+            if run_ku and KU_site > 0 and BS:
+                lo, hi = range_of("usr", meta.usr_cap, meta.usr_gn, k1)
+                ovf = ovf | reduceB(exists & ((hi - lo) > KU_site))
+                kd, kp, ku_used = ku_eval(
+                    slice_blocks(arrs["usx"], lo, KU_site), lo, hi, KU_site,
+                    tombstoned=dm is not None and dm.has_ustomb,
+                )
+                d, p, used = d | kd, p | kp, used | ku_used
+            elif run_ku and KU_site > 0:
+                # scattered (non-blockslice) layout: no delta level exists
                 lo, hi = range_of("usr", meta.usr_cap, meta.usr_gn, k1)
                 ovf = ovf | reduceB(exists & ((hi - lo) > KU_site))
                 valid = (
                     jnp.arange(KU_site, dtype=jnp.int32) < (hi - lo)[..., None]
                 ) & exists[..., None]
                 used = used | reduceB(valid)
-                if BS:
-                    ublk = slice_blocks(arrs["usx"], lo, KU_site)
-                    s = jnp.where(valid, ublk[..., usL["subj"]], -1)
-                    r = jnp.where(valid, ublk[..., usL["srel"]], -1)
-                else:
-                    idx = lo[..., None] + jnp.arange(KU_site, dtype=jnp.int32)
-                    idxc = jnp.clip(idx, 0, max(meta.us_rows - 1, 0))
-                    s = tk(arrs["us_subj"], idxc)
-                    r = tk(arrs["us_srel"], idxc)
+                idx = lo[..., None] + jnp.arange(KU_site, dtype=jnp.int32)
+                idxc = jnp.clip(idx, 0, max(meta.us_rows - 1, 0))
+                s = tk(arrs["us_subj"], idxc)
+                r = tk(arrs["us_srel"], idxc)
                 gk = s * S1c + (r + 1)  # invalid rows (-1, -1) → negative
                 nd2 = nd + 1
                 in_d, in_p = cl_probe(bq(q_k2, nd2), gk)
@@ -760,38 +1193,37 @@ def make_flat_fn(
                     in_d, in_p = in_d | win_d, in_p | win_p
                 refl = (gk == bq(q_k2, nd2)) & (bq(q_k2, nd2) >= 0)
                 if plan.has_permission_usersets:
-                    if BS:
-                        permf = (
-                            (jnp.where(valid, ublk[..., usL["perm"]], 0) != 0)
-                            if meta.us_hasperm
-                            else jnp.zeros(valid.shape, bool)
-                        )
-                        pblk = probe_block(
-                            arrs["push_off"], arrs["pusx"], meta.pus_cap, (gk,)
-                        )
-                        in_pus = jnp.any(
-                            (pblk[..., 0] == gk[..., None])
-                            & (gk >= 0)[..., None],
-                            axis=-1,
-                        )
-                    else:
-                        permf = tk(arrs["us_perm"], idxc) != 0
-                        in_pus = probe_rows(
-                            arrs["push_off"], arrs["push_rows"],
-                            (arrs["pus_k"],), (gk,),
-                            meta.pus_cap, meta.pus_n,
-                        ) >= 0
+                    permf = tk(arrs["us_perm"], idxc) != 0
+                    in_pus = probe_rows(
+                        arrs["push_off"], arrs["push_rows"],
+                        (arrs["pus_k"],), (gk,),
+                        meta.pus_cap, meta.pus_n,
+                    ) >= 0
                     in_d = (in_d | refl) & ~permf
                     in_p = in_p | refl | in_pus | permf
                 else:
                     in_d = in_d | refl
                     in_p = in_p | refl
-                if BS:
-                    ugd, ugp = gate2_blk("us", ublk, usL, valid)
-                else:
-                    ugd, ugp = gate2("us", idxc, valid)
+                ugd, ugp = gate2("us", idxc, valid)
                 d = d | jnp.any(ugd & in_d, axis=-1)
                 p = p | jnp.any(ugp & in_p, axis=-1)
+
+            # delta-level userset grants (adds with subject relations)
+            run_kud = (
+                dm is not None
+                and dm.has_us
+                and (bool(dm.us_slots) if dyn else (slot in dm.us_slots))
+            )
+            if run_kud:
+                lo, hi = range_probe(
+                    arrs["dl_usr_off"], arrs["dl_usgx"], dm.us_cap, k1
+                )
+                ovf = ovf | reduceB(exists & ((hi - lo) > dm.us_fan))
+                kd, kp, ku_used = ku_eval(
+                    slice_blocks(arrs["dl_usx"], lo, dm.us_fan),
+                    lo, hi, dm.us_fan, tombstoned=False,
+                )
+                d, p, used = d | kd, p | kp, used | ku_used
             return d, p, ovf, used
 
         memo: Dict = {}
@@ -859,39 +1291,77 @@ def make_flat_fn(
                 ts_slot = plan.ts_slots[ir[1]]
                 child_types = arrow_child_types(ts_slot, types)
                 data_fan = dict(meta.ar_fanout_by_slot).get(ts_slot, 0)
-                if not child_types or data_fan == 0:
+                d_run = dm is not None and dm.has_ar and ts_slot in dm.ar_slots
+                Ksd = dm.ar_fan if d_run else 0
+                if not child_types or (data_fan == 0 and Ksd == 0):
                     # no reachable types / no edges of this tupleset at all
                     z = jnp.zeros(nodes.shape, bool)
                     return z, z, zB, zB
                 Ks = min(K, data_fan)
                 exists = nodes >= 0
                 ak = jnp.int32(ts_slot) * Nc + jnp.where(exists, nodes, 0)
-                lo, hi = range_of("arr", meta.arr_cap, meta.arr_gn, ak)
+                if Ks:
+                    lo, hi = range_of("arr", meta.arr_cap, meta.arr_gn, ak)
+                else:
+                    lo = hi = jnp.zeros(nodes.shape, jnp.int32)
+                if Ksd:
+                    lod, hid = range_probe(
+                        arrs["dl_arr_off"], arrs["dl_argx"], dm.ar_cap, ak
+                    )
+                else:
+                    lod = hid = jnp.zeros(nodes.shape, jnp.int32)
                 width = 1
                 for dim in nodes.shape[1:]:
                     width *= dim
-                if width * Ks > cfg.flat_max_width:
+                if width * (Ks + Ksd) > cfg.flat_max_width:
                     # lattice budget spent: don't expand — probe child
                     # existence only; real deeper grants surface as
                     # possible and resolve on the host oracle
                     return (
                         jnp.zeros(nodes.shape, bool),
-                        (hi > lo) & exists,
+                        ((hi > lo) | (hid > lod)) & exists,
                         zB, zB,
                     )
                 ovf = reduceB(exists & ((hi - lo) > Ks))
                 valid = (
-                    jnp.arange(Ks, dtype=jnp.int32) < (hi - lo)[..., None]
+                    jnp.arange(max(Ks, 1), dtype=jnp.int32) < (hi - lo)[..., None]
                 ) & exists[..., None]
-                if BS:
+                if Ks == 0:
+                    children = jnp.full(nodes.shape + (0,), -1, jnp.int32)
+                    gd = gp = jnp.zeros(nodes.shape + (0,), bool)
+                elif BS:
                     ablk = slice_blocks(arrs["arx"], lo, Ks)
                     children = jnp.where(valid, ablk[..., arL["child"]], -1)
                     gd, gp = gate2_blk("ar", ablk, arL, valid)
+                    if dm is not None and dm.has_artomb:
+                        # mask deleted base rows by (group, child) identity
+                        tb = probe_block(
+                            arrs["dl_atb_off"], arrs["dl_atbx"], dm.atb_cap,
+                            (ak[..., None], children),
+                        )
+                        tomb = jnp.any(
+                            blk_hit(tb, (ak[..., None], children)), axis=-1
+                        )
+                        children = jnp.where(tomb, -1, children)
+                        gd, gp = gd & ~tomb, gp & ~tomb
                 else:
                     idx = lo[..., None] + jnp.arange(Ks, dtype=jnp.int32)
                     idxc = jnp.clip(idx, 0, max(meta.ar_rows - 1, 0))
                     children = jnp.where(valid, tk(arrs["ar_child"], idxc), -1)
                     gd, gp = gate2("ar", idxc, valid)
+                if Ksd:
+                    # delta-level arrow rows: extra candidates on the axis
+                    ovf = ovf | reduceB(exists & ((hid - lod) > Ksd))
+                    dvalid = (
+                        jnp.arange(Ksd, dtype=jnp.int32)
+                        < (hid - lod)[..., None]
+                    ) & exists[..., None]
+                    dblk = slice_blocks(arrs["dl_arx"], lod, Ksd)
+                    dchildren = jnp.where(dvalid, dblk[..., arL["child"]], -1)
+                    dgd, dgp = gate2_blk("ar", dblk, arL, dvalid)
+                    children = jnp.concatenate([children, dchildren], axis=-1)
+                    gd = jnp.concatenate([gd, dgd], axis=-1)
+                    gp = jnp.concatenate([gp, dgp], axis=-1)
                 cd, cp, co, cu = eval_slot(ir[2], children, stack, child_types)
                 return (
                     jnp.any(cd & gd, axis=-1),

@@ -71,6 +71,10 @@ class EngineConfig:
     #: TPU gathers cost ~a row per cycle regardless of width, so this is
     #: the TPU-shaped layout; False falls back to scattered 1-D probes
     flat_blockslice: bool = True
+    #: accumulated delta-level rows (adds + tombstones) beyond
+    #: max(this, E/8) trigger compaction: the next prepare rebuilds the
+    #: base instead of growing the overlay (engine/flat.py delta level)
+    flat_delta_min_compact: int = 65_536
 
     @staticmethod
     def for_schema(compiled: CompiledSchema, **overrides) -> "EngineConfig":

@@ -31,6 +31,7 @@ revision is a consistent snapshot of it (consistency/consistency.go).
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Any, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
@@ -39,6 +40,41 @@ from ..rel.relationship import Relationship, expiration_micros
 from ..schema.compiler import CompiledSchema
 from .interner import Interner
 from .snapshot import Snapshot, _exp_to_rel32, finish_snapshot
+
+
+@dataclass
+class DeltaInfo:
+    """Machine-readable description of the delta that produced a snapshot,
+    attached to it by ``apply_delta`` (as ``snap.delta_info``) so the
+    device engine can advance its resident tables incrementally
+    (engine/flat.py build_delta_arrays) instead of re-shipping O(E) state.
+
+    ``a_*``: the upserted rows (lowered, epoch-relative expiry).
+    ``g_*``: primary-identity columns of every row REMOVED from the
+    previous snapshot — deletions plus rows replaced by an upsert.
+    """
+
+    prev_revision: int
+    a_rel: np.ndarray
+    a_res: np.ndarray
+    a_subj: np.ndarray
+    a_srel1: np.ndarray
+    a_cav: np.ndarray
+    a_ctx: np.ndarray
+    a_exp: np.ndarray  # epoch-relative int32 (device form)
+    g_rel: np.ndarray
+    g_res: np.ndarray
+    g_subj: np.ndarray
+    g_srel1: np.ndarray
+    #: True when context indices were renumbered by compaction — stored
+    #: ctx ids inside device-resident base tables are then stale and the
+    #: device must do a full prepare
+    contexts_renumbered: bool = False
+
+#: contexts-list compaction floor: below this length, dead context dicts
+#: are retained so indices stay append-only stable (the device delta-
+#: prepare depends on that; tests lower it to force renumbering)
+CTX_COMPACT_MIN = 1024
 
 # (rel, res) packed: rel < 2**15 slots, res < 2**31 nodes → 46 bits.
 _RES_BITS = 31
@@ -153,9 +189,12 @@ def _lower_delta(
     interner: Interner,
     rels: Sequence[Relationship],
     contexts: List[Mapping[str, Any]],
+    ctx_index: Optional[dict] = None,
 ) -> Tuple[np.ndarray, ...]:
     """Relationship objects → unsorted int columns (interning new strings),
-    appending any caveat contexts to ``contexts`` in place."""
+    appending any caveat contexts to ``contexts`` in place.  Contexts are
+    deduplicated by value so re-touching a caveated tuple revision after
+    revision reuses one stored dict instead of growing the list."""
     D = len(rels)
     res = np.empty(D, dtype=np.int64)
     rel_s = np.empty(D, dtype=np.int64)
@@ -166,6 +205,12 @@ def _lower_delta(
     exp_us = np.zeros(D, dtype=np.int64)
     slot_of = compiled.slot_of_name
     caveat_ids = compiled.caveat_ids
+    if ctx_index is None:
+        ctx_index = {}
+        for i, c in enumerate(contexts):
+            ctx_index.setdefault(
+                repr(sorted(c.items(), key=lambda kv: kv[0])), i
+            )
     for i, r in enumerate(rels):
         res[i] = interner.node(r.resource_type, r.resource_id)
         rel_s[i] = slot_of[r.resource_relation]
@@ -174,8 +219,13 @@ def _lower_delta(
         if r.caveat_name:
             cav[i] = caveat_ids[r.caveat_name]
             if r.caveat_context:
-                ctx[i] = len(contexts)
-                contexts.append(r.caveat_context)
+                key = repr(sorted(r.caveat_context.items(), key=lambda kv: kv[0]))
+                at = ctx_index.get(key)
+                if at is None:
+                    at = len(contexts)
+                    ctx_index[key] = at
+                    contexts.append(r.caveat_context)
+                ctx[i] = at
         exp_us[i] = expiration_micros(r.expiration) if r.has_expiration() else 0
     return res, rel_s, subj, srel1, cav, ctx, exp_us
 
@@ -200,8 +250,16 @@ def apply_delta(
     compiled = prev.compiled
     contexts = list(prev.contexts)
 
+    # the value→index dedup map is append-only between renumberings, so
+    # chained deltas carry it forward instead of re-hashing every stored
+    # context dict per revision
+    ctx_index = getattr(prev, "_ctx_index", None)
+    if ctx_index is None:
+        ctx_index = {}
+        for i, c in enumerate(contexts):
+            ctx_index.setdefault(repr(sorted(c.items(), key=lambda kv: kv[0])), i)
     a_res, a_rel, a_subj, a_srel1, a_cav, a_ctx, a_exp_us = _lower_delta(
-        compiled, interner, adds, contexts
+        compiled, interner, adds, contexts, ctx_index=ctx_index
     )
     d_contexts: List[Mapping[str, Any]] = []
     d_res, d_rel, d_subj, d_srel1, _, _, _ = _lower_delta(
@@ -247,22 +305,45 @@ def apply_delta(
     e_exp = interleave(prev.e_exp, a_exp32[a_order])
     e_exp_us = interleave(prev.e_exp_us, a_exp_us[a_order])
 
-    # compact contexts: tombstoned rows' dicts would otherwise accumulate
-    # forever across chained deltas (each snapshot copies the list)
+    # compact contexts only when the dead fraction is substantial:
+    # renumbering invalidates the ctx ids baked into device-resident base
+    # tables, forcing the engine's delta-prepare into a full rebuild, so
+    # small deltas keep indices append-only stable
+    renumbered = False
     used = e_ctx >= 0
-    if np.any(used):
+    n_used = int(np.count_nonzero(used))
+    if n_used == 0:
+        renumbered = bool(contexts)
+        contexts = []
+    elif len(contexts) > CTX_COMPACT_MIN and len(contexts) > 2 * n_used:
         live_ctx, inv = np.unique(e_ctx[used], return_inverse=True)
         contexts = [contexts[i] for i in live_ctx]
         e_ctx = e_ctx.copy()
         e_ctx[used] = inv.astype(np.int32)
-    else:
-        contexts = []
+        renumbered = True
 
     nxt = finish_snapshot(
         revision, compiled, interner,
         e_rel=e_rel, e_res=e_res, e_subj=e_subj, e_srel1=e_srel1,
         e_caveat=e_cav, e_ctx=e_ctx, e_exp=e_exp, e_exp_us=e_exp_us,
         contexts=contexts, epoch_us=prev.epoch_us,
+    )
+    if not renumbered:
+        nxt._ctx_index = ctx_index  # still valid: indices were append-only
+    # attach the machine-readable delta for the device engine's
+    # incremental prepare (identity columns of removed rows come from the
+    # previous snapshot's primary arrays)
+    gone_rows = (
+        np.unique(gone[gone >= 0]) if gone.size else np.empty(0, np.int64)
+    )
+    nxt.delta_info = DeltaInfo(
+        prev_revision=prev.revision,
+        a_rel=a_rel.astype(np.int32), a_res=a_res.astype(np.int32),
+        a_subj=a_subj.astype(np.int32), a_srel1=a_srel1.astype(np.int32),
+        a_cav=a_cav, a_ctx=a_ctx, a_exp=a_exp32,
+        g_rel=prev.e_rel[gone_rows], g_res=prev.e_res[gone_rows],
+        g_subj=prev.e_subj[gone_rows], g_srel1=prev.e_srel1[gone_rows],
+        contexts_renumbered=renumbered,
     )
     # carry the lookup index forward: when the previous snapshot has one,
     # advance it by the delta (O(E + D log E) merges) instead of letting

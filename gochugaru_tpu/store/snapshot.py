@@ -399,6 +399,7 @@ def finish_snapshot(
     used = np.unique(us_subj_key)
     edge_key = res_o * num_slots + rel_o  # the userset each edge grants
     feeds = np.isin(edge_key, used)
+    used_keys = used  # persisted below: the delta-prepare bail test
 
     # seeds: direct edges into used usersets, by subject node
     seed_mask = feeds & (srel_o < 0)
@@ -482,7 +483,7 @@ def finish_snapshot(
     ar_ctx = e_ctx[ar_mask]
     ar_exp = e_exp[ar_mask]
 
-    return Snapshot(
+    snap = Snapshot(
         revision=revision,
         compiled=compiled,
         interner=interner,
@@ -504,3 +505,9 @@ def finish_snapshot(
         ar_caveat=ar_cav, ar_ctx=ar_ctx, ar_exp=ar_exp,
         contexts=contexts,
     )
+    # packed (subj · num_slots + srel) int64 keys of usersets that appear
+    # as tuple subjects: the device delta-prepare (engine/flat.py
+    # build_delta_arrays) bails to a full rebuild when a delta row touches
+    # the membership subgraph, which it detects against this set
+    snap.us_used_keys = used_keys
+    return snap

@@ -159,9 +159,11 @@ def test_delta_stream_many_revisions():
 
 
 def test_delta_contexts_do_not_accumulate():
-    """Touching the same caveated tuple revision after revision must not
-    grow the snapshot's contexts list (tombstoned rows' dicts are
-    compacted away in the delta merge)."""
+    """Touching the same caveated tuple revision after revision must keep
+    the contexts list bounded: identical context dicts are deduplicated at
+    lowering, and once the list outgrows the compaction floor the dead
+    fraction is renumbered away (flagged so the device delta-prepare does
+    a full rebuild rather than reading stale ctx ids)."""
     store = Store()
     store.write_schema(SCHEMA)
     full = consistency.full()
@@ -170,8 +172,33 @@ def test_delta_contexts_do_not_accumulate():
         store.write(Txn().touch(r.with_caveat("ip_ok", {"allowed": i % 2})))
         snap = store.snapshot_for(full)
     assert snap.num_edges == 1
-    assert len(snap.contexts) == 1
+    # value-dedup: the 30 touches alternate between exactly two dicts
+    assert len(snap.contexts) <= 2
     assert snap.decode_edge(0).caveat_context == {"allowed": 1}
+
+
+def test_delta_contexts_compact_past_floor(monkeypatch):
+    """Past the compaction floor, dead contexts are renumbered away and
+    the delta is flagged contexts_renumbered (the device delta-prepare
+    must not trust its baked-in ctx ids afterwards)."""
+    from gochugaru_tpu.store import delta as delta_mod
+
+    monkeypatch.setattr(delta_mod, "CTX_COMPACT_MIN", 4)
+    store = Store()
+    store.write_schema(SCHEMA)
+    full = consistency.full()
+    r = rel.must_from_tuple("doc:d0#reader", "user:u0")
+    renumbered_ever = False
+    for i in range(12):
+        store.write(Txn().touch(r.with_caveat("ip_ok", {"allowed": i})))
+        snap = store.snapshot_for(full)
+        di = getattr(snap, "delta_info", None)
+        if di is not None and di.contexts_renumbered:
+            renumbered_ever = True
+    assert snap.num_edges == 1
+    assert len(snap.contexts) <= 5
+    assert renumbered_ever
+    assert snap.decode_edge(0).caveat_context == {"allowed": 11}
 
 
 def test_delta_checks_agree_with_oracle():
