@@ -49,6 +49,7 @@ from .hash import (
     build_range_hash,
     interleave_buckets,
     interleave_rows,
+    mix32,
     probe_block,
     probe_range,
     probe_rows,
@@ -174,6 +175,9 @@ class FlatMeta:
     #: LSM delta level riding on this snapshot's base tables (None = the
     #: snapshot was fully prepared)
     delta: Optional[DeltaMeta] = None
+    #: tables are bucket-sharded / stacked for shard_map (the kernel must
+    #: be built with the matching ``axis``; make_flat_fn enforces this)
+    sharded: bool = False
 
 
 def _gate_cols(hascav: bool, hasexp: bool) -> list:
@@ -229,16 +233,13 @@ def _pad(a: np.ndarray, size: int, fill) -> np.ndarray:
     return out
 
 
-def build_flat_arrays(
-    snap, config: EngineConfig
-) -> Optional[Tuple[Dict[str, np.ndarray], FlatMeta]]:
-    """Hash-index the snapshot + flatten its membership closure.  Returns
-    padded host arrays (merged into DeviceSnapshot.arrays) and the static
-    FlatMeta — or None when keys don't pack into int32 (num_nodes ·
-    num_slots ≥ 2³¹; such graphs use the legacy engine)."""
-    from ..store.closure import NEVER, NO_EXP, _expand_join, build_closure
+def _pack(a: np.ndarray, radix: int, b) -> np.ndarray:
+    return (a.astype(np.int64) * radix + b).astype(np.int32)
 
-    # pow2 radix: stable across deltas until the node count doubles
+
+def _node_radix(snap) -> Optional[Tuple[int, int]]:
+    """(N, S1) packing radices with delta headroom, or None when keys
+    don't fit int32 (such graphs use the legacy engine)."""
     N = _ceil_pow2(max(snap.num_nodes, 1), 8)
     S1 = snap.num_slots + 1
     if N * snap.num_slots >= 2**31 or N * S1 >= 2**31:
@@ -248,20 +249,125 @@ def build_flat_arrays(
     # full rebuild — double N whenever the key space still fits int32
     if N < 2 * snap.num_nodes and 2 * N * S1 < 2**31 and 2 * N * snap.num_slots < 2**31:
         N *= 2
+    return N, S1
+
+
+def _view_flags_of(snap) -> Dict[str, bool]:
+    return dict(
+        e_hascav=bool(snap.e_caveat.any()),
+        e_hasexp=bool(snap.e_exp.any()),
+        us_hascav=bool(snap.us_caveat.any()),
+        us_hasexp=bool(snap.us_exp.any()),
+        us_hasperm=bool(snap.us_perm.any()),
+        ar_hascav=bool(snap.ar_caveat.any()),
+        ar_hasexp=bool(snap.ar_exp.any()),
+    )
+
+
+def _run_maxes(gk: np.ndarray, glo: np.ndarray, ghi: np.ndarray, N: int):
+    """Per-slot max run length of a packed (slot·N + res) range index
+    (pow2-bucketed so retraces are rare)."""
+    fans: Dict[int, int] = {}
+    if gk.shape[0]:
+        slots_of = gk.astype(np.int64) // N
+        lens = (ghi - glo).astype(np.int64)
+        first = np.ones(gk.shape[0], bool)
+        first[1:] = slots_of[1:] != slots_of[:-1]
+        starts = np.nonzero(first)[0]
+        for s, m in zip(slots_of[starts], np.maximum.reduceat(lens, starts)):
+            fans[int(s)] = _round_fan(int(m))
+    return tuple(sorted(fans.items()))
+
+
+def _tindex_join(snap, config: EngineConfig, cl, us_gk, cl_k1, cl_k2, pus_k, S1):
+    """The T-index join (userset edges ⋈ closure-by-target) shared by both
+    layout builders: returns (T_k1, T_k2, T_d, T_p, t_slots, t_all) or
+    None when disabled/ineligible/oversized.  For slots whose userset rows
+    carry no caveats and no permission-valued subjects, {edge expiry ×
+    closure semiring} folds into ONE (slot·N+res, member-key) →
+    until-values table."""
+    from ..store.closure import NO_EXP, _expand_join
+
+    if not (config.flat_tindex and snap.us_rel.shape[0]):
+        return None
+    ok = (snap.us_caveat == 0) & (snap.us_perm == 0)
+    pe_all = _pack(snap.us_subj, S1, snap.us_srel + 1)
+    if snap.pus_n.shape[0]:
+        pus_sorted = np.sort(pus_k)
+        pos = np.clip(
+            np.searchsorted(pus_sorted, pe_all), 0, pus_sorted.shape[0] - 1
+        )
+        ok &= ~(pus_sorted[pos] == pe_all)
+    bad_slots = np.unique(snap.us_rel[~ok])
+    elig = ~np.isin(snap.us_rel, bad_slots)
+    if not elig.any():
+        return None
+    tgt = cl_k2
+    t_order = np.argsort(tgt, kind="stable")
+    pe = pe_all[elig]
+    ek1 = us_gk[elig]
+    w = np.where(
+        snap.us_exp[elig] == 0, np.int64(NO_EXP),
+        snap.us_exp[elig].astype(np.int64),
+    ).astype(np.int32)
+    cap_rows = config.flat_tindex_factor * max(int(snap.us_rel.shape[0]), 1024)
+    # size the join BEFORE materializing it: a popular group with a huge
+    # closure in-degree must disable the index, not OOM
+    tgt_sorted = tgt[t_order]
+    join_rows = int(
+        (
+            np.searchsorted(tgt_sorted, pe, "right")
+            - np.searchsorted(tgt_sorted, pe, "left")
+        ).sum()
+    )
+    if join_rows + pe.shape[0] > cap_rows:
+        return None
+    reps, ii = _expand_join(tgt_sorted, pe)
+    jj = t_order[ii]
+    T_k1 = np.concatenate([ek1, ek1[reps]])
+    T_k2 = np.concatenate([pe, cl_k1[jj]])
+    T_d = np.concatenate([w, np.minimum(w[reps], cl.c_d_until[jj])])
+    T_p = np.concatenate([w, np.minimum(w[reps], cl.c_p_until[jj])])
+    o2 = np.lexsort((T_k2, T_k1))
+    T_k1, T_k2 = T_k1[o2], T_k2[o2]
+    T_d, T_p = T_d[o2], T_p[o2]
+    first = np.ones(T_k1.shape[0], bool)
+    first[1:] = (T_k1[1:] != T_k1[:-1]) | (T_k2[1:] != T_k2[:-1])
+    st = np.nonzero(first)[0]
+    T_k1, T_k2 = T_k1[first], T_k2[first]
+    T_d = np.maximum.reduceat(T_d, st)
+    T_p = np.maximum.reduceat(T_p, st)
+    return (
+        T_k1, T_k2, T_d, T_p,
+        tuple(int(s) for s in np.unique(snap.us_rel[elig])),
+        bad_slots.size == 0,
+    )
+
+
+def build_flat_arrays(
+    snap, config: EngineConfig
+) -> Optional[Tuple[Dict[str, np.ndarray], FlatMeta]]:
+    """Hash-index the snapshot + flatten its membership closure.  Returns
+    padded host arrays (merged into DeviceSnapshot.arrays) and the static
+    FlatMeta — or None when keys don't pack into int32 (num_nodes ·
+    num_slots ≥ 2³¹; such graphs use the legacy engine)."""
+    from ..store.closure import NEVER, build_closure
+
+    radix = _node_radix(snap)
+    if radix is None:
+        return None
+    N, S1 = radix
 
     cl = build_closure(snap, per_source_cap=config.closure_source_cap)
 
-    def pk(a, radix, b):
-        return (a.astype(np.int64) * radix + b).astype(np.int32)
-
-    e_k1 = pk(snap.e_rel, N, snap.e_res)
-    e_k2 = pk(snap.e_subj, S1, snap.e_srel1)
-    us_gk = pk(snap.us_rel, N, snap.us_res)
-    ar_gk = pk(snap.ar_rel, N, snap.ar_res)
-    cl_k1 = pk(cl.c_src, S1, cl.c_srel1)
-    cl_k2 = pk(cl.c_g, S1, cl.c_grel + 1)
-    pus_k = pk(snap.pus_n, S1, snap.pus_r + 1)
-    ovf_k = pk(cl.ovf_src, S1, cl.ovf_srel1)
+    e_k1 = _pack(snap.e_rel, N, snap.e_res)
+    e_k2 = _pack(snap.e_subj, S1, snap.e_srel1)
+    us_gk = _pack(snap.us_rel, N, snap.us_res)
+    ar_gk = _pack(snap.ar_rel, N, snap.ar_res)
+    cl_k1 = _pack(cl.c_src, S1, cl.c_srel1)
+    cl_k2 = _pack(cl.c_g, S1, cl.c_grel + 1)
+    pus_k = _pack(snap.pus_n, S1, snap.pus_r + 1)
+    ovf_k = _pack(cl.ovf_src, S1, cl.ovf_srel1)
 
     eh = build_hash([e_k1, e_k2])
     usr = build_range_hash(us_gk)
@@ -273,13 +379,11 @@ def build_flat_arrays(
     out: Dict[str, np.ndarray] = {}
     BS = config.flat_blockslice
     # view flags, computed up front: they pick the interleaved layouts
-    e_hascav = bool(snap.e_caveat.any())
-    e_hasexp = bool(snap.e_exp.any())
-    us_hascav = bool(snap.us_caveat.any())
-    us_hasexp = bool(snap.us_exp.any())
-    us_hasperm = bool(snap.us_perm.any())
-    ar_hascav = bool(snap.ar_caveat.any())
-    ar_hasexp = bool(snap.ar_exp.any())
+    flags = _view_flags_of(snap)
+    e_hascav, e_hasexp = flags["e_hascav"], flags["e_hasexp"]
+    us_hascav, us_hasexp = flags["us_hascav"], flags["us_hasexp"]
+    us_hasperm = flags["us_hasperm"]
+    ar_hascav, ar_hasexp = flags["ar_hascav"], flags["ar_hasexp"]
 
     def put_hash(prefix: str, h) -> None:
         # off keeps its exact size+1 length: the device probe derives the
@@ -351,95 +455,31 @@ def build_flat_arrays(
         out["pus_k"] = _pad(pus_k, _ceil_pow2(max(pus_k.shape[0], 1)), -1)
         out["ovf_k"] = _pad(ovf_k, _ceil_pow2(max(ovf_k.shape[0], 1)), -1)
 
-    # ---- T-index: userset edges ⋈ closure-by-target ---------------------
-    # For slots whose userset rows carry no caveats and no permission-
-    # valued subjects, fold {edge expiry × closure semiring} into ONE
-    # (slot·N+res, member-key) → (d_until, p_until) table: the kernel's
-    # userset block becomes a single hash probe.  Size-capped; ineligible
-    # or oversized → the KU probe path still answers.
+    # ---- T-index: userset edges ⋈ closure-by-target (shared join) -------
     t_kw = dict(has_tindex=False, t_cap=4, t_n=8, t_slots=(), t_all=False)
-    if config.flat_tindex and snap.us_rel.shape[0]:
-        ok = (snap.us_caveat == 0) & (snap.us_perm == 0)
-        pe_all = pk(snap.us_subj, S1, snap.us_srel + 1)
-        if snap.pus_n.shape[0]:
-            pus_sorted = np.sort(pus_k)
-            pos = np.clip(
-                np.searchsorted(pus_sorted, pe_all), 0, pus_sorted.shape[0] - 1
-            )
-            ok &= ~(pus_sorted[pos] == pe_all)
-        bad_slots = np.unique(snap.us_rel[~ok])
-        elig = ~np.isin(snap.us_rel, bad_slots)
-        if elig.any():
-            tgt = cl_k2
-            t_order = np.argsort(tgt, kind="stable")
-            pe = pe_all[elig]
-            ek1 = us_gk[elig]
-            w = np.where(
-                snap.us_exp[elig] == 0, np.int64(NO_EXP),
-                snap.us_exp[elig].astype(np.int64),
-            ).astype(np.int32)
-            cap_rows = config.flat_tindex_factor * max(
-                int(snap.us_rel.shape[0]), 1024
-            )
-            # size the join BEFORE materializing it: a popular group with
-            # a huge closure in-degree must disable the index, not OOM
-            tgt_sorted = tgt[t_order]
-            join_rows = int(
-                (
-                    np.searchsorted(tgt_sorted, pe, "right")
-                    - np.searchsorted(tgt_sorted, pe, "left")
-                ).sum()
-            )
-            if join_rows + pe.shape[0] <= cap_rows:
-                reps, ii = _expand_join(tgt_sorted, pe)
-                jj = t_order[ii]
-                T_k1 = np.concatenate([ek1, ek1[reps]])
-                T_k2 = np.concatenate([pe, cl_k1[jj]])
-                T_d = np.concatenate([w, np.minimum(w[reps], cl.c_d_until[jj])])
-                T_p = np.concatenate([w, np.minimum(w[reps], cl.c_p_until[jj])])
-                o2 = np.lexsort((T_k2, T_k1))
-                T_k1, T_k2 = T_k1[o2], T_k2[o2]
-                T_d, T_p = T_d[o2], T_p[o2]
-                first = np.ones(T_k1.shape[0], bool)
-                first[1:] = (T_k1[1:] != T_k1[:-1]) | (T_k2[1:] != T_k2[:-1])
-                st = np.nonzero(first)[0]
-                T_k1, T_k2 = T_k1[first], T_k2[first]
-                T_d = np.maximum.reduceat(T_d, st)
-                T_p = np.maximum.reduceat(T_p, st)
-                th = build_hash([T_k1, T_k2])
-                if BS:
-                    out["th_off"] = th.off
-                    out["tx"] = interleave_buckets(th, [T_k1, T_k2, T_d, T_p])
-                else:
-                    put_hash("th", th)
-                    TP = _ceil_pow2(max(T_k1.shape[0], 1))
-                    out["t_k1"] = _pad(T_k1, TP, -1)
-                    out["t_k2"] = _pad(T_k2, TP, -1)
-                    out["t_d"] = _pad(T_d, TP, NEVER)
-                    out["t_p"] = _pad(T_p, TP, NEVER)
-                t_kw = dict(
-                    has_tindex=True,
-                    t_cap=_round_cap(th.cap),
-                    t_n=_ceil_pow2(max(th.n, 1)),
-                    t_slots=tuple(int(s) for s in np.unique(snap.us_rel[elig])),
-                    t_all=bad_slots.size == 0,
-                )
+    tj = _tindex_join(snap, config, cl, us_gk, cl_k1, cl_k2, pus_k, S1)
+    if tj is not None:
+        T_k1, T_k2, T_d, T_p, t_slots, t_all = tj
+        th = build_hash([T_k1, T_k2])
+        if BS:
+            out["th_off"] = th.off
+            out["tx"] = interleave_buckets(th, [T_k1, T_k2, T_d, T_p])
+        else:
+            put_hash("th", th)
+            TP = _ceil_pow2(max(T_k1.shape[0], 1))
+            out["t_k1"] = _pad(T_k1, TP, -1)
+            out["t_k2"] = _pad(T_k2, TP, -1)
+            out["t_d"] = _pad(T_d, TP, NEVER)
+            out["t_p"] = _pad(T_p, TP, NEVER)
+        t_kw = dict(
+            has_tindex=True,
+            t_cap=_round_cap(th.cap),
+            t_n=_ceil_pow2(max(th.n, 1)),
+            t_slots=t_slots,
+            t_all=t_all,
+        )
 
     wc_nodes = snap.wildcard_node_of_type[snap.wildcard_node_of_type >= 0]
-
-    def run_maxes(gk: np.ndarray, glo: np.ndarray, ghi: np.ndarray):
-        """Per-slot max run length of a packed (slot·N + res) range index
-        (pow2-bucketed so retraces are rare)."""
-        fans: Dict[int, int] = {}
-        if gk.shape[0]:
-            slots_of = gk.astype(np.int64) // N
-            lens = (ghi - glo).astype(np.int64)
-            first = np.ones(gk.shape[0], bool)
-            first[1:] = slots_of[1:] != slots_of[:-1]
-            starts = np.nonzero(first)[0]
-            for s, m in zip(slots_of[starts], np.maximum.reduceat(lens, starts)):
-                fans[int(s)] = _round_fan(int(m))
-        return fans
 
     meta = FlatMeta(
         N=N, S1=S1,
@@ -455,8 +495,8 @@ def build_flat_arrays(
         pus_cap=_round_cap(push.cap), pus_n=_ceil_pow2(max(push.n, 1)),
         ovf_cap=_round_cap(ovfh.cap), ovf_n=_ceil_pow2(max(ovfh.n, 1)),
         has_ovf=ovfh.n > 0,
-        ar_fanout_by_slot=tuple(sorted(run_maxes(arr.gk, arr.glo, arr.ghi).items())),
-        us_fanout_by_slot=tuple(sorted(run_maxes(usr.gk, usr.glo, usr.ghi).items())),
+        ar_fanout_by_slot=_run_maxes(arr.gk, arr.glo, arr.ghi, N),
+        us_fanout_by_slot=_run_maxes(usr.gk, usr.glo, usr.ghi, N),
         **t_kw,
         e_hascav=e_hascav,
         e_hasexp=e_hasexp,
@@ -466,6 +506,220 @@ def build_flat_arrays(
         ar_hascav=ar_hascav,
         ar_hasexp=ar_hasexp,
         blockslice=BS,
+        e_slots=tuple(int(s) for s in np.unique(snap.e_rel)),
+        us_slots=tuple(int(s) for s in np.unique(snap.us_rel)),
+        has_wc_edges=bool(np.isin(snap.e_subj, wc_nodes).any()),
+        has_wc_closure=bool(
+            np.isin(cl.c_src[cl.c_srel1 == 0], wc_nodes).any()
+            or np.isin(cl.ovf_src[cl.ovf_srel1 == 0], wc_nodes).any()
+        ),
+    )
+    return out, meta
+
+
+# ---------------------------------------------------------------------------
+# bucket-sharded layout (multi-chip: shard_map over the model axis)
+# ---------------------------------------------------------------------------
+#
+# Hash tables shard by BUCKET RANGE: device s of M owns buckets
+# [s·bpd, (s+1)·bpd) (bpd = size/M, both pow2), the bucket-ordered
+# interleaved rows for those buckets (a contiguous slice), and the
+# normalized local offsets.  A probe hashes globally, masks "is this my
+# bucket", probes locally, and the site's boolean outputs OR-reduce over
+# ICI (psum); value blocks (userset/arrow candidate rows) broadcast from
+# their single owner via psum-of-masked.  This keeps per-device table
+# memory at 1/M — the graph-size scaling axis of SURVEY.md §5 — while the
+# kernel stays the same straight-line probe program.
+
+
+def _stack_point(h: HashIndex, cols: Sequence[np.ndarray], M: int, pad: int = 64):
+    """Bucket-sharded point table: (off int32[M·(bpd+1)],
+    tbl int32[M·R_pad, w]) — shard_map splits both on the leading axis."""
+    size, bpd = h.size, h.size // M
+    assert bpd * M == h.size and bpd >= 1
+    w = max(len(cols), 1)
+    n = int(h.rows.shape[0]) if h.n else 0
+    perm = [np.ascontiguousarray(c, np.int32)[h.rows[:n]] for c in cols]
+    off = h.off.astype(np.int64)
+    starts = off[np.arange(M) * bpd]
+    ends = off[(np.arange(M) + 1) * bpd]
+    R_pad = _ceil_pow2(int((ends - starts).max() if M else 1) + max(pad, h.cap))
+    tbl = np.full((M, R_pad, w), -1, np.int32)
+    offs = np.zeros((M, bpd + 1), np.int32)
+    for s in range(M):
+        g0, g1 = int(starts[s]), int(ends[s])
+        for j, c in enumerate(perm):
+            tbl[s, : g1 - g0, j] = c[g0:g1]
+        offs[s] = (h.off[s * bpd : (s + 1) * bpd + 1] - g0).astype(np.int32)
+    return offs.reshape(-1), tbl.reshape(M * R_pad, w)
+
+
+def _stack_range(ri, row_cols: Sequence[np.ndarray], M: int, fan_pad: int):
+    """Bucket-sharded range view: the group table shards like a point
+    table, and the underlying rows are PERMUTED into group-bucket order so
+    each device's rows are its own groups' rows, contiguous and locally
+    indexed.  ``ri`` is a RangeIndex built with min_size ≥ M (its group
+    hash is reused, not rebuilt).  Returns (goff, gtbl, rows_tbl,
+    group_cap) stacked for shard_map splitting."""
+    gk, glo, ghi, gh = ri.gk, ri.glo, ri.ghi, ri.index
+    G = int(gk.shape[0])
+    size, bpd = gh.size, gh.size // M
+    assert bpd * M == size, "RangeIndex must be built with min_size >= M"
+    lens = ghi.astype(np.int64) - glo.astype(np.int64)
+    w = max(len(row_cols), 1)
+    goff = gh.off.astype(np.int64)
+    g_starts = goff[np.arange(M) * bpd]
+    g_ends = goff[(np.arange(M) + 1) * bpd]
+    # one global bucket-ordered row permutation (vectorized), sliced per
+    # shard: order_groups lists groups bucket-ordered; their row ranges
+    # concatenate in that order
+    order_groups = gh.rows[:G]
+    lens_o = lens[order_groups] if G else np.zeros(0, np.int64)
+    ends_all = np.cumsum(lens_o)
+    starts_all = ends_all - lens_o
+    total = int(ends_all[-1]) if G else 0
+    row_src = (
+        np.repeat(glo[order_groups].astype(np.int64), lens_o)
+        + (np.arange(total, dtype=np.int64) - np.repeat(starts_all, lens_o))
+        if G
+        else np.zeros(0, np.int64)
+    )
+    shard_row_base = np.zeros(M + 1, np.int64)
+    for s in range(M):
+        shard_row_base[s + 1] = (
+            ends_all[int(g_ends[s]) - 1] if g_ends[s] > g_starts[s]
+            else shard_row_base[s]
+        )
+    row_counts = np.diff(shard_row_base)
+    R_pad = _ceil_pow2(int(row_counts.max() if M else 1) + max(fan_pad, 64))
+    G_pad = _ceil_pow2(int((g_ends - g_starts).max() if M else 1) + max(64, gh.cap))
+    rows_tbl = np.full((M, R_pad, w), -1, np.int32)
+    gtbl = np.full((M, G_pad, 3), -1, np.int32)
+    goffs = np.zeros((M, bpd + 1), np.int32)
+    cols32 = [np.ascontiguousarray(c, np.int32) for c in row_cols]
+    for s in range(M):
+        gs0, gs1 = int(g_starts[s]), int(g_ends[s])
+        r0, r1 = int(shard_row_base[s]), int(shard_row_base[s + 1])
+        src = row_src[r0:r1]
+        for ci, c in enumerate(cols32):
+            rows_tbl[s, : r1 - r0, ci] = c[src]
+        ng = gs1 - gs0
+        gtbl[s, :ng, 0] = gk[order_groups[gs0:gs1]]
+        gtbl[s, :ng, 1] = (starts_all[gs0:gs1] - r0).astype(np.int32)
+        gtbl[s, :ng, 2] = (ends_all[gs0:gs1] - r0).astype(np.int32)
+        goffs[s] = (gh.off[s * bpd : (s + 1) * bpd + 1] - gs0).astype(np.int32)
+    return (
+        goffs.reshape(-1),
+        gtbl.reshape(M * G_pad, 3),
+        rows_tbl.reshape(M * R_pad, w),
+        gh.cap,
+    )
+
+
+def build_flat_arrays_sharded(
+    snap, config: EngineConfig, model_size: int
+) -> Optional[Tuple[Dict[str, np.ndarray], FlatMeta]]:
+    """The bucket-sharded counterpart of build_flat_arrays: every hash /
+    range / closure / T table stacked per model shard (leading axis splits
+    M ways under shard_map; probes mask bucket ownership and OR-reduce).
+    Array names and FlatMeta fields match the single-chip layout — the
+    kernel distinguishes the layouts by FlatMeta.sharded and must be built
+    with the matching ``axis``.  Returns None when keys don't pack (legacy
+    sharded path)."""
+    from ..store.closure import build_closure
+
+    M = model_size
+    radix = _node_radix(snap)
+    if radix is None:
+        return None
+    N, S1 = radix
+
+    cl = build_closure(snap, per_source_cap=config.closure_source_cap)
+
+    e_k1 = _pack(snap.e_rel, N, snap.e_res)
+    e_k2 = _pack(snap.e_subj, S1, snap.e_srel1)
+    us_gk = _pack(snap.us_rel, N, snap.us_res)
+    ar_gk = _pack(snap.ar_rel, N, snap.ar_res)
+    cl_k1 = _pack(cl.c_src, S1, cl.c_srel1)
+    cl_k2 = _pack(cl.c_g, S1, cl.c_grel + 1)
+    pus_k = _pack(snap.pus_n, S1, snap.pus_r + 1)
+    ovf_k = _pack(cl.ovf_src, S1, cl.ovf_srel1)
+
+    flags = _view_flags_of(snap)
+
+    ms = max(8, M)
+    eh = build_hash([e_k1, e_k2], min_size=ms)
+    clh = build_hash([cl_k1, cl_k2], min_size=ms)
+    push = build_hash([pus_k], min_size=ms)
+    ovfh = build_hash([ovf_k], min_size=ms)
+
+    out: Dict[str, np.ndarray] = {}
+    out["eh_off"], out["ehx"] = _stack_point(
+        eh,
+        [e_k1, e_k2]
+        + ([snap.e_caveat, snap.e_ctx] if flags["e_hascav"] else [])
+        + ([snap.e_exp] if flags["e_hasexp"] else []),
+        M,
+    )
+    out["clh_off"], out["clx"] = _stack_point(
+        clh, [cl_k1, cl_k2, cl.c_d_until, cl.c_p_until], M
+    )
+    out["push_off"], out["pusx"] = _stack_point(push, [pus_k], M)
+    out["ovfh_off"], out["ovfx"] = _stack_point(ovfh, [ovf_k], M)
+
+    usr = build_range_hash(us_gk, min_size=ms)
+    arr = build_range_hash(ar_gk, min_size=ms)
+    out["usr_off"], out["usgx"], out["usx"], usr_cap = _stack_range(
+        usr,
+        [snap.us_subj, snap.us_srel]
+        + ([snap.us_caveat, snap.us_ctx] if flags["us_hascav"] else [])
+        + ([snap.us_exp] if flags["us_hasexp"] else [])
+        + ([snap.us_perm] if flags["us_hasperm"] else []),
+        M, max(64, config.us_leaf_cap),
+    )
+    out["arr_off"], out["argx"], out["arx"], arr_cap = _stack_range(
+        arr,
+        [snap.ar_child]
+        + ([snap.ar_caveat, snap.ar_ctx] if flags["ar_hascav"] else [])
+        + ([snap.ar_exp] if flags["ar_hasexp"] else []),
+        M, max(64, config.arrow_fanout),
+    )
+
+    t_kw = dict(has_tindex=False, t_cap=4, t_n=8, t_slots=(), t_all=False)
+    tj = _tindex_join(snap, config, cl, us_gk, cl_k1, cl_k2, pus_k, S1)
+    if tj is not None:
+        T_k1, T_k2, T_d, T_p, t_slots, t_all = tj
+        th = build_hash([T_k1, T_k2], min_size=ms)
+        out["th_off"], out["tx"] = _stack_point(th, [T_k1, T_k2, T_d, T_p], M)
+        t_kw = dict(
+            has_tindex=True,
+            t_cap=_round_cap(th.cap),
+            t_n=_ceil_pow2(max(th.n, 1)),
+            t_slots=t_slots,
+            t_all=t_all,
+        )
+
+    wc_nodes = snap.wildcard_node_of_type[snap.wildcard_node_of_type >= 0]
+    meta = FlatMeta(
+        N=N, S1=S1,
+        e_cap=_round_cap(eh.cap), e_n=_ceil_pow2(max(eh.n, 1)),
+        usr_cap=_round_cap(usr_cap),
+        usr_gn=8,  # legacy-probe geometry: unused (local shapes rule)
+        us_rows=8,
+        arr_cap=_round_cap(arr_cap),
+        arr_gn=8,
+        ar_rows=8,
+        cl_cap=_round_cap(clh.cap), cl_n=_ceil_pow2(max(clh.n, 1)),
+        has_closure=clh.n > 0,
+        pus_cap=_round_cap(push.cap), pus_n=_ceil_pow2(max(push.n, 1)),
+        ovf_cap=_round_cap(ovfh.cap), ovf_n=_ceil_pow2(max(ovfh.n, 1)),
+        has_ovf=ovfh.n > 0,
+        ar_fanout_by_slot=_run_maxes(arr.gk, arr.glo, arr.ghi, N),
+        us_fanout_by_slot=_run_maxes(usr.gk, usr.glo, usr.ghi, N),
+        **t_kw,
+        **flags,
+        blockslice=True,
+        sharded=True,
         e_slots=tuple(int(s) for s in np.unique(snap.e_rel)),
         us_slots=tuple(int(s) for s in np.unique(snap.us_rel)),
         has_wc_edges=bool(np.isin(snap.e_subj, wc_nodes).any()),
@@ -775,17 +1029,36 @@ def make_flat_fn(
     slots: Tuple[int, ...],
     caveat_plan=None,
     jit: bool = True,
+    axis: Optional[str] = None,
+    model_size: int = 1,
 ):
     """Build the batched flat check function for a static set of permission
     slots.  Queries select their slot's result with a vectorized compare —
     evaluating ≤ flat_max_slots programs over the whole batch is far
-    cheaper than any per-query dispatch."""
+    cheaper than any per-query dispatch.
+
+    With ``axis`` (inside shard_map over the model axis, tables built by
+    build_flat_arrays_sharded) every probe masks bucket ownership, boolean
+    site outputs OR-reduce with psum over ICI, and userset/arrow candidate
+    blocks broadcast from their single owning shard — the program is the
+    same straight-line probe pipeline with one collective per site."""
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     from ..caveats.device import make_tri_fn
 
     tri = make_tri_fn(caveat_plan) if caveat_plan is not None else None
+    SH = axis is not None
+    if SH and meta.delta is not None:
+        raise NotImplementedError(
+            "the delta level is single-chip; sharded prepare is full"
+        )
+    if SH != meta.sharded:
+        raise ValueError(
+            "kernel/layout mismatch: bucket-sharded tables need the model"
+            " axis and vice versa (FlatMeta.sharded vs make_flat_fn axis)"
+        )
 
     perm_programs: Dict[int, List[Tuple[str, int, ExprIR]]] = {}
     for (tname, tid, slot, expr) in plan.topo_programs:
@@ -899,23 +1172,55 @@ def make_flat_fn(
             return live & (t == 2), live & (t >= 1)
 
         dm = meta.delta
+        me = lax.axis_index(axis) if SH else None
 
-        def blk_hit(blk, q_cols):
+        def por(x):
+            """Boolean OR-reduce over the model axis (identity 1-chip)."""
+            return (
+                x if not SH
+                else lax.psum(x.astype(jnp.int32), axis).astype(bool)
+            )
+
+        def vbcast(own, x):
+            """Single-owner int32 broadcast over the model axis: exactly
+            one shard contributes (its bucket owns the key), the psum of
+            masked values IS the value (identity 1-chip)."""
+            return x if not SH else lax.psum(jnp.where(own, x, 0), axis)
+
+        def blk_hit(blk, q_cols, mine=None):
             """Exact-key hit mask over a probe block's candidates, with
             ≥0 validity guards on every query column (padded/overshoot
-            rows hold -1 keys or other buckets' keys and never match)."""
+            rows hold -1 keys or other buckets' keys and never match) and
+            the bucket-ownership mask under sharding."""
             h = jnp.ones(blk.shape[:-1], bool)
             g = None
             for j, qc in enumerate(q_cols):
                 h = h & (blk[..., j] == qc[..., None])
                 g = (qc >= 0) if g is None else (g & (qc >= 0))
-            return h & g[..., None]
+            h = h & g[..., None]
+            if mine is not None:
+                h = h & mine[..., None]
+            return h
+
+        def pblock(off, tbl, cap: int, q_cols):
+            """probe_block with bucket-ownership: (blk, mine).  Sharded
+            tables derive bpd from the LOCAL off length (shapes inside
+            shard_map are per-shard)."""
+            if not SH:
+                return probe_block(off, tbl, cap, q_cols), None
+            bpd = off.shape[0] - 1
+            h = (
+                mix32(q_cols, jnp) & jnp.uint32(bpd * model_size - 1)
+            ).astype(jnp.int32)
+            mine = (h // jnp.int32(bpd)) == me
+            start = take_in_bounds(off, h & jnp.int32(bpd - 1))
+            return slice_blocks(tbl, start, cap), mine
 
         def range_probe(off, tbl, cap: int, q):
-            """(lo, hi) row range of group key ``q`` in an interleaved
-            (gk, glo, ghi) group table; (0, 0) on miss."""
-            blk = probe_block(off, tbl, cap, (q,))
-            hit = blk_hit(blk, (q,))
+            """(lo, hi) LOCAL row range of group key ``q``; (0, 0) on a
+            miss or on non-owning shards."""
+            blk, mine = pblock(off, tbl, cap, (q,))
+            hit = blk_hit(blk, (q,), mine)
             lo = jnp.max(jnp.where(hit, blk[..., 1], 0), axis=-1)
             hi = jnp.max(jnp.where(hit, blk[..., 2], 0), axis=-1)
             return lo, hi
@@ -942,17 +1247,13 @@ def make_flat_fn(
                 )
                 return z, z
             if BS:
-                blk = probe_block(
+                blk, mine = pblock(
                     arrs["clh_off"], arrs["clx"], meta.cl_cap, (srck, gk)
                 )
-                hit = (
-                    (blk[..., 0] == srck[..., None])
-                    & (blk[..., 1] == gk[..., None])
-                    & ((srck >= 0) & (gk >= 0))[..., None]
-                )
+                hit = blk_hit(blk, (srck, gk), mine)
                 return (
-                    jnp.any(hit & (blk[..., 2] > now), axis=-1),
-                    jnp.any(hit & (blk[..., 3] > now), axis=-1),
+                    por(jnp.any(hit & (blk[..., 2] > now), axis=-1)),
+                    por(jnp.any(hit & (blk[..., 3] > now), axis=-1)),
                 )
             row = probe_rows(
                 arrs["clh_off"], arrs["clh_rows"],
@@ -1008,13 +1309,14 @@ def make_flat_fn(
                     tombstones carry full primary identities."""
                     hd = hp = jnp.zeros(nodes.shape, bool)
                     if run_e:
-                        blk = probe_block(
+                        blk, mine = pblock(
                             arrs["eh_off"], arrs["ehx"], meta.e_cap,
                             (k1, k2q),
                         )
-                        hit = blk_hit(blk, (k1, k2q)) & exists[..., None]
+                        hit = blk_hit(blk, (k1, k2q), mine) & exists[..., None]
                         bd, bp = gate2_blk("e", blk, eL, hit)
-                        hd, hp = jnp.any(bd, axis=-1), jnp.any(bp, axis=-1)
+                        hd = por(jnp.any(bd, axis=-1))
+                        hp = por(jnp.any(bp, axis=-1))
                         if dm is not None and dm.has_tombs:
                             tb = probe_block(
                                 arrs["dl_tb_off"], arrs["dl_tbx"],
@@ -1060,18 +1362,13 @@ def make_flat_fn(
             if use_t:
                 def t_site(k2q):
                     if BS:
-                        blk = probe_block(
+                        blk, mine = pblock(
                             arrs["th_off"], arrs["tx"], meta.t_cap, (k1, k2q)
                         )
-                        hit = (
-                            (blk[..., 0] == k1[..., None])
-                            & (blk[..., 1] == k2q[..., None])
-                            & exists[..., None]
-                            & (k2q >= 0)[..., None]
-                        )
+                        hit = blk_hit(blk, (k1, k2q), mine) & exists[..., None]
                         return (
-                            jnp.any(hit & (blk[..., 2] > now), axis=-1),
-                            jnp.any(hit & (blk[..., 3] > now), axis=-1),
+                            por(jnp.any(hit & (blk[..., 2] > now), axis=-1)),
+                            por(jnp.any(hit & (blk[..., 3] > now), axis=-1)),
                         )
                     trow = probe_rows(
                         arrs["th_off"], arrs["th_rows"],
@@ -1103,16 +1400,36 @@ def make_flat_fn(
                     # T is incomplete for overflowed closure sources: flag
                     # queries whose (slot, node) has userset rows at all
                     lo2, hi2 = range_of("usr", meta.usr_cap, meta.usr_gn, k1)
-                    used = used | reduceB(exists & (hi2 > lo2))
+                    used = used | por(reduceB(exists & (hi2 > lo2)))
 
-            def ku_eval(ublk, lo, hi, fan, tombstoned: bool):
+            def ku_fetch(prefix: str, cap: int, fan: int):
+                """Range-probe a userset view and fetch its candidate
+                block; under sharding the single owning shard's rows
+                broadcast to every shard (each then tests the candidates
+                against ITS closure/pus buckets)."""
+                lo, hi = (
+                    range_of("usr", cap, meta.usr_gn, k1)
+                    if prefix == "usr"
+                    else range_probe(
+                        arrs["dl_usr_off"], arrs["dl_usgx"], cap, k1
+                    )
+                )
+                over = por(reduceB(exists & ((hi - lo) > fan)))
+                valid = (
+                    jnp.arange(fan, dtype=jnp.int32) < (hi - lo)[..., None]
+                ) & exists[..., None]
+                tbl = arrs["usx" if prefix == "usr" else "dl_usx"]
+                ublk = slice_blocks(tbl, lo, fan)
+                if SH:
+                    ublk = vbcast(valid[..., None], ublk)
+                    valid = por(valid)
+                return ublk, valid, over
+
+            def ku_eval(ublk, valid, tombstoned: bool):
                 """Userset-grant evaluation over one level's candidate
                 block: per-candidate closure/reflexivity/permission tests
                 gated by the row's caveat/expiry columns.  Returns the
                 (d, p, used) contributions (any-reduced over candidates)."""
-                valid = (
-                    jnp.arange(fan, dtype=jnp.int32) < (hi - lo)[..., None]
-                ) & exists[..., None]
                 s = jnp.where(valid, ublk[..., usL["subj"]], -1)
                 r = jnp.where(valid, ublk[..., usL["srel"]], -1)
                 gk = s * S1c + (r + 1)  # invalid rows (-1, -1) → negative
@@ -1139,10 +1456,10 @@ def make_flat_fn(
                         if meta.us_hasperm
                         else jnp.zeros(valid.shape, bool)
                     )
-                    pblk = probe_block(
+                    pblk, pmine = pblock(
                         arrs["push_off"], arrs["pusx"], meta.pus_cap, (gk,)
                     )
-                    in_pus = jnp.any(blk_hit(pblk, (gk,)), axis=-1)
+                    in_pus = por(jnp.any(blk_hit(pblk, (gk,), pmine), axis=-1))
                     in_d = (in_d | refl) & ~permf
                     in_p = in_p | refl | in_pus | permf
                 else:
@@ -1166,10 +1483,10 @@ def make_flat_fn(
             )
             KU_site = min(KU, us_fan_max if dyn else us_fans.get(slot, 0))
             if run_ku and KU_site > 0 and BS:
-                lo, hi = range_of("usr", meta.usr_cap, meta.usr_gn, k1)
-                ovf = ovf | reduceB(exists & ((hi - lo) > KU_site))
+                ublk, valid, over = ku_fetch("usr", meta.usr_cap, KU_site)
+                ovf = ovf | over
                 kd, kp, ku_used = ku_eval(
-                    slice_blocks(arrs["usx"], lo, KU_site), lo, hi, KU_site,
+                    ublk, valid,
                     tombstoned=dm is not None and dm.has_ustomb,
                 )
                 d, p, used = d | kd, p | kp, used | ku_used
@@ -1215,14 +1532,9 @@ def make_flat_fn(
                 and (bool(dm.us_slots) if dyn else (slot in dm.us_slots))
             )
             if run_kud:
-                lo, hi = range_probe(
-                    arrs["dl_usr_off"], arrs["dl_usgx"], dm.us_cap, k1
-                )
-                ovf = ovf | reduceB(exists & ((hi - lo) > dm.us_fan))
-                kd, kp, ku_used = ku_eval(
-                    slice_blocks(arrs["dl_usx"], lo, dm.us_fan),
-                    lo, hi, dm.us_fan, tombstoned=False,
-                )
+                ublk, valid, over = ku_fetch("dl_usr", dm.us_cap, dm.us_fan)
+                ovf = ovf | over
+                kd, kp, ku_used = ku_eval(ublk, valid, tombstoned=False)
                 d, p, used = d | kd, p | kp, used | ku_used
             return d, p, ovf, used
 
@@ -1319,10 +1631,10 @@ def make_flat_fn(
                     # possible and resolve on the host oracle
                     return (
                         jnp.zeros(nodes.shape, bool),
-                        ((hi > lo) | (hid > lod)) & exists,
+                        por((hi > lo) | (hid > lod)) & exists,
                         zB, zB,
                     )
-                ovf = reduceB(exists & ((hi - lo) > Ks))
+                ovf = por(reduceB(exists & ((hi - lo) > Ks)))
                 valid = (
                     jnp.arange(max(Ks, 1), dtype=jnp.int32) < (hi - lo)[..., None]
                 ) & exists[..., None]
@@ -1331,6 +1643,11 @@ def make_flat_fn(
                     gd = gp = jnp.zeros(nodes.shape + (0,), bool)
                 elif BS:
                     ablk = slice_blocks(arrs["arx"], lo, Ks)
+                    if SH:
+                        # the owning shard's rows broadcast; every shard
+                        # then recurses on the SAME children lattice
+                        ablk = vbcast(valid[..., None], ablk)
+                        valid = por(valid)
                     children = jnp.where(valid, ablk[..., arL["child"]], -1)
                     gd, gp = gate2_blk("ar", ablk, arL, valid)
                     if dm is not None and dm.has_artomb:
@@ -1399,13 +1716,10 @@ def make_flat_fn(
         else:
             def ovf_probe(k):
                 if BS:
-                    oblk = probe_block(
+                    oblk, omine = pblock(
                         arrs["ovfh_off"], arrs["ovfx"], meta.ovf_cap, (k,)
                     )
-                    return jnp.any(
-                        (oblk[..., 0] == k[..., None]) & (k >= 0)[..., None],
-                        axis=-1,
-                    )
+                    return por(jnp.any(blk_hit(oblk, (k,), omine), axis=-1))
                 return probe_rows(
                     arrs["ovfh_off"], arrs["ovfh_rows"],
                     (arrs["ovf_k"],), (k,), meta.ovf_cap, meta.ovf_n,

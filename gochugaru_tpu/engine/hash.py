@@ -135,7 +135,7 @@ def build_range_hash(k: np.ndarray, **kw) -> RangeIndex:
     n = int(k.shape[0])
     if n == 0:
         z = np.zeros(0, np.int32)
-        return RangeIndex(gk=z, glo=z, ghi=z, index=build_hash([]))
+        return RangeIndex(gk=z, glo=z, ghi=z, index=build_hash([], **kw))
     first = np.ones(n, bool)
     first[1:] = k[1:] != k[:-1]
     starts = np.nonzero(first)[0]

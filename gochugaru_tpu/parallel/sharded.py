@@ -91,6 +91,8 @@ class ShardedEngine(DeviceEngine):
                 check_vma=False,
             )
         )
+        #: shard_mapped flat kernels per (slots, FlatMeta, array keys)
+        self._flat_sharded_fns: Dict = {}
 
     def _array_keys(self):
         # single source of truth for the column set lives in DeviceEngine
@@ -101,8 +103,96 @@ class ShardedEngine(DeviceEngine):
             keys += ["ectx_vi", "ectx_vf", "ectx_pr", "ectx_host"]
         return keys
 
+    # -- flat (bucket-sharded) path ---------------------------------------
+    @staticmethod
+    def _flat_spec_of(key: str):
+        """Sharded flat tables split on the leading (stacked) axis; node
+        types and stored-context tables are replicated."""
+        if key == "node_type" or key.startswith("ectx_"):
+            return P()
+        return P(MODEL_AXIS)
+
+    def _flat_sharded_fn(self, slots: Tuple[int, ...], meta, arr_keys):
+        """Cache of shard_mapped flat kernels per (slots, meta, keys)."""
+        key = (slots, meta, arr_keys)
+        fn = self._flat_sharded_fns.get(key)
+        if fn is not None:
+            return fn
+        from ..engine.flat import make_flat_fn
+
+        raw = make_flat_fn(
+            self.compiled, self.plan, self.config, meta, slots,
+            caveat_plan=self.caveat_plan, jit=False,
+            axis=MODEL_AXIS, model_size=self.model_size,
+        )
+        arr_spec = {k: self._flat_spec_of(k) for k in arr_keys}
+        qctx_spec = {k: P() for k in ("vi", "vf", "pr", "host")}
+        in_specs = (
+            arr_spec, P(), P(),  # arrays, tid_map, now
+            P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+            P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+            qctx_spec,
+        )
+        fn = jax.jit(
+            shard_map(
+                raw, mesh=self.mesh, in_specs=in_specs,
+                out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+                check_vma=False,
+            )
+        )
+        while len(self._flat_sharded_fns) >= self.FLAT_FN_CACHE_MAX:
+            self._flat_sharded_fns.pop(next(iter(self._flat_sharded_fns)))
+        self._flat_sharded_fns[key] = fn
+        return fn
+
     # -- snapshot preparation: pad every view to a multiple of model_size --
-    def prepare(self, snap: Snapshot) -> DeviceSnapshot:
+    def prepare(
+        self, snap: Snapshot, prev: Optional[DeviceSnapshot] = None
+    ) -> DeviceSnapshot:
+        """``prev`` is accepted for DeviceEngine signature compatibility
+        (Client._dsnap_for passes it); the sharded engine has no delta
+        level yet, so every revision re-materializes and re-ships — the
+        honest multi-host status bench5_watch documents."""
+        if (
+            self.config.use_flat
+            and self.config.flat_blockslice
+            and self.model_size & (self.model_size - 1) == 0
+        ):
+            from ..engine.flat import build_flat_arrays_sharded
+
+            built = build_flat_arrays_sharded(
+                snap, self.config, self.model_size
+            )
+            if built is not None:
+                flat_arrays, flat_meta = built
+                host = dict(flat_arrays)
+                host["node_type"] = _pad_payload(
+                    snap.node_type, _ceil_pow2(2 * snap.num_nodes), -1
+                )
+                ectx, strings = self._ectx_tables(snap)
+                host.update(ectx)
+                arrays = {
+                    k: jax.device_put(
+                        v, NamedSharding(self.mesh, self._flat_spec_of(k))
+                    )
+                    for k, v in host.items()
+                }
+                tid_map = np.full(
+                    max(self.plan.num_schema_types, 1), -1, dtype=np.int32
+                )
+                for tname, tid in self.compiled.type_ids.items():
+                    tid_map[tid] = snap.interner.type_lookup(tname)
+                return DeviceSnapshot(
+                    revision=snap.revision,
+                    arrays=arrays,
+                    tid_map=jnp.asarray(tid_map),
+                    snapshot=snap,
+                    strings=strings,
+                    flat_meta=flat_meta,
+                )
+        return self._prepare_legacy(snap)
+
+    def _prepare_legacy(self, snap: Snapshot) -> DeviceSnapshot:
         host = self._host_arrays(snap)
         # Model-sharded columns must split evenly across model_size (power
         # of two); the base padding is already pow2, so only meshes wider
@@ -140,6 +230,78 @@ class ShardedEngine(DeviceEngine):
         )
 
     # -- batched check: queries partitioned per data-shard ----------------
+    def _dispatch_flat(
+        self,
+        dsnap: DeviceSnapshot,
+        queries: Dict[str, np.ndarray],
+        qctx: Dict[str, np.ndarray],
+        now_us: Optional[int],
+        fetch: bool = True,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Dispatch over the bucket-sharded flat tables: queries partition
+        along the data axis; the kernel's probe sites OR-reduce over the
+        model axis internally (engine/flat.py make_flat_fn with axis)."""
+        snap = dsnap.snapshot
+        D = self.data_size
+        B = queries["q_res"].shape[0]
+        per = _ceil_pow2(-(-B // D), self.config.batch_bucket_min)
+        BP = per * D
+
+        def padq(a, fill):
+            a = np.asarray(a)
+            out = np.full(BP, fill, a.dtype)
+            out[:B] = a
+            return out
+
+        q_srel1 = np.where(
+            queries["q_srel"] >= 0, queries["q_srel"] + 1, 0
+        ).astype(np.int32)
+        all_slots = sorted(
+            {int(s) for s in np.unique(queries["q_perm"]) if s >= 0}
+        )
+        now = jnp.int32(snap.now_rel32(now_us))
+        dsh = NamedSharding(self.mesh, P(DATA_AXIS))
+        rep = NamedSharding(self.mesh, P())
+
+        def put(a):
+            return jax.device_put(a, dsh)
+
+        args_fixed = (
+            put(padq(queries["q_res"], -1)),
+            put(padq(queries["q_subj"], -1)), put(padq(q_srel1, 0)),
+            put(padq(queries["q_wc"], -1)), put(padq(queries["q_ctx"], -1)),
+            put(padq(queries["q_self"], False)),
+            {k: jax.device_put(v, rep) for k, v in qctx.items()},
+        )
+        arr_keys = tuple(sorted(dsnap.arrays.keys()))
+        # batches with more distinct permissions than flat_max_slots are
+        # evaluated in slot chunks (each query's slot lives in exactly one
+        # chunk; masked-out queries read -1 → all-false) — the compile
+        # cost stays bounded instead of unrolling one program per slot
+        cap = max(self.config.flat_max_slots, 1)
+        q_perm = queries["q_perm"]
+        d = p = ovf = None
+        for at in range(0, max(len(all_slots), 1), cap):
+            chunk = tuple(all_slots[at : at + cap])
+            if len(all_slots) > cap:
+                perm_col = np.where(
+                    np.isin(q_perm, np.asarray(chunk, np.int32)), q_perm, -1
+                )
+            else:
+                perm_col = q_perm
+            fn = self._flat_sharded_fn(chunk, dsnap.flat_meta, arr_keys)
+            cd, cp, covf = fn(
+                dsnap.arrays, dsnap.tid_map, now,
+                args_fixed[0], put(padq(perm_col, -1)), *args_fixed[1:],
+            )
+            d = cd if d is None else d | cd
+            p = cp if p is None else p | cp
+            ovf = covf if ovf is None else ovf | covf
+        if not fetch:
+            return d, p, ovf
+        d, p, ovf = jax.device_get((d, p, ovf))
+        return d[:B], p[:B], ovf[:B]
+
     def _dispatch_columns(
         self,
         dsnap: DeviceSnapshot,
@@ -155,6 +317,8 @@ class ShardedEngine(DeviceEngine):
         here per shard.  With ``fetch=False`` the raw padded sharded
         device outputs (length BP ≥ B) are returned for pipelined
         dispatch, mirroring DeviceEngine.check_columns."""
+        if dsnap.flat_meta is not None:
+            return self._dispatch_flat(dsnap, queries, qctx, now_us, fetch)
         snap = dsnap.snapshot
         D = self.data_size
         B = queries["q_res"].shape[0]

@@ -172,3 +172,45 @@ def test_sharded_check_columns_reflexive_self():
         now_us=1_700_000_000_000_000,
     )
     assert bool(np.asarray(d)[0])
+
+
+def test_sharded_flat_slot_chunking():
+    """More distinct permissions in one batch than flat_max_slots: the
+    sharded flat dispatch must chunk the slot set (bounded compiles) and
+    still answer every query exactly."""
+    cs, snap, oracle, queries = build_world()
+    mesh = make_mesh(2, 4)
+    from gochugaru_tpu.engine.plan import EngineConfig
+
+    eng = ShardedEngine(cs, mesh, EngineConfig.for_schema(cs, flat_max_slots=1))
+    dsnap = eng.prepare(snap)
+    assert dsnap.flat_meta is not None and dsnap.flat_meta.sharded
+    # queries mix 'read'/'admin' (2 slots) + relation slots via tuples
+    mixed = queries[:48] + [
+        rel.must_from_tuple("repo:r1#reader", "user:u1"),
+        rel.must_from_tuple("team:t0#member", "user:u0"),
+    ]
+    d, p, ovf = eng.check_batch(dsnap, mixed, now_us=1_700_000_000_000_000)
+    single = DeviceEngine(cs)
+    sd, sp, sovf = single.check_batch(
+        single.prepare(snap), mixed, now_us=1_700_000_000_000_000
+    )
+    np.testing.assert_array_equal(d, sd)
+    np.testing.assert_array_equal(p, sp)
+    np.testing.assert_array_equal(ovf, sovf)
+
+
+def test_sharded_meta_kernel_mismatch_raises():
+    """A bucket-sharded FlatMeta must not silently build a single-chip
+    kernel (and vice versa) — the geometry is incompatible."""
+    cs, snap, oracle, queries = build_world()
+    mesh = make_mesh(2, 4)
+    eng = ShardedEngine(cs, mesh)
+    dsnap = eng.prepare(snap)
+    from gochugaru_tpu.engine.flat import make_flat_fn
+
+    with pytest.raises(ValueError):
+        make_flat_fn(
+            eng.compiled, eng.plan, eng.config, dsnap.flat_meta, (),
+            caveat_plan=eng.caveat_plan,
+        )
