@@ -951,6 +951,7 @@ class DeviceEngine:
         now,
         B: int,
         jit: bool = True,
+        bucket_min: int = 0,
     ):
         """The flat kernel + its lowered padded argument tuple — the ONE
         place that knows the kernel's signature (check paths, bench.py and
@@ -973,7 +974,7 @@ class DeviceEngine:
                 self.compiled, self.plan, self.config, dsnap.flat_meta,
                 slots, caveat_plan=self.caveat_plan, jit=False,
             )
-        BP = _ceil_pow2(B, self.config.batch_bucket_min)
+        BP = _ceil_pow2(B, max(bucket_min, self.config.batch_bucket_min))
 
         def padq(a, fill):
             a = np.asarray(a)
@@ -1001,10 +1002,13 @@ class DeviceEngine:
         qctx: Dict[str, np.ndarray],
         now,
         B: int,
+        bucket_min: int = 0,
     ):
         """Dispatch the flat kernel; returns padded device (d, p, ovf), or
         None when the flat path is unavailable."""
-        got = self.flat_fn_and_args(dsnap, queries, qctx, now, B)
+        got = self.flat_fn_and_args(
+            dsnap, queries, qctx, now, B, bucket_min=bucket_min
+        )
         if got is None:
             return None
         fn, args = got
@@ -1121,11 +1125,15 @@ class DeviceEngine:
         qctx_rows: Optional[Sequence[Mapping[str, Any]]] = None,
         now_us: Optional[int] = None,
         fetch: bool = True,
+        bucket_min: int = 0,
     ):
         """Bulk check straight from pre-interned int32 columns — the fast
         path for 100k+-item batches, where per-item Relationship objects
         would dominate (the analogue of the reference's chunked iterator
-        APIs, client/client.go:164-180).
+        APIs, client/client.go:164-180).  ``bucket_min`` raises the pow2
+        padding floor — callers with highly variable batch sizes (device
+        lookups) use a coarse floor so warm calls share one compiled
+        program instead of retracing per fresh bucket.
 
         With ``fetch`` (default) returns (definite, possible, overflow)
         numpy arrays trimmed to the batch length, fetched in ONE
@@ -1137,12 +1145,14 @@ class DeviceEngine:
         """
         snap = dsnap.snapshot
         B = q_res.shape[0]
-        BP = _ceil_pow2(B, self.config.batch_bucket_min)
+        BP = _ceil_pow2(B, max(bucket_min, self.config.batch_bucket_min))
         queries, qctx = self._columns_preamble(
             dsnap, q_res, q_perm, q_subj, q_srel, q_wc, q_ctx, qctx_rows
         )
         now_flat = jnp.int32(snap.now_rel32(now_us))
-        out = self._flat_call(dsnap, queries, qctx, now_flat, B)
+        out = self._flat_call(
+            dsnap, queries, qctx, now_flat, B, bucket_min=bucket_min
+        )
         if out is not None:
             if not fetch:
                 return out

@@ -47,6 +47,9 @@ from ..native.sort import argsort1, lexsort2
 from ..rel.relationship import WILDCARD_ID
 from ..store.snapshot import Snapshot
 
+#: padding floor for the lookup exact-filter batch (see _exact_filter)
+LOOKUP_BUCKET_MIN = 4096
+
 _B32 = np.int64(2**32)
 
 
@@ -169,8 +172,12 @@ def _exact_filter(
     bool collapse, client/client.go:277).  Resolving p&~d on the host
     matters for permission-valued userset subjects, where the device can
     only ever report "possible" but the host answer is definite."""
+    # coarse bucket floor: per-subject candidate counts vary, and every
+    # fresh pow2 bucket costs a kernel retrace — with a 4096 floor, warm
+    # lookups share one compiled program
     d, p, ovf = engine.check_columns(
-        dsnap, q_res, q_perm, q_subj, q_srel=q_srel, q_wc=q_wc, now_us=now_us
+        dsnap, q_res, q_perm, q_subj, q_srel=q_srel, q_wc=q_wc,
+        now_us=now_us, bucket_min=LOOKUP_BUCKET_MIN,
     )
     needs_host = ovf | (p & ~d)
     granted = list(cand[d & ~needs_host])

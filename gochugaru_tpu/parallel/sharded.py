@@ -237,6 +237,7 @@ class ShardedEngine(DeviceEngine):
         qctx: Dict[str, np.ndarray],
         now_us: Optional[int],
         fetch: bool = True,
+        bucket_min: int = 0,
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Dispatch over the bucket-sharded flat tables: queries partition
         along the data axis; the kernel's probe sites OR-reduce over the
@@ -244,7 +245,9 @@ class ShardedEngine(DeviceEngine):
         snap = dsnap.snapshot
         D = self.data_size
         B = queries["q_res"].shape[0]
-        per = _ceil_pow2(-(-B // D), self.config.batch_bucket_min)
+        per = _ceil_pow2(
+            -(-B // D), max(bucket_min, self.config.batch_bucket_min)
+        )
         BP = per * D
 
         def padq(a, fill):
@@ -309,6 +312,7 @@ class ShardedEngine(DeviceEngine):
         qctx: Dict[str, np.ndarray],
         now_us: Optional[int],
         fetch: bool = True,
+        bucket_min: int = 0,
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Partition query columns across the data axis, compute per-shard
         unique (subject, context) closure rows, and dispatch the
@@ -318,7 +322,9 @@ class ShardedEngine(DeviceEngine):
         device outputs (length BP ≥ B) are returned for pipelined
         dispatch, mirroring DeviceEngine.check_columns."""
         if dsnap.flat_meta is not None:
-            return self._dispatch_flat(dsnap, queries, qctx, now_us, fetch)
+            return self._dispatch_flat(
+                dsnap, queries, qctx, now_us, fetch, bucket_min=bucket_min
+            )
         snap = dsnap.snapshot
         D = self.data_size
         B = queries["q_res"].shape[0]
@@ -403,11 +409,15 @@ class ShardedEngine(DeviceEngine):
         qctx_rows=None,
         now_us: Optional[int] = None,
         fetch: bool = True,
+        bucket_min: int = 0,
     ):
         """Columnar bulk check with the sharded layout (the base-class fast
         path assumes an unsharded q_row/uniq table, which would be wrong
-        under shard_map — see _dispatch_columns)."""
+        under shard_map — see _dispatch_columns).  ``bucket_min`` raises
+        the per-data-shard padding floor, matching DeviceEngine."""
         queries, qctx = self._columns_preamble(
             dsnap, q_res, q_perm, q_subj, q_srel, q_wc, q_ctx, qctx_rows
         )
-        return self._dispatch_columns(dsnap, queries, qctx, now_us, fetch=fetch)
+        return self._dispatch_columns(
+            dsnap, queries, qctx, now_us, fetch=fetch, bucket_min=bucket_min
+        )

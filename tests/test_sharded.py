@@ -214,3 +214,35 @@ def test_sharded_meta_kernel_mismatch_raises():
             eng.compiled, eng.plan, eng.config, dsnap.flat_meta, (),
             caveat_plan=eng.caveat_plan,
         )
+
+
+def test_sharded_flat_features_world():
+    """Caveats, expirations, wildcards, nested groups, and folder
+    recursion under the bucket-sharded flat kernel: every plane must
+    match the single-chip flat engine exactly (the CEL VM runs on
+    replicated context tables; gates ride the sharded blocks)."""
+    import test_flat_engine as tfe
+
+    rng = random.Random(4)
+    rels = tfe.build_feature_world(rng)
+    cs = compile_schema(parse_schema(tfe.FEATURES))
+    interner = Interner()
+    snap = build_snapshot(1, cs, interner, rels, epoch_us=tfe.NOW)
+    checks = tfe.make_checks(rng, 10, 10, n=64)
+    from gochugaru_tpu.engine.plan import EngineConfig
+
+    cfg = EngineConfig.for_schema(cs, flat_recursion=3, flat_max_width=32)
+    single = DeviceEngine(cs, cfg)
+    sd, sp, sovf = single.check_batch(
+        single.prepare(snap), checks, now_us=tfe.NOW
+    )
+    for shape in [(4, 2), (1, 8)]:
+        mesh = make_mesh(*shape)
+        eng = ShardedEngine(cs, mesh, cfg)
+        dsnap = eng.prepare(snap)
+        assert dsnap.flat_meta is not None and dsnap.flat_meta.sharded
+        d, p, ovf = eng.check_batch(dsnap, checks, now_us=tfe.NOW)
+        for i, q in enumerate(checks):
+            assert bool(d[i]) == bool(sd[i]), f"{shape} definite differs: {q}"
+            assert bool(p[i]) == bool(sp[i]), f"{shape} possible differs: {q}"
+            assert bool(ovf[i]) == bool(sovf[i]), f"{shape} ovf differs: {q}"
