@@ -178,6 +178,13 @@ class FlatMeta:
     #: tables are bucket-sharded / stacked for shard_map (the kernel must
     #: be built with the matching ``axis``; make_flat_fn enforces this)
     sharded: bool = False
+    #: longest arrow chain in the DATA (longest path over the ar view),
+    #: or -1 when the arrow graph has a cycle / exceeded the probe cap.
+    #: Bounds recursion unrolling: beyond this many arrow hops there are
+    #: no real children, so deeper unrolls are provably dead — a schema-
+    #: recursive folder tree of depth 4 compiles 4 levels, not the full
+    #: flat_recursion budget.  Pow2-bucketed for delta stability
+    ar_data_depth: int = -1
 
 
 def _gate_cols(hascav: bool, hasexp: bool) -> list:
@@ -262,6 +269,38 @@ def _view_flags_of(snap) -> Dict[str, bool]:
         ar_hascav=bool(snap.ar_caveat.any()),
         ar_hasexp=bool(snap.ar_exp.any()),
     )
+
+
+def _arrow_data_depth(snap, cap: int = 64) -> int:
+    """Longest path, in arrow hops, over the DATA's res→child arrow edges
+    (all tupleset relations together); -1 on a data cycle or past ``cap``.
+    Bellman-style relaxation over the res-grouped view: converges in
+    (true depth) rounds on a DAG — folder trees are ~log-depth, so this
+    is a handful of O(AR) numpy passes at prepare time."""
+    AR = int(snap.ar_rel.shape[0])
+    if AR == 0:
+        return 0
+    res = snap.ar_res.astype(np.int64)
+    child = np.ascontiguousarray(snap.ar_child, np.int64)
+    order = np.argsort(res, kind="stable")
+    res_s, child_s = res[order], child[order]
+    first = np.ones(AR, bool)
+    first[1:] = res_s[1:] != res_s[:-1]
+    starts = np.nonzero(first)[0]
+    uniq_res = res_s[starts]
+    childc = np.clip(child_s, 0, max(snap.num_nodes - 1, 0))
+    cvalid = child_s >= 0
+    depth = np.zeros(snap.num_nodes, np.int32)
+    for _ in range(cap):
+        vals = np.where(cvalid, depth[childc] + 1, 0)
+        upd = np.maximum.reduceat(vals, starts)
+        if (upd <= depth[uniq_res]).all():
+            # pow2-bucketed (rounding UP keeps the cut sound): FlatMeta is
+            # the kernel-cache key, so a tree deepening 4→5 must not
+            # recompile on every prepare
+            return _ceil_pow2(int(depth.max()), 1)
+        depth[uniq_res] = np.maximum(depth[uniq_res], upd)
+    return -1
 
 
 def _run_maxes(gk: np.ndarray, glo: np.ndarray, ghi: np.ndarray, N: int):
@@ -506,6 +545,7 @@ def build_flat_arrays(
         ar_hascav=ar_hascav,
         ar_hasexp=ar_hasexp,
         blockslice=BS,
+        ar_data_depth=_arrow_data_depth(snap),
         e_slots=tuple(int(s) for s in np.unique(snap.e_rel)),
         us_slots=tuple(int(s) for s in np.unique(snap.us_rel)),
         has_wc_edges=bool(np.isin(snap.e_subj, wc_nodes).any()),
@@ -720,6 +760,7 @@ def build_flat_arrays_sharded(
         **flags,
         blockslice=True,
         sharded=True,
+        ar_data_depth=_arrow_data_depth(snap),
         e_slots=tuple(int(s) for s in np.unique(snap.e_rel)),
         us_slots=tuple(int(s) for s in np.unique(snap.us_rel)),
         has_wc_edges=bool(np.isin(snap.e_subj, wc_nodes).any()),
@@ -1540,8 +1581,15 @@ def make_flat_fn(
 
         memo: Dict = {}
         pins: List = []  # keep node arrays alive so id() keys stay unique
+        # arrow-recursion cut: beyond the DATA's longest arrow chain there
+        # are provably no children, so deeper unrolls are dead code — but
+        # a delta level with arrow adds may deepen chains, so it reverts
+        # to the schema recursion budget
+        ar_bound = meta.ar_data_depth
+        if dm is not None and dm.has_ar:
+            ar_bound = -1
 
-        def eval_progs(slot: int, nodes, stack: Tuple, types) -> Tuple:
+        def eval_progs(slot: int, nodes, stack: Tuple, types, ar_hops: int) -> Tuple:
             """The permission programs of ``slot`` at ``nodes`` (no leaf)."""
             zn = jnp.zeros(nodes.shape, bool)
             d, p, ovf, used = zn, zn, zB, zB
@@ -1566,18 +1614,22 @@ def make_flat_fn(
                     p = p | (mask & (nodes >= 0))
                     continue
                 ed, ep, eo, eu = eval_expr(
-                    expr, nodes, stack + ((tname, slot),), frozenset((tname,))
+                    expr, nodes, stack + ((tname, slot),),
+                    frozenset((tname,)), ar_hops,
                 )
                 d = d | (mask & ed)
                 p = p | (mask & ep)
                 ovf, used = ovf | eo, used | eu
             return d, p, ovf, used
 
-        def eval_slot(slot: int, nodes, stack: Tuple, types) -> Tuple:
+        def eval_slot(slot: int, nodes, stack: Tuple, types, ar_hops: int) -> Tuple:
             cyc_sig = tuple(
                 sorted((pr, stack.count(pr)) for pr in set(stack) if pr in cyclic)
             )
-            key = (slot, id(nodes), types, cyc_sig)
+            key = (
+                slot, id(nodes), types, cyc_sig,
+                ar_hops if ar_bound >= 0 else 0,
+            )
             got = memo.get(key)
             if got is not None:
                 return got
@@ -1585,21 +1637,25 @@ def make_flat_fn(
             d, p, ovf, used = zn, zn, zB, zB
             if slot in rel_slots:
                 d, p, ovf, used = leaf(slot, nodes)
-            pd, pp, po, pu = eval_progs(slot, nodes, stack, types)
+            pd, pp, po, pu = eval_progs(slot, nodes, stack, types, ar_hops)
             d, p = d | pd, p | pp
             ovf, used = ovf | po, used | pu
             pins.append(nodes)
             memo[key] = (d, p, ovf, used)
             return memo[key]
 
-        def eval_expr(ir: ExprIR, nodes, stack: Tuple, types) -> Tuple:
+        def eval_expr(ir: ExprIR, nodes, stack: Tuple, types, ar_hops: int) -> Tuple:
             tag = ir[0]
             if tag == "ref":
-                return eval_slot(ir[1], nodes, stack, types)
+                return eval_slot(ir[1], nodes, stack, types, ar_hops)
             if tag == "nil":
                 z = jnp.zeros(nodes.shape, bool)
                 return z, z, zB, zB
             if tag == "arrow":
+                if 0 <= ar_bound <= ar_hops:
+                    # deeper than any real chain in the data: no children
+                    z = jnp.zeros(nodes.shape, bool)
+                    return z, z, zB, zB
                 ts_slot = plan.ts_slots[ir[1]]
                 child_types = arrow_child_types(ts_slot, types)
                 data_fan = dict(meta.ar_fanout_by_slot).get(ts_slot, 0)
@@ -1679,7 +1735,9 @@ def make_flat_fn(
                     children = jnp.concatenate([children, dchildren], axis=-1)
                     gd = jnp.concatenate([gd, dgd], axis=-1)
                     gp = jnp.concatenate([gp, dgp], axis=-1)
-                cd, cp, co, cu = eval_slot(ir[2], children, stack, child_types)
+                cd, cp, co, cu = eval_slot(
+                    ir[2], children, stack, child_types, ar_hops + 1
+                )
                 return (
                     jnp.any(cd & gd, axis=-1),
                     jnp.any(cp & gp, axis=-1),
@@ -1690,7 +1748,7 @@ def make_flat_fn(
                 z = jnp.zeros(nodes.shape, bool)
                 d, p, ovf, used = z, z, zB, zB
                 for c in ir[1]:
-                    cd, cp, co, cu = eval_expr(c, nodes, stack, types)
+                    cd, cp, co, cu = eval_expr(c, nodes, stack, types, ar_hops)
                     d, p = d | cd, p | cp
                     ovf, used = ovf | co, used | cu
                 return d, p, ovf, used
@@ -1698,13 +1756,13 @@ def make_flat_fn(
                 o = jnp.ones(nodes.shape, bool)
                 d, p, ovf, used = o, o, zB, zB
                 for c in ir[1]:
-                    cd, cp, co, cu = eval_expr(c, nodes, stack, types)
+                    cd, cp, co, cu = eval_expr(c, nodes, stack, types, ar_hops)
                     d, p = d & cd, p & cp
                     ovf, used = ovf | co, used | cu
                 return d, p, ovf, used
             if tag == "excl":
-                bd, bp, bo, bu = eval_expr(ir[1], nodes, stack, types)
-                sd, sp, so, su = eval_expr(ir[2], nodes, stack, types)
+                bd, bp, bo, bu = eval_expr(ir[1], nodes, stack, types, ar_hops)
+                sd, sp, so, su = eval_expr(ir[2], nodes, stack, types, ar_hops)
                 return bd & ~sp, bp & ~sd, bo | so, bu | su
             raise TypeError(f"bad expression IR {ir!r}")
 
@@ -1738,7 +1796,7 @@ def make_flat_fn(
         for slot in slots:
             if not perm_programs.get(slot):
                 continue
-            sd, sp, so, su = eval_progs(int(slot), q_res, (), all_types)
+            sd, sp, so, su = eval_progs(int(slot), q_res, (), all_types, 0)
             sel = q_perm == slot
             d_out = d_out | (sel & sd)
             p_out = p_out | (sel & sp)
