@@ -89,3 +89,40 @@ def test_profiling_option_writes_trace_and_metric(tmp_path):
     assert found, "profiler trace directory is empty"
     after = metrics.default.snapshot().get("checks.device_time_s.count", 0)
     assert after > before
+
+
+def test_client_takes_incremental_device_path():
+    """Consecutive write→check revisions through the public Client must
+    advance the device snapshot incrementally (base tables reused, delta
+    overlay only) — the Watch-driven re-index path, BASELINE config 5."""
+    c, ctx, rev = seeded_client()
+    full = consistency.full()
+    assert c.check_one(ctx, full, rel.must_from_triple("doc:d", "view", "user:u"))
+    incremental = 0
+    for i in range(4):
+        txn = rel.Txn()
+        txn.touch(rel.must_from_triple("doc:d", "reader", f"user:w{i}"))
+        c.write(ctx, txn)
+        assert c.check_one(
+            ctx, full, rel.must_from_triple("doc:d", "view", f"user:w{i}")
+        )
+        snap = c._store.snapshot_for(full)
+        ds = c._dsnap_cache.get(snap.revision)
+        if (
+            ds is not None
+            and ds.flat_meta is not None
+            and ds.flat_meta.delta is not None
+        ):
+            incremental += 1
+    assert incremental >= 3, f"incremental prepares: {incremental}/4"
+    # deletes ride the same path (tombstone overlay)
+    txn = rel.Txn()
+    txn.delete(rel.must_from_triple("doc:d", "reader", "user:w0"))
+    c.write(ctx, txn)
+    assert not c.check_one(
+        ctx, full, rel.must_from_triple("doc:d", "view", "user:w0")
+    )
+    snap = c._store.snapshot_for(full)
+    ds = c._dsnap_cache.get(snap.revision)
+    assert ds is not None and ds.flat_meta.delta is not None
+    assert ds.flat_meta.delta.has_tombs
