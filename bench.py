@@ -136,8 +136,12 @@ def _flat_args(engine, dsnap, snap, q_res, q_perm, q_subj):
     return got
 
 
-def measure_batch(engine, dsnap, snap, users, repos, slot, B, note):
-    """Compile + measure one batch size; returns the result dict."""
+def measure_batch(engine, dsnap, snap, users, repos, slot, B, note,
+                  true_rate=False):
+    """Compile + measure one batch size; returns the result dict.  With
+    ``true_rate``, also measure the repeat-harness rate (N evaluations
+    inside ONE dispatch, t(2K)-t(K) — the tunnel-amortized number the
+    round-2 verdict measured by hand)."""
     import numpy as np
     import jax
 
@@ -196,7 +200,7 @@ def measure_batch(engine, dsnap, snap, users, repos, slot, B, note):
     lat = np.maximum(timed(fn, reps) - overhead, 0.0) * 1000.0
     p99_ms = float(np.percentile(lat, 99))
 
-    return {
+    out = {
         "metric": "rbac_2hop_bulk_check_throughput",
         "value": round(best_rate, 1),
         "unit": "checks/sec/chip",
@@ -208,6 +212,25 @@ def measure_batch(engine, dsnap, snap, users, repos, slot, B, note):
         "platform": jax.default_backend(),
         **({"note": note} if note else {}),
     }
+    if true_rate:
+        return out, (q_perm, args)
+    return out, None
+
+
+def measure_true_rate(engine, dsnap, B, q_perm, args):
+    """Repeat-harness true rate (N evaluations inside ONE dispatch,
+    t(2K)-t(K)) — the tunnel-amortized number the round-2 verdict
+    measured by hand.  Runs AFTER the batch's headline line is already on
+    stdout, so a hang here can only cost this extra figure."""
+    import numpy as np
+
+    from benchmarks.common import measured_rate_flat
+
+    # same slot derivation as DeviceEngine.flat_fn_and_args: the harness
+    # must compile the very program being benchmarked
+    slots = tuple(sorted({int(s) for s in np.unique(q_perm) if s >= 0}))
+    stage(f"measuring repeat-harness true rate B={B}")
+    return round(measured_rate_flat(engine, dsnap, slots, B, args, iters=8), 1)
 
 
 def run_bench(batches, world_kw, budget_s, note=None):
@@ -231,9 +254,24 @@ def run_bench(batches, world_kw, budget_s, note=None):
         if i > 0 and elapsed > budget_s * 0.55:
             stage(f"budget {elapsed:.0f}s/{budget_s}s spent; skipping B≥{B}")
             break
-        result = measure_batch(engine, dsnap, snap, users, repos, slot, B, note)
+        result, tr_inputs = measure_batch(
+            engine, dsnap, snap, users, repos, slot, B, note,
+            # the repeat harness costs two extra compiles: measure it at
+            # the first (smallest, cheapest-to-compile) batch size only
+            true_rate=(i == 0),
+        )
         print(json.dumps(result), flush=True)  # a line per batch: timeouts
         # keep the best completed measurement on stdout
+        if tr_inputs is not None:
+            # AFTER the headline line is out: a hang here costs only the
+            # extra figure, never the batch's salvageable result
+            try:
+                result["true_rate"] = measure_true_rate(
+                    engine, dsnap, B, *tr_inputs
+                )
+                print(json.dumps(result), flush=True)
+            except Exception as e:
+                stage(f"true-rate measurement failed: {type(e).__name__}: {e}")
 
 
 def child_main(mode: str, note: str | None) -> None:
@@ -257,8 +295,11 @@ def child_main(mode: str, note: str | None) -> None:
 
 
 def _parse_best(stdout: str):
-    """Best (highest-throughput) JSON result line in a child's stdout."""
+    """Best (highest-throughput) JSON result line in a child's stdout;
+    the repeat-harness true rate (measured once, at the smallest batch)
+    is carried onto the winner."""
     best = None
+    true_rate = None
     for line in (stdout or "").splitlines():
         line = line.strip()
         if not line.startswith("{"):
@@ -268,8 +309,12 @@ def _parse_best(stdout: str):
         except json.JSONDecodeError:
             continue
         if "metric" in parsed and "value" in parsed:
+            if "true_rate" in parsed:
+                true_rate = max(true_rate or 0.0, parsed["true_rate"])
             if best is None or parsed["value"] > best["value"]:
                 best = parsed
+    if best is not None and true_rate is not None:
+        best["true_rate"] = true_rate
     return best
 
 
