@@ -13,12 +13,13 @@ Metrics: delta re-index latency (host materialize + device overlay) and
 sustained updates/sec, at a base graph scaled by ``--edges`` (the full
 config is 1B edges on v5e-16; one chip holds the 100M-class slice).
 
-Multi-host status, honestly: ShardedEngine.prepare re-ships the full
-padded edge columns on every revision (parallel/sharded.py) — per-shard
-delta overlays are single-chip only so far, so the multi-host cost per
-revision is a full re-materialize + re-ship, measured here on one chip.
-The remaining O(E) cost per revision is the HOST-side column merge in
-apply_delta; the device cost is O(delta)."""
+Multi-host status: ShardedEngine.prepare(prev=...) also advances
+incrementally — bucket-sharded base tables stay resident per shard and
+the delta-sized overlay ships replicated
+(parallel/sharded.py _prepare_delta_sharded, tested on the CPU mesh in
+test_delta_level.py) — so the per-revision device cost is O(delta) on
+one chip AND on a mesh.  The remaining O(E) cost per revision is the
+HOST-side column merge in apply_delta."""
 
 import argparse
 import time

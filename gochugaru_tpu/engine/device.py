@@ -764,6 +764,17 @@ class DeviceEngine:
             flat_meta=flat_meta,
         )
 
+    def _delta_prev_ok(self, prev: DeviceSnapshot) -> bool:
+        """Layout eligibility of ``prev`` for the incremental prepare —
+        the sharded engine overrides (its base tables are bucket-sharded)."""
+        return prev.flat_meta is not None and not prev.flat_meta.sharded
+
+    def _place_replicated(self, v: np.ndarray):
+        """Ship a replicated (non-bucket-sharded) host array — overlays,
+        node types, stored-context tables.  The sharded engine overrides
+        with an explicitly-replicated device_put."""
+        return jnp.asarray(v)
+
     def _prepare_delta(
         self, snap: Snapshot, prev: DeviceSnapshot
     ) -> Optional[DeviceSnapshot]:
@@ -775,8 +786,14 @@ class DeviceEngine:
         legacy (non-flat) kernel columns inside are left at the BASE
         revision — a delta-prepared snapshot serves the flat path, and the
         engine's check paths only fall back to the legacy kernel when
-        flat_meta is None, which is never the case here."""
-        if not (self.config.use_flat and self.config.flat_blockslice):
+        flat_meta is None, which is never the case here.  Shared verbatim
+        by the sharded engine (whose overlay placement is replicated
+        across the mesh) through the two hooks above."""
+        if not (
+            self.config.use_flat
+            and self.config.flat_blockslice
+            and self._delta_prev_ok(prev)
+        ):
             return None
         from dataclasses import replace as _dc_replace
 
@@ -797,15 +814,19 @@ class DeviceEngine:
             old = prev.arrays.get("ectx_vi")
             if old is not None and ectx["ectx_vi"].shape[0] != old.shape[0]:
                 return None  # context bucket grew: shapes change, rebuild
-            arrays.update({k: jnp.asarray(v) for k, v in ectx.items()})
+            arrays.update(
+                {k: self._place_replicated(v) for k, v in ectx.items()}
+            )
         if snap.num_nodes > prev.snapshot.num_nodes:
             NN = int(prev.arrays["node_type"].shape[0])
             if snap.num_nodes > NN:
                 return None  # node bucket outgrown: every node shape moves
-            arrays["node_type"] = jnp.asarray(
+            arrays["node_type"] = self._place_replicated(
                 _pad_payload(snap.node_type, NN, -1)
             )
-        arrays.update({k: jnp.asarray(v) for k, v in dl_arrays.items()})
+        arrays.update(
+            {k: self._place_replicated(v) for k, v in dl_arrays.items()}
+        )
         # an empty collapsed delta (or one that cancelled out) compiles as
         # the plain base kernel — don't pay a retrace for DeltaMeta()
         meta = _dc_replace(

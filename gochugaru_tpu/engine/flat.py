@@ -1091,10 +1091,10 @@ def make_flat_fn(
 
     tri = make_tri_fn(caveat_plan) if caveat_plan is not None else None
     SH = axis is not None
-    if SH and meta.delta is not None:
-        raise NotImplementedError(
-            "the delta level is single-chip; sharded prepare is full"
-        )
+    # under sharding the delta overlay tables are REPLICATED (they are
+    # small): delta probe sites use plain unsharded probes whose results
+    # are identical on every shard, composed after the base sites'
+    # OR-reductions — no extra collectives
     if SH != meta.sharded:
         raise ValueError(
             "kernel/layout mismatch: bucket-sharded tables need the model"
@@ -1257,10 +1257,15 @@ def make_flat_fn(
             start = take_in_bounds(off, h & jnp.int32(bpd - 1))
             return slice_blocks(tbl, start, cap), mine
 
-        def range_probe(off, tbl, cap: int, q):
+        def range_probe(off, tbl, cap: int, q, rep: bool = False):
             """(lo, hi) LOCAL row range of group key ``q``; (0, 0) on a
-            miss or on non-owning shards."""
-            blk, mine = pblock(off, tbl, cap, (q,))
+            miss or on non-owning shards.  ``rep`` marks a REPLICATED
+            table (delta overlays): the bucket-ownership math would use
+            the wrong hash mask there, so it probes plainly."""
+            if rep:
+                blk, mine = probe_block(off, tbl, cap, (q,)), None
+            else:
+                blk, mine = pblock(off, tbl, cap, (q,))
             hit = blk_hit(blk, (q,), mine)
             lo = jnp.max(jnp.where(hit, blk[..., 1], 0), axis=-1)
             hi = jnp.max(jnp.where(hit, blk[..., 2], 0), axis=-1)
@@ -1447,21 +1452,26 @@ def make_flat_fn(
                 """Range-probe a userset view and fetch its candidate
                 block; under sharding the single owning shard's rows
                 broadcast to every shard (each then tests the candidates
-                against ITS closure/pus buckets)."""
+                against ITS closure/pus buckets).  The delta level's
+                tables are replicated, so its ranges/blocks are already
+                identical everywhere — no collectives."""
+                rep = prefix != "usr"
                 lo, hi = (
                     range_of("usr", cap, meta.usr_gn, k1)
-                    if prefix == "usr"
+                    if not rep
                     else range_probe(
-                        arrs["dl_usr_off"], arrs["dl_usgx"], cap, k1
+                        arrs["dl_usr_off"], arrs["dl_usgx"], cap, k1, rep=True
                     )
                 )
-                over = por(reduceB(exists & ((hi - lo) > fan)))
+                over = reduceB(exists & ((hi - lo) > fan))
+                if not rep:
+                    over = por(over)
                 valid = (
                     jnp.arange(fan, dtype=jnp.int32) < (hi - lo)[..., None]
                 ) & exists[..., None]
-                tbl = arrs["usx" if prefix == "usr" else "dl_usx"]
+                tbl = arrs["usx" if not rep else "dl_usx"]
                 ublk = slice_blocks(tbl, lo, fan)
-                if SH:
+                if SH and not rep:
                     ublk = vbcast(valid[..., None], ublk)
                     valid = por(valid)
                 return ublk, valid, over
@@ -1674,7 +1684,8 @@ def make_flat_fn(
                     lo = hi = jnp.zeros(nodes.shape, jnp.int32)
                 if Ksd:
                     lod, hid = range_probe(
-                        arrs["dl_arr_off"], arrs["dl_argx"], dm.ar_cap, ak
+                        arrs["dl_arr_off"], arrs["dl_argx"], dm.ar_cap, ak,
+                        rep=True,
                     )
                 else:
                     lod = hid = jnp.zeros(nodes.shape, jnp.int32)
