@@ -1,0 +1,78 @@
+"""Bulk-import benchmark: N edges through Client.import_relationships
+(the reference's BulkImportRelationships path, client/client.go:438-465),
+then a spot-check visibility probe and a full export round-trip count.
+
+The metric times the CLIENT path — chunk accumulation, columnar store
+segments (store/store.py COLUMNAR_IMPORT_MIN), revision mint — for
+pre-built Relationship objects; building 10M Python objects is the
+caller's cost and is reported separately.  VERDICT round-2 item 3 asked
+for a committed ≥10M-edge import timing through the Client."""
+
+import argparse
+import time
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+from benchmarks.common import maybe_force_cpu, emit, note
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--edges", type=int, default=10_000_000)
+    args = ap.parse_args()
+    note(f"platform={maybe_force_cpu()}")
+
+    from gochugaru_tpu import consistency, rel
+    from gochugaru_tpu.client import Client
+    from gochugaru_tpu.utils import background
+
+    c = Client()
+    ctx = background()
+    c.write_schema(ctx, """
+    definition user {}
+    definition doc {
+        relation reader: user
+        permission view = reader
+    }
+    """)
+    n_docs = max(args.edges // 10, 1000)
+    t0 = time.perf_counter()
+    # unique (doc, user) pairs by construction: every generated edge is a
+    # distinct live tuple, so the imported count equals the edge count
+    rels = [
+        rel.Relationship(
+            resource_type="doc", resource_id=f"d{i % n_docs}",
+            resource_relation="reader",
+            subject_type="user", subject_id=f"u{i // n_docs}",
+        )
+        for i in range(args.edges)
+    ]
+    note(f"built {len(rels):,} Relationship objects in "
+         f"{time.perf_counter()-t0:.1f}s (caller-side cost, untimed below)")
+
+    t0 = time.perf_counter()
+    c.import_relationships(ctx, rels)
+    dt = time.perf_counter() - t0
+    rate = args.edges / dt
+    emit("bulk_import_edges_per_sec", rate, "edges/sec", rate / 1_000_000)
+    note(f"import: {dt:.1f}s for {args.edges:,} edges")
+
+    full = consistency.full()
+    t0 = time.perf_counter()
+    assert c.check_one(
+        ctx, full, rel.must_from_triple("doc:d0", "view", "user:u0")
+    )
+    note(f"first check after import (incl. device prepare): "
+         f"{time.perf_counter()-t0:.1f}s")
+    t0 = time.perf_counter()
+    n = sum(1 for _ in c.export_relationships(ctx, c.read_schema(ctx)[1]))
+    dt = time.perf_counter() - t0
+    emit("bulk_export_edges_per_sec", n / dt, "edges/sec", n / dt / 1_000_000)
+    note(f"export: {dt:.1f}s for {n:,} live edges")
+
+
+if __name__ == "__main__":
+    main()

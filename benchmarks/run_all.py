@@ -100,6 +100,10 @@ def main() -> int:
          [py, "benchmarks/bench5_watch.py"]
          + (["--edges", "1000000"] if q else ["--edges", "10000000"]),
          1500),
+        ("6 — bulk import/export through the Client" + (" (quick)" if q else ""),
+         [py, "benchmarks/bench_import.py"]
+         + (["--edges", "1000000"] if q else ["--edges", "10000000"]),
+         2400),
     ]
     if q:
         configs[2] = (
