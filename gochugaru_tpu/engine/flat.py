@@ -295,10 +295,13 @@ def _arrow_data_depth(snap, cap: int = 64) -> int:
         vals = np.where(cvalid, depth[childc] + 1, 0)
         upd = np.maximum.reduceat(vals, starts)
         if (upd <= depth[uniq_res]).all():
-            # pow2-bucketed (rounding UP keeps the cut sound): FlatMeta is
-            # the kernel-cache key, so a tree deepening 4→5 must not
-            # recompile on every prepare
-            return _ceil_pow2(int(depth.max()), 1)
+            # bucketed to the next EVEN depth (rounding UP keeps the cut
+            # sound): FlatMeta is the kernel-cache key, so a tree
+            # deepening 4→5 must not recompile on every prepare — but
+            # pow2 granularity would round the common depth 5 up to 8,
+            # keeping 60% of the dead unroll the cut exists to remove
+            d = int(depth.max())
+            return d + (d & 1)
         depth[uniq_res] = np.maximum(depth[uniq_res], upd)
     return -1
 
