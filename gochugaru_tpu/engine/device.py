@@ -747,7 +747,7 @@ class DeviceEngine:
         if self.config.use_flat:
             from .flat import build_flat_arrays
 
-            built = build_flat_arrays(snap, self.config)
+            built = build_flat_arrays(snap, self.config, plan=self.plan)
             if built is not None:  # unpackable graphs use the legacy path
                 flat_arrays, flat_meta = built
                 arrays.update(flat_arrays)

@@ -178,6 +178,11 @@ class FlatMeta:
     #: tables are bucket-sharded / stacked for shard_map (the kernel must
     #: be built with the matching ``axis``; make_flat_fn enforces this)
     sharded: bool = False
+    #: flattened recursive hierarchies (the resource-side Leopard index):
+    #: ((ts_slot, group_cap, fan), ...) — per eligible tupleset, the
+    #: ancestor-closure tables rc{ts}_off / rc{ts}gx / rc{ts}x exist and
+    #: the kernel evaluates ``perm = ∃ ancestor: rest`` in ONE level
+    rc_slots: Tuple[Tuple[int, int, int], ...] = ()
     #: longest arrow chain in the DATA (longest path over the ar view),
     #: or -1 when the arrow graph has a cycle / exceeded the probe cap.
     #: Bounds recursion unrolling: beyond this many arrow hops there are
@@ -271,17 +276,178 @@ def _view_flags_of(snap) -> Dict[str, bool]:
     )
 
 
-def _arrow_data_depth(snap, cap: int = 64) -> int:
+def rc_candidates(compiled: CompiledSchema, plan: DevicePlan):
+    """Self-recursive arrow hierarchies eligible for ancestor flattening
+    (the resource-side Leopard index): programs of shape
+    ``perm = union(rest..., ts->perm)`` on a type whose ``ts`` edges stay
+    WITHIN the type (pure hierarchy, e.g. folder.parent).  Returns
+    {(type_name, perm_slot): (ts_slot, rest_ir)} where ``rest_ir`` is the
+    union of the non-recursive children — the flattened evaluation is
+    ``perm(n) = ∃ a ∈ ancestors_ts*(n): rest(a)`` with the path's
+    admissibility folded through the closure semiring."""
+    out = {}
+    for (tname, tid, slot, expr) in plan.topo_programs:
+        if expr[0] != "union":
+            continue
+        ct = compiled.types[compiled.type_ids[tname]]
+        rest = []
+        ts_slots = set()
+        ok = True
+        for child in expr[1]:
+            if child[0] == "arrow" and plan.ts_slots[child[1]] >= 0:
+                ts_slot = plan.ts_slots[child[1]]
+                if child[2] == slot:
+                    # the recursive child: its tupleset must only reach
+                    # this same type (direct subjects; arrows traverse
+                    # ellipsis subjects only)
+                    relation = ct.relations.get(ts_slot)
+                    if relation is None or any(
+                        a.type_id != tid or a.relation_slot >= 0
+                        or a.wildcard
+                        for a in relation.allowed
+                    ):
+                        ok = False
+                        break
+                    ts_slots.add(ts_slot)
+                    continue
+            # non-recursive children must not re-reach this slot at all
+            if _ir_refs_slot(child, slot):
+                ok = False
+                break
+            rest.append(child)
+        if ok and len(ts_slots) == 1 and rest:
+            out[(tname, slot)] = (next(iter(ts_slots)), ("union", tuple(rest)))
+    return out
+
+
+def cfg_budget(config: EngineConfig) -> int:
+    """Arrow hops the unrolled recursion can cover exactly."""
+    return config.flat_recursion
+
+
+def _ir_refs_slot(ir: ExprIR, slot: int) -> bool:
+    tag = ir[0]
+    if tag == "ref":
+        return ir[1] == slot
+    if tag == "arrow":
+        return ir[2] == slot
+    if tag in ("union", "inter"):
+        return any(_ir_refs_slot(c, slot) for c in ir[1])
+    if tag == "excl":
+        return _ir_refs_slot(ir[1], slot) or _ir_refs_slot(ir[2], slot)
+    return False
+
+
+def _arrow_closure(snap, ts_slot: int, *, per_node_cap: int = 64,
+                   max_hops: int = 64):
+    """Reflexive-transitive ancestor closure over ONE tupleset's arrow
+    edges, with the membership closure's two-plane max-min expiry
+    semiring folded along paths.  Returns (src, anc, d_until, p_until)
+    sorted by src — or None when the slot's hierarchy has a data cycle,
+    doesn't converge, or some node's ancestor set exceeds the cap
+    (the recursive kernel path still answers those worlds)."""
+    from ..store.closure import NEVER, NO_EXP
+
+    m = snap.ar_rel == ts_slot
+    src = snap.ar_res[m].astype(np.int64)
+    dst = snap.ar_child[m].astype(np.int64)
+    keep = dst >= 0
+    src, dst = src[keep], dst[keep]
+    cav = snap.ar_caveat[m][keep]
+    exp = snap.ar_exp[m][keep]
+    w = np.where(exp == 0, np.int64(NO_EXP), exp.astype(np.int64)).astype(np.int32)
+    e_d = np.where(cav == 0, w, NEVER)
+    e_p = w
+    order = np.argsort(src, kind="stable")
+    e_src, e_dst = src[order], dst[order]
+    e_d, e_p = e_d[order], e_p[order]
+
+    from ..store.closure import _expand_join
+
+    from ..native.sort import lexsort2
+
+    def dedup(s, a, d, p):
+        # native parallel lexsort, same reason as store/closure.py
+        # group_max: numpy lexsort is tens of seconds at big pair counts
+        o = lexsort2(s.astype(np.int32), a.astype(np.int32))
+        s, a, d, p = s[o], a[o], d[o], p[o]
+        first = np.ones(s.shape[0], bool)
+        first[1:] = (s[1:] != s[:-1]) | (a[1:] != a[:-1])
+        st = np.nonzero(first)[0]
+        return (
+            s[first], a[first],
+            np.maximum.reduceat(d, st), np.maximum.reduceat(p, st),
+        )
+
+    c_s, c_a, c_d, c_p = dedup(e_src, e_dst, e_d, e_p)
+    n_s, n_a, n_d, n_p = c_s, c_a, c_d, c_p
+    for _ in range(max_hops):
+        if n_s.size == 0:
+            break
+        reps, ii = _expand_join(e_src, n_a)
+        if reps.size == 0:
+            break
+        j_s = n_s[reps]
+        j_a = e_dst[ii]
+        j_d = np.minimum(n_d[reps], e_d[ii])
+        j_p = np.minimum(n_p[reps], e_p[ii])
+        if (j_s == j_a).any():
+            return None  # data cycle: keep the recursive path
+        m_s = np.concatenate([c_s, j_s])
+        m_a = np.concatenate([c_a, j_a])
+        m_d = np.concatenate([c_d, j_d])
+        m_p = np.concatenate([c_p, j_p])
+        new_s, new_a, new_d, new_p = dedup(m_s, m_a, m_d, m_p)
+        if new_s.shape[0] == c_s.shape[0] and (new_d == c_d).all() and (
+            new_p == c_p
+        ).all():
+            break
+        # the next frontier: improved/new pairs only (semi-naive)
+        pk_old = c_s * np.int64(2**31) + c_a
+        pk_new = new_s.astype(np.int64) * np.int64(2**31) + new_a
+        pos = np.searchsorted(pk_old, pk_new)
+        posc = np.clip(pos, 0, max(pk_old.shape[0] - 1, 0))
+        found = (pk_old.shape[0] > 0) & (pk_old[posc] == pk_new)
+        old_d = np.where(found, c_d[posc], NEVER)
+        old_p = np.where(found, c_p[posc], NEVER)
+        imp = (new_d > old_d) | (new_p > old_p)
+        n_s, n_a = new_s[imp], new_a[imp]
+        n_d, n_p = new_d[imp], new_p[imp]
+        c_s, c_a, c_d, c_p = new_s, new_a, new_d, new_p
+    else:
+        return None  # hop budget exhausted
+
+    # STRICT ancestors only: the kernel always evaluates `rest` at the
+    # node itself through a dedicated reflexive lane, so a range miss
+    # simply means "self only"
+    if c_s.size:
+        counts = np.bincount(c_s.astype(np.int64))
+        if counts.max() > per_node_cap:
+            return None
+    return c_s.astype(np.int32), c_a.astype(np.int32), c_d, c_p
+
+
+def _arrow_data_depth(snap, cap: int = 64, ts_slot: Optional[int] = None) -> int:
     """Longest path, in arrow hops, over the DATA's res→child arrow edges
-    (all tupleset relations together); -1 on a data cycle or past ``cap``.
-    Bellman-style relaxation over the res-grouped view: converges in
-    (true depth) rounds on a DAG — folder trees are ~log-depth, so this
-    is a handful of O(AR) numpy passes at prepare time."""
-    AR = int(snap.ar_rel.shape[0])
+    (all tupleset relations together, or just ``ts_slot``'s); -1 on a
+    data cycle or past ``cap``.  Bellman-style relaxation over the
+    res-grouped view: converges in (true depth) rounds on a DAG — folder
+    trees are ~log-depth, so this is a handful of O(AR) numpy passes at
+    prepare time.  The result is bucketed to the next EVEN depth
+    (rounding UP keeps every use sound): FlatMeta is the kernel-cache
+    key, so a tree deepening 4→5 must not recompile on every prepare —
+    and pow2 granularity would round the common depth 5 up to 8, keeping
+    60% of the dead unroll the recursion cut exists to remove."""
+    if ts_slot is not None:
+        m = snap.ar_rel == ts_slot
+        res = snap.ar_res[m].astype(np.int64)
+        child = np.ascontiguousarray(snap.ar_child[m], np.int64)
+    else:
+        res = snap.ar_res.astype(np.int64)
+        child = np.ascontiguousarray(snap.ar_child, np.int64)
+    AR = int(res.shape[0])
     if AR == 0:
         return 0
-    res = snap.ar_res.astype(np.int64)
-    child = np.ascontiguousarray(snap.ar_child, np.int64)
     order = np.argsort(res, kind="stable")
     res_s, child_s = res[order], child[order]
     first = np.ones(AR, bool)
@@ -295,11 +461,6 @@ def _arrow_data_depth(snap, cap: int = 64) -> int:
         vals = np.where(cvalid, depth[childc] + 1, 0)
         upd = np.maximum.reduceat(vals, starts)
         if (upd <= depth[uniq_res]).all():
-            # bucketed to the next EVEN depth (rounding UP keeps the cut
-            # sound): FlatMeta is the kernel-cache key, so a tree
-            # deepening 4→5 must not recompile on every prepare — but
-            # pow2 granularity would round the common depth 5 up to 8,
-            # keeping 60% of the dead unroll the cut exists to remove
             d = int(depth.max())
             return d + (d & 1)
         depth[uniq_res] = np.maximum(depth[uniq_res], upd)
@@ -386,8 +547,41 @@ def _tindex_join(snap, config: EngineConfig, cl, us_gk, cl_k1, cl_k2, pus_k, S1)
     )
 
 
+def _rc_build(
+    snap, config: EngineConfig, plan: Optional[DevicePlan], ar_depth: int
+):
+    """Ancestor closures for every flattenable recursive hierarchy:
+    {ts_slot: (src, anc, d_until, p_until, fan)} (engine-level R-index).
+
+    Built only when the DATA is deeper than the recursion budget: within
+    the budget, the unrolled recursion is exact and CHEAPER (narrow
+    lattices, no closure fetch); beyond it, the flattened form is the
+    only device-exact path — either way no host fallback."""
+    if plan is None or not config.flat_rc_index:
+        return {}
+    if 0 <= ar_depth <= cfg_budget(config):
+        return {}  # every hierarchy fits the unroll: nothing to flatten
+    cands = rc_candidates(snap.compiled, plan)
+    out = {}
+    for (_tname, _slot), (ts_slot, _rest) in cands.items():
+        if ts_slot in out:
+            continue
+        # per-tupleset depth: one deep hierarchy must not force closure
+        # builds for shallow ones the recursion already answers exactly
+        slot_depth = _arrow_data_depth(snap, ts_slot=ts_slot)
+        if 0 <= slot_depth <= cfg_budget(config):
+            continue
+        built = _arrow_closure(snap, ts_slot)
+        if built is None:
+            continue
+        src, anc, d_until, p_until = built
+        counts = np.bincount(src.astype(np.int64)) if src.size else np.zeros(1)
+        out[ts_slot] = (src, anc, d_until, p_until, _round_fan(int(counts.max())))
+    return out
+
+
 def build_flat_arrays(
-    snap, config: EngineConfig
+    snap, config: EngineConfig, plan: Optional[DevicePlan] = None
 ) -> Optional[Tuple[Dict[str, np.ndarray], FlatMeta]]:
     """Hash-index the snapshot + flatten its membership closure.  Returns
     padded host arrays (merged into DeviceSnapshot.arrays) and the static
@@ -521,10 +715,31 @@ def build_flat_arrays(
             t_all=t_all,
         )
 
+    # resource-side Leopard index: flattened ancestor closures for
+    # self-recursive arrow hierarchies (block-slice layout only)
+    ar_dd = _arrow_data_depth(snap)
+    rc_kw: Dict = {}
+    if BS:
+        rc_list = []
+        for ts_slot, (src, anc, d_u, p_u, fan) in _rc_build(
+            snap, config, plan, ar_dd
+        ).items():
+            ri = build_range_hash(src)
+            out[f"rc{ts_slot}_off"] = ri.index.off
+            out[f"rc{ts_slot}gx"] = interleave_buckets(
+                ri.index, [ri.gk, ri.glo, ri.ghi]
+            )
+            out[f"rc{ts_slot}x"] = interleave_rows(
+                [anc, d_u, p_u], pad=max(64, fan)
+            )
+            rc_list.append((int(ts_slot), _round_cap(ri.index.cap), fan))
+        rc_kw = dict(rc_slots=tuple(sorted(rc_list)))
+
     wc_nodes = snap.wildcard_node_of_type[snap.wildcard_node_of_type >= 0]
 
     meta = FlatMeta(
         N=N, S1=S1,
+        **rc_kw,
         e_cap=_round_cap(eh.cap), e_n=_ceil_pow2(max(eh.n, 1)),
         usr_cap=_round_cap(usr.index.cap),
         usr_gn=_ceil_pow2(max(usr.index.n, 1)),
@@ -548,7 +763,7 @@ def build_flat_arrays(
         ar_hascav=ar_hascav,
         ar_hasexp=ar_hasexp,
         blockslice=BS,
-        ar_data_depth=_arrow_data_depth(snap),
+        ar_data_depth=ar_dd,
         e_slots=tuple(int(s) for s in np.unique(snap.e_rel)),
         us_slots=tuple(int(s) for s in np.unique(snap.us_rel)),
         has_wc_edges=bool(np.isin(snap.e_subj, wc_nodes).any()),
@@ -660,7 +875,8 @@ def _stack_range(ri, row_cols: Sequence[np.ndarray], M: int, fan_pad: int):
 
 
 def build_flat_arrays_sharded(
-    snap, config: EngineConfig, model_size: int
+    snap, config: EngineConfig, model_size: int,
+    plan: Optional[DevicePlan] = None,
 ) -> Optional[Tuple[Dict[str, np.ndarray], FlatMeta]]:
     """The bucket-sharded counterpart of build_flat_arrays: every hash /
     range / closure / T table stacked per model shard (leading axis splits
@@ -742,9 +958,24 @@ def build_flat_arrays_sharded(
             t_all=t_all,
         )
 
+    ar_dd = _arrow_data_depth(snap)
+    rc_list = []
+    for ts_slot, (src, anc, d_u, p_u, fan) in _rc_build(
+        snap, config, plan, ar_dd
+    ).items():
+        ri = build_range_hash(src, min_size=ms)
+        (
+            out[f"rc{ts_slot}_off"],
+            out[f"rc{ts_slot}gx"],
+            out[f"rc{ts_slot}x"],
+            gcap,
+        ) = _stack_range(ri, [anc, d_u, p_u], M, max(64, fan))
+        rc_list.append((int(ts_slot), _round_cap(gcap), fan))
+
     wc_nodes = snap.wildcard_node_of_type[snap.wildcard_node_of_type >= 0]
     meta = FlatMeta(
         N=N, S1=S1,
+        rc_slots=tuple(sorted(rc_list)),
         e_cap=_round_cap(eh.cap), e_n=_ceil_pow2(max(eh.n, 1)),
         usr_cap=_round_cap(usr_cap),
         usr_gn=8,  # legacy-probe geometry: unused (local shapes rule)
@@ -763,7 +994,7 @@ def build_flat_arrays_sharded(
         **flags,
         blockslice=True,
         sharded=True,
-        ar_data_depth=_arrow_data_depth(snap),
+        ar_data_depth=ar_dd,
         e_slots=tuple(int(s) for s in np.unique(snap.e_rel)),
         us_slots=tuple(int(s) for s in np.unique(snap.us_rel)),
         has_wc_edges=bool(np.isin(snap.e_subj, wc_nodes).any()),
@@ -939,6 +1170,16 @@ def build_delta_arrays(
     S1 = meta.S1
     N = meta.N
     acc = _acc_collapse(getattr(prev_dsnap, "delta_acc", None), di, N, S1)
+    if meta.rc_slots:
+        # rows of a FLATTENED tupleset shift its ancestor closure: bail
+        # EARLY (before any table builds) to a full rebuild.  Incremental
+        # rc-closure maintenance is a possible future middle ground
+        rc_ts = np.asarray([t for t, _, _ in meta.rc_slots], np.int64)
+        if (
+            (np.isin(acc["a_rel"], rc_ts) & (acc["a_srel1"] == 0)).any()
+            or (np.isin(acc["g_rel"], rc_ts) & (acc["g_srel1"] == 0)).any()
+        ):
+            return None
     n_adds = acc["a_key"].shape[0]
     n_tombs = acc["g_key"].shape[0]
     if n_adds + n_tombs > max(
@@ -1107,6 +1348,14 @@ def make_flat_fn(
     perm_programs: Dict[int, List[Tuple[str, int, ExprIR]]] = {}
     for (tname, tid, slot, expr) in plan.topo_programs:
         perm_programs.setdefault(slot, []).append((tname, tid, expr))
+    # flattened recursive hierarchies whose closure tables were built:
+    # (type, slot) → (ts_slot, rest_ir); geometry per ts_slot from meta
+    rc_geom = {ts: (cap, fan) for ts, cap, fan in meta.rc_slots}
+    rc_map = {
+        key: (ts_slot, rest)
+        for key, (ts_slot, rest) in rc_candidates(compiled, plan).items()
+        if ts_slot in rc_geom
+    }
     rel_slots = frozenset(plan.rel_leaf_slots)
     cyclic = _eval_cyclic_pairs(compiled)
     KU = cfg.us_leaf_cap
@@ -1617,8 +1866,27 @@ def make_flat_fn(
                 tk(node_type, jnp.clip(nodes, 0, node_type.shape[0] - 1)),
                 -1,
             )
+            width = 1
+            for dim in nodes.shape[1:]:
+                width *= dim
             for (tname, tid, expr) in progs:
                 mask = ntype == tid_map[tid]
+                rc = rc_map.get((tname, slot))
+                if rc is not None and width * (
+                    rc_geom[rc[0]][1] + 1
+                ) <= cfg.flat_max_width:
+                    # flattened hierarchy: ONE level over the ancestor
+                    # closure instead of recursive unrolling — lane 0 is
+                    # the node itself (reflexive), the rest are strict
+                    # ancestors gated by the path's semiring values
+                    ed, ep, eo, eu = rc_eval(
+                        rc[0], rc[1], nodes, stack + ((tname, slot),),
+                        frozenset((tname,)), ar_hops,
+                    )
+                    d = d | (mask & ed)
+                    p = p | (mask & ep)
+                    ovf, used = ovf | eo, used | eu
+                    continue
                 if (tname, slot) in cyclic and stack.count(
                     (tname, slot)
                 ) >= cfg.flat_recursion:
@@ -1634,6 +1902,40 @@ def make_flat_fn(
                 p = p | (mask & ep)
                 ovf, used = ovf | eo, used | eu
             return d, p, ovf, used
+
+        def rc_eval(ts_slot: int, rest: ExprIR, nodes, stack, types,
+                    ar_hops: int):
+            """perm(n) = ∃ a ∈ {n} ∪ ancestors(n): rest(a), with the
+            ancestor paths' two-plane admissibility from the flattened
+            arrow closure (rc{ts} tables)."""
+            cap, fan = rc_geom[ts_slot]
+            exists = nodes >= 0
+            nq = jnp.where(exists, nodes, -1)
+            # rc tables follow the base layout: bucket-sharded under SH
+            # (owner-local ranges, broadcast below), plain otherwise
+            lo, hi = range_probe(
+                arrs[f"rc{ts_slot}_off"], arrs[f"rc{ts_slot}gx"], cap, nq
+            )
+            valid = (
+                jnp.arange(fan, dtype=jnp.int32) < (hi - lo)[..., None]
+            ) & exists[..., None]
+            blk = slice_blocks(arrs[f"rc{ts_slot}x"], lo, fan)
+            if SH:
+                blk = vbcast(valid[..., None], blk)
+                valid = por(valid)
+            anc = jnp.where(valid, blk[..., 0], -1)
+            path_d = valid & (blk[..., 1] > now)
+            path_p = valid & (blk[..., 2] > now)
+            # reflexive lane 0: the node itself, path trivially live
+            lattice = jnp.concatenate([nodes[..., None], anc], axis=-1)
+            path_d = jnp.concatenate([exists[..., None], path_d], axis=-1)
+            path_p = jnp.concatenate([exists[..., None], path_p], axis=-1)
+            rd, rp, ro, ru = eval_expr(rest, lattice, stack, types, ar_hops)
+            return (
+                jnp.any(rd & path_d, axis=-1),
+                jnp.any(rp & path_p, axis=-1),
+                ro, ru,
+            )
 
         def eval_slot(slot: int, nodes, stack: Tuple, types, ar_hops: int) -> Tuple:
             cyc_sig = tuple(

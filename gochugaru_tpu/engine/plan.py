@@ -75,6 +75,11 @@ class EngineConfig:
     #: max(this, E/8) trigger compaction: the next prepare rebuilds the
     #: base instead of growing the overlay (engine/flat.py delta level)
     flat_delta_min_compact: int = 65_536
+    #: flatten self-recursive arrow hierarchies into precomputed ancestor
+    #: closures (the resource-side Leopard index, engine/flat.py
+    #: rc_candidates/_arrow_closure): a depth-D folder tree evaluates in
+    #: ONE level instead of D unrolled recursion levels
+    flat_rc_index: bool = True
 
     @staticmethod
     def for_schema(compiled: CompiledSchema, **overrides) -> "EngineConfig":

@@ -167,7 +167,7 @@ class ShardedEngine(DeviceEngine):
             from ..engine.flat import build_flat_arrays_sharded
 
             built = build_flat_arrays_sharded(
-                snap, self.config, self.model_size
+                snap, self.config, self.model_size, plan=self.plan
             )
             if built is not None:
                 flat_arrays, flat_meta = built

@@ -205,21 +205,25 @@ def test_flat_matches_legacy_on_caveat_free_world():
             assert bool(fp[i]) == bool(lp[i]), checks[i]
 
 
-def test_deep_recursion_beyond_budget_falls_back_not_wrong():
-    # folder chain deeper than the recursion budget: queries needing the
-    # deep walk must surface as possible/overflow (host fallback), and
-    # shallow queries stay exact
-    chain = 14
+def _deep_chain_world(chain=14, **cfg):
     rels = [rel.must_from_tuple("folder:f0#owner", "user:deep")]
     for i in range(1, chain):
         rels.append(rel.must_from_tuple(f"folder:f{i}#parent", f"folder:f{i-1}"))
     rels.append(rel.must_from_tuple("doc:d#folder", f"folder:f{chain-1}"))
-    engine, dsnap, oracle = world(FEATURES, rels, flat_recursion=4)
+    engine, dsnap, oracle = world(FEATURES, rels, flat_recursion=4, **cfg)
     checks = [
         rel.must_from_triple("doc:d", "read", "user:deep"),
         rel.must_from_triple("doc:d", "read", "user:other"),
         rel.must_from_triple("folder:f1", "view", "user:deep"),
     ]
+    return engine, dsnap, oracle, checks
+
+
+def test_deep_recursion_beyond_budget_falls_back_not_wrong():
+    # folder chain deeper than the recursion budget, with the flattened
+    # ancestor index DISABLED: queries needing the deep walk must surface
+    # as possible/overflow (host fallback), and shallow queries stay exact
+    engine, dsnap, oracle, checks = _deep_chain_world(flat_rc_index=False)
     d, p, ovf = engine.check_batch(dsnap, checks, now_us=NOW)
     # never a wrong definite
     for i, q in enumerate(checks):
@@ -230,6 +234,21 @@ def test_deep_recursion_beyond_budget_falls_back_not_wrong():
     assert (p[0] and not d[0]) or ovf[0]
     # shallow view query is exact
     assert bool(d[2]) == (oracle.check_relationship(checks[2]) == T)
+
+
+def test_deep_recursion_flattened_exact_on_device():
+    # with the resource-side Leopard index (default), the SAME deep chain
+    # resolves exactly on device — no host fallback, no overflow
+    engine, dsnap, oracle, checks = _deep_chain_world()
+    assert dsnap.flat_meta.rc_slots, "hierarchy should be flattened"
+    d, p, ovf = engine.check_batch(dsnap, checks, now_us=NOW)
+    from gochugaru_tpu.engine.oracle import F
+
+    for i, q in enumerate(checks):
+        want = oracle.check_relationship(q)
+        assert not ovf[i]
+        assert bool(d[i]) == (want == T), q
+        assert bool(p[i]) == (want != F), q
 
 
 def test_arrow_fanout_overflow_flags():
@@ -423,3 +442,94 @@ def test_blockslice_scatter_parity():
             assert bool(db[i]) == bool(ds[i]), f"definite differs for {q}"
             assert bool(pb[i]) == bool(ps[i]), f"possible differs for {q}"
             assert bool(ob[i]) == bool(osc[i]), f"overflow differs for {q}"
+
+
+RC_GATED = """
+caveat tier(t int, min int) { t >= min }
+definition user {}
+definition folder {
+    relation parent: folder | folder with tier
+    relation owner: user
+    permission view = owner + parent->view
+}
+"""
+
+
+def _gated_chain(chain=12):
+    """A deep parent chain with a caveated edge and an expired edge mid-
+    chain: flattened ancestor paths must fold per-edge admissibility
+    through the closure semiring (definite only via caveat-free live
+    paths)."""
+    import datetime as dt
+
+    rels = [rel.must_from_tuple("folder:f0#owner", "user:root")]
+    for i in range(1, chain):
+        r = rel.must_from_tuple(f"folder:f{i}#parent", f"folder:f{i-1}")
+        if i == chain // 2:
+            r = r.with_caveat("tier", {"min": 5})
+        if i == chain - 2:
+            r = r.with_expiration(
+                dt.datetime.fromtimestamp(
+                    NOW / 1e6 - 50, tz=dt.timezone.utc
+                )
+            )
+        rels.append(r)
+    # a second branch with fully-live edges into the middle of the chain
+    rels.append(rel.must_from_tuple("folder:side#parent", "folder:f3"))
+    rels.append(rel.must_from_tuple(f"folder:f{chain//2}#owner", "user:mid"))
+    return rels
+
+
+def test_rc_index_folds_caveats_and_expiry():
+    rels = _gated_chain()
+    engine, dsnap, oracle = world(RC_GATED, rels, flat_recursion=3)
+    assert dsnap.flat_meta.rc_slots, "deep gated chain should be flattened"
+    checks = [
+        # below the caveated edge: root grant is conditional, mid definite
+        rel.must_from_triple("folder:f7", "view", "user:root"),
+        rel.must_from_triple("folder:f7", "view", "user:mid"),
+        # above the caveated edge: root grant stays definite
+        rel.must_from_triple("folder:f4", "view", "user:root"),
+        # beyond the EXPIRED edge: nothing flows through it
+        rel.must_from_triple("folder:f11", "view", "user:root"),
+        rel.must_from_triple("folder:f11", "view", "user:mid"),
+        # the side branch re-enters mid-chain below the caveat
+        rel.must_from_triple("folder:side", "view", "user:root"),
+        rel.must_from_triple("folder:side", "view", "user:mid"),
+    ]
+    assert_sound_cascade(engine, dsnap, oracle, checks)
+    d, p, ovf = engine.check_batch(dsnap, checks, now_us=NOW)
+    assert bool(d[1]) and bool(d[2])  # definite along clean paths
+    assert bool(p[0]) and not bool(d[0])  # conditional through the caveat
+    assert not bool(p[3]) and not bool(p[4])  # dead past the expiry
+
+
+def test_rc_index_sharded_deep_chain():
+    import jax
+    import pytest as _pytest
+
+    if len(jax.devices()) < 8:
+        _pytest.skip("needs 8 virtual devices")
+    from gochugaru_tpu.parallel import ShardedEngine, make_mesh
+
+    rels = _gated_chain()
+    cs = compile_schema(parse_schema(RC_GATED))
+    snap = build_snapshot(1, cs, Interner(), rels, epoch_us=NOW)
+    cfg = EngineConfig.for_schema(cs, flat_recursion=3)
+    single = DeviceEngine(cs, cfg)
+    sds = single.prepare(snap)
+    assert sds.flat_meta.rc_slots
+    checks = [
+        rel.must_from_triple(f"folder:f{i}", "view", u)
+        for i in range(12)
+        for u in ("user:root", "user:mid")
+    ]
+    sd, sp, sovf = single.check_batch(sds, checks, now_us=NOW)
+    eng = ShardedEngine(cs, make_mesh(2, 4), cfg)
+    ds = eng.prepare(snap)
+    assert ds.flat_meta.sharded and ds.flat_meta.rc_slots
+    d, p, ovf = eng.check_batch(ds, checks, now_us=NOW)
+    for i, q in enumerate(checks):
+        assert bool(d[i]) == bool(sd[i]), f"definite differs: {q}"
+        assert bool(p[i]) == bool(sp[i]), f"possible differs: {q}"
+        assert bool(ovf[i]) == bool(sovf[i]), f"ovf differs: {q}"
