@@ -115,3 +115,94 @@ def test_range_index_empty():
     lo, hi = probe_range(arrays, ri.index.cap, ri.index.n,
                          jnp.asarray([3], dtype=jnp.int32))
     assert int(lo[0]) == 0 and int(hi[0]) == 0
+
+
+def test_probe_block_matches_probe_rows():
+    """The block-slice probe must find exactly the rows the scattered
+    probe finds, across random tables and query mixes."""
+    import numpy as np
+
+    from gochugaru_tpu.engine.hash import (
+        build_hash, interleave_buckets, probe_block, probe_rows,
+    )
+
+    rng = np.random.default_rng(0)
+    for n in (0, 1, 7, 500):
+        k1 = rng.integers(0, 200, n).astype(np.int32)
+        k2 = rng.integers(0, 50, n).astype(np.int32)
+        payload = np.arange(n, dtype=np.int32)
+        h = build_hash([k1, k2])
+        tbl = interleave_buckets(h, [k1, k2, payload])
+        q1 = rng.integers(-1, 220, 64).astype(np.int32)
+        q2 = rng.integers(-1, 60, 64).astype(np.int32)
+        import jax.numpy as jnp
+
+        blk = np.asarray(
+            probe_block(
+                jnp.asarray(h.off), jnp.asarray(tbl), max(h.cap, 1),
+                (jnp.asarray(q1), jnp.asarray(q2)),
+            )
+        )
+        hit = (
+            (blk[..., 0] == q1[:, None])
+            & (blk[..., 1] == q2[:, None])
+            & (q1 >= 0)[:, None]
+            & (q2 >= 0)[:, None]
+        )
+        got = np.where(hit.any(1), blk[..., 2].max(1, initial=-1, where=hit), -1)
+        if n == 0:
+            assert (got == -1).all()
+            continue
+        row = np.asarray(
+            probe_rows(h.off, h.rows, (k1, k2), (q1, q2), max(h.cap, 1), h.n)
+        )
+        want = np.where(row >= 0, payload[np.clip(row, 0, max(n - 1, 0))], -1)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_slice_blocks_never_shifts_within_pad():
+    """A slice starting at any real offset must return exactly the rows
+    at [start, start+cap) — the pad guarantees no clamp shift."""
+    import numpy as np
+
+    from gochugaru_tpu.engine.hash import interleave_rows, slice_blocks
+
+    vals = np.arange(100, dtype=np.int32)
+    tbl = interleave_rows([vals, vals * 2], pad=16)
+    starts = np.asarray([0, 1, 57, 99, 100], np.int32)
+    import jax.numpy as jnp
+
+    blk = np.asarray(slice_blocks(jnp.asarray(tbl), jnp.asarray(starts), 8))
+    for i, s in enumerate(starts):
+        for j in range(8):
+            want = s + j if s + j < 100 else -1
+            assert blk[i, j, 0] == want, (s, j)
+
+
+def test_stack_point_and_range_cover_all_rows():
+    """Bucket-sharded stacking: every row lands on exactly one shard, at
+    the local offset its (normalized) bucket table says."""
+    import numpy as np
+
+    from gochugaru_tpu.engine.hash import build_hash, mix32
+    from gochugaru_tpu.engine.flat import _stack_point
+
+    rng = np.random.default_rng(3)
+    n, M = 300, 4
+    k = rng.integers(0, 10_000, n).astype(np.int32)
+    payload = np.arange(n, dtype=np.int32)
+    h = build_hash([k], min_size=M)
+    off, tbl = _stack_point(h, [k, payload], M)
+    bpd = (off.shape[0] // M) - 1
+    tbl3 = tbl.reshape(M, -1, 2)
+    off2 = off.reshape(M, bpd + 1)
+    seen = []
+    for i in range(n):
+        b = int(mix32([k[i : i + 1]])[0] & np.uint32(h.size - 1))
+        s = b // bpd
+        lo, hi = off2[s, b % bpd], off2[s, b % bpd + 1]
+        rows = tbl3[s, lo:hi]
+        match = rows[(rows[:, 0] == k[i]) & (rows[:, 1] == payload[i])]
+        assert match.shape[0] == 1, i
+        seen.append(int(match[0, 1]))
+    assert sorted(seen) == list(range(n))
