@@ -637,6 +637,9 @@ class DeviceEngine:
         )
         #: flat-kernel cache: (slots tuple, FlatMeta) → jitted fn
         self._flat_fns: Dict[Any, Any] = {}
+        #: context-free qctx singletons (host + device forms)
+        self._empty_qctx_np: Optional[Dict[str, np.ndarray]] = None
+        self._empty_qctx_jnp = None
 
     #: every per-edge/lookup column _host_arrays emits (the sharded engine
     #: derives its shard_map specs from this — keep in lockstep, enforced
@@ -915,15 +918,23 @@ class DeviceEngine:
     def _encode_query_contexts(
         self, ctx_rows: List[Mapping], strings: Optional[Dict[str, int]]
     ) -> Dict[str, np.ndarray]:
-        """Encode deduped request contexts into padded qctx tables."""
+        """Encode deduped request contexts into padded qctx tables.  The
+        context-free case (most checks) returns a per-engine singleton so
+        dispatch paths can cache its device form — 4 of the ~12 small
+        host→device puts a small-batch check pays."""
+        if not ctx_rows and self._empty_qctx_np is not None:
+            return self._empty_qctx_np
         if self.caveat_plan is None:
             P = 1
-            return {
+            out = {
                 "vi": np.zeros((1, P), np.int32),
                 "vf": np.zeros((1, P), np.float32),
                 "pr": np.zeros((1, P), bool),
                 "host": np.zeros((1, 1), bool),
             }
+            if not ctx_rows:
+                self._empty_qctx_np = out
+            return out
         table = encode_contexts(
             self.caveat_plan, ctx_rows,
             strings if strings is not None else dict(self.caveat_plan.base_strings),
@@ -936,12 +947,27 @@ class DeviceEngine:
             out[: a.shape[0]] = a
             return out
 
-        return {
+        out = {
             "vi": padrows(table.vi),
             "vf": padrows(table.vf),
             "pr": padrows(table.present),
             "host": padrows(table.host),
         }
+        if not ctx_rows:
+            self._empty_qctx_np = out
+        return out
+
+    def _qctx_device(self, qctx: Dict[str, np.ndarray]):
+        """Device form of the qctx tables, cached for the context-free
+        singleton (checks without request context skip 4 host→device
+        transfers per dispatch)."""
+        if qctx is self._empty_qctx_np:
+            if self._empty_qctx_jnp is None:
+                self._empty_qctx_jnp = {
+                    k: jnp.asarray(v) for k, v in qctx.items()
+                }
+            return self._empty_qctx_jnp
+        return {k: jnp.asarray(v) for k, v in qctx.items()}
 
     # -- flat-kernel plumbing (engine/flat.py) ---------------------------
     #: bound on cached per-permission-subset kernels (simple FIFO eviction:
@@ -1012,7 +1038,7 @@ class DeviceEngine:
             padq(queries["q_subj"], -1), padq(q_srel1, 0),
             padq(queries["q_wc"], -1), padq(queries["q_ctx"], -1),
             padq(queries["q_self"], False),
-            {k: jnp.asarray(v) for k, v in qctx.items()},
+            self._qctx_device(qctx),
         )
         return fn, args
 
@@ -1087,7 +1113,7 @@ class DeviceEngine:
             padq(queries["q_subj"], -1), padq(queries["q_srel"], -1),
             padq(queries["q_wc"], -1), padq(queries["q_row"], 0),
             padq(queries["q_self"], False), padq(queries["q_ctx"], -1),
-            {k: jnp.asarray(v) for k, v in qctx.items()},
+            self._qctx_device(qctx),
         )
         # one device→host fetch for all three planes: separate np.asarray
         # calls round-trip the dispatch boundary once each, which dominates
@@ -1204,7 +1230,7 @@ class DeviceEngine:
             padq(q_srel, -1), padq(q_wc, -1),
             padq(q_row.astype(np.int32), 0),
             padq(q_self, False), padq(q_ctx, -1),
-            {k: jnp.asarray(v) for k, v in qctx.items()},
+            self._qctx_device(qctx),
         )
         if not fetch:
             return d, p, ovf
