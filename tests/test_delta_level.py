@@ -375,3 +375,71 @@ def test_delta_level_sharded():
                 f"rev {revision} overflow differs for {q}"
             )
         sh_prev = sh_inc
+
+
+def test_delta_level_long_chain_stays_stable():
+    """A 40-revision chained delta stream: the compiled-kernel cache must
+    stay bounded (stable FlatMeta across revisions), the accumulated
+    overlay must keep answering exactly, and a final compaction-sized
+    burst must fold back into a fresh base."""
+    rng, rels, cs, interner, snap, engine, dsnap = _prep(seed=3)
+    base_meta = dsnap.flat_meta
+    metas = set()
+    incr = 0
+    py = random.Random(5)
+    for revision in range(2, 42):
+        adds = [
+            rel.must_from_triple(
+                f"doc:d{py.randrange(10)}", "reader", f"user:lc{revision}"
+            )
+        ]
+        deletes = []
+        if revision % 5 == 0:
+            deletes = [
+                rel.must_from_triple(
+                    f"doc:d{py.randrange(10)}", "reader",
+                    f"user:lc{revision - 1}",
+                )
+            ]
+        snap = apply_delta(snap, revision, adds, deletes, interner=interner)
+        dsnap = engine.prepare(snap, prev=dsnap)
+        # fresh nodes eventually outgrow the packing radix on this tiny
+        # world — the occasional full rebuild re-bases the chain
+        incr += int(dsnap.flat_meta.delta is not None)
+        metas.add(dsnap.flat_meta)
+        d, _, _ = engine.check_batch(
+            dsnap,
+            [rel.must_from_triple("doc:d1", "read", f"user:lc{revision}")]
+            if adds[0].resource_id == "d1"
+            else [
+                rel.must_from_triple(
+                    f"doc:{adds[0].resource_id}", "read", f"user:lc{revision}"
+                )
+            ],
+            now_us=NOW,
+        )
+        assert bool(d[0])
+    # delta-table shape buckets keep the distinct-meta count (≈ compiled
+    # kernels) far below the revision count, and the chain stays
+    # overwhelmingly incremental (one radix rebuild allowed)
+    assert len(metas) <= 10, len(metas)
+    assert incr >= 38, incr
+    assert len(engine._flat_fns) <= engine.FLAT_FN_CACHE_MAX
+    # final parity check vs a full prepare
+    checks = make_checks(rng, 10, 12, n=40)
+    _assert_parity(engine, dsnap, engine.prepare(snap), checks)
+    # compaction burst: enough rows to cross max(flat_delta_min_compact,
+    # E/8) folds back into a fresh base (delta=None) that still answers
+    big = [
+        rel.must_from_triple(f"doc:d{i % 10}", "reader", f"user:burst{i}")
+        for i in range(70_000)
+    ]
+    snap = apply_delta(snap, 42, big, [], interner=interner)
+    dsnap = engine.prepare(snap, prev=dsnap)
+    assert dsnap.flat_meta.delta is None
+    d, _, _ = engine.check_batch(
+        dsnap, [rel.must_from_triple("doc:d1", "read", "user:burst1")],
+        now_us=NOW,
+    )
+    assert bool(d[0])
+    assert base_meta is not None
