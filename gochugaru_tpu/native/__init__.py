@@ -100,6 +100,11 @@ def _load() -> Optional[ctypes.CDLL]:
             c.c_void_p, c.c_int64, c.c_char_p, c.c_int64, c.POINTER(c.c_int32),
         ]
         lib.gi_key.restype = c.c_int64
+        lib.gi_keys_batch.argtypes = [
+            c.c_void_p, c.POINTER(c.c_int64), c.c_int64, c.c_char_p,
+            c.c_int64, c.POINTER(c.c_int64), c.POINTER(c.c_int32),
+        ]
+        lib.gi_keys_batch.restype = c.c_int64
         for name in ("gi_lexsort4",):
             fn = getattr(lib, name)
             fn.argtypes = [

@@ -175,6 +175,36 @@ int64_t gi_key(void* h, int64_t node, char* out_str, int64_t cap,
   return e.len;
 }
 
+// Batched keys: concatenated id bytes of n nodes into out_buf (cap bytes),
+// with out_offsets (n+1 entries, offsets[0] = 0) and out_types (n).
+// Returns the total byte length needed — when it exceeds cap, nothing is
+// written beyond what fits and the caller must retry with a bigger buffer.
+// Invalid nodes get length 0 and type -1.
+int64_t gi_keys_batch(void* h, const int64_t* nodes, int64_t n,
+                      char* out_buf, int64_t cap, int64_t* out_offsets,
+                      int32_t* out_types) {
+  Interner* in = static_cast<Interner*>(h);
+  const int64_t sz = static_cast<int64_t>(in->entries.size());
+  int64_t total = 0;
+  out_offsets[0] = 0;
+  for (int64_t i = 0; i < n; i++) {
+    int64_t node = nodes[i];
+    if (node < 0 || node >= sz) {
+      out_types[i] = -1;
+      out_offsets[i + 1] = total;
+      continue;
+    }
+    const Entry& e = in->entries[node];
+    out_types[i] = e.type;
+    if (total + e.len <= cap) {
+      std::memcpy(out_buf + total, in->arena.data() + e.off, e.len);
+    }
+    total += e.len;
+    out_offsets[i + 1] = total;
+  }
+  return total;
+}
+
 // Parallel lexsort by (a, b, c, d) — the snapshot's primary order
 // (rel, res, subj, srel1).  Writes the permutation into out (int64[n]).
 // Keys are packed into (hi, lo) uint64 pairs: hi = a<<32 | b-as-unsigned,

@@ -118,6 +118,45 @@ class NativeInterner:
     def __len__(self) -> int:
         return int(self._lib.gi_size(self._h))
 
+    def keys_batch(self, nodes) -> List[Tuple[str, str]]:
+        """(type, id) pairs for an int array of nodes in ONE native call
+        (plus a retry when the id bytes outgrow the buffer guess) — the
+        batched decode path behind snapshot exports."""
+        nn = np.ascontiguousarray(nodes, np.int64)
+        n = int(nn.shape[0])
+        if n == 0:
+            return []
+        offs = np.empty(n + 1, np.int64)
+        types = np.empty(n, np.int32)
+        cap = max(32 * n, 4096)
+        # under the lock: concurrent interning may reallocate the C++
+        # entry/arena vectors mid-copy (the Python Interner's lock-free
+        # read contract does not transfer to std::vector)
+        with self._lock:
+            while True:
+                buf = ctypes.create_string_buffer(cap)
+                total = int(self._lib.gi_keys_batch(
+                    self._h,
+                    nn.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    ctypes.c_int64(n), buf, ctypes.c_int64(cap),
+                    offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    types.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                ))
+                if total <= cap:
+                    break
+                cap = total
+        raw = buf.raw
+        tn = self._type_names
+        o = offs.tolist()
+        tl = types.tolist()
+        out = []
+        for i in range(n):
+            t = tl[i]
+            if t < 0:  # C++ invalid-node sentinel — match key_of's raise
+                raise IndexError(f"unknown node {int(nn[i])}")
+            out.append((tn[t], raw[o[i] : o[i + 1]].decode("utf-8")))
+        return out
+
     @property
     def num_types(self) -> int:
         return len(self._type_names)

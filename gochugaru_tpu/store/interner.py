@@ -73,6 +73,13 @@ class Interner:
     def key_of(self, node: int) -> Tuple[str, str]:
         return self._keys[node]
 
+    def keys_batch(self, nodes) -> List[Tuple[str, str]]:
+        """(type, id) pairs for an int array of nodes — the batched
+        decode path (snapshot exports).  Reads race-safely without the
+        lock: the list is append-only and CPython appends are atomic."""
+        k = self._keys
+        return [k[n] for n in np.asarray(nodes).tolist()]
+
     def __len__(self) -> int:
         return len(self._keys)
 
