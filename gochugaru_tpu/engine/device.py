@@ -32,7 +32,6 @@ XLA program, traced once per (schema, config, shape bucket).
 
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 

@@ -37,7 +37,6 @@ from ..engine.device import (
     _ceil_pow2,
     _make_check_fn,
     _pad_payload,
-    _pad_sorted,
 )
 from ..engine.plan import EngineConfig
 from ..rel.relationship import Relationship
