@@ -190,6 +190,19 @@ class FlatMeta:
     #: recursive folder tree of depth 4 compiles 4 levels, not the full
     #: flat_recursion budget.  Pow2-bucketed for delta stability
     ar_data_depth: int = -1
+    #: permission fold (engine/fold.py P-index): (type_name, perm_slot)
+    #: pairs whose BASE evaluation is the pf_e/pf_t probe pair — their
+    #: programs compile to nothing when no delta level rides the base
+    #: (a delta reverts to the walked program, which keeps add/tombstone
+    #: semantics exact without incremental fold maintenance)
+    fold_pairs: Tuple[Tuple[str, int], ...] = ()
+    pf_e_cap: int = 4
+    pf_t_cap: int = 4
+    pf_hascav: bool = False
+    pf_hasuntil: bool = False
+    pf_haswc: bool = False
+    pf_has_e: bool = False
+    pf_has_t: bool = False
 
 
 def _gate_cols(hascav: bool, hasexp: bool) -> list:
@@ -489,7 +502,7 @@ def _tindex_join(snap, config: EngineConfig, cl, us_gk, cl_k1, cl_k2, pus_k, S1)
     carry no caveats and no permission-valued subjects, {edge expiry ×
     closure semiring} folds into ONE (slot·N+res, member-key) →
     until-values table."""
-    from ..store.closure import NO_EXP, _expand_join
+    from ..store.closure import NO_EXP
 
     if not (config.flat_tindex and snap.us_rel.shape[0]):
         return None
@@ -505,8 +518,8 @@ def _tindex_join(snap, config: EngineConfig, cl, us_gk, cl_k1, cl_k2, pus_k, S1)
     elig = ~np.isin(snap.us_rel, bad_slots)
     if not elig.any():
         return None
-    tgt = cl_k2
-    t_order = np.argsort(tgt, kind="stable")
+    from .fold import t_join_core
+
     pe = pe_all[elig]
     ek1 = us_gk[elig]
     w = np.where(
@@ -514,34 +527,13 @@ def _tindex_join(snap, config: EngineConfig, cl, us_gk, cl_k1, cl_k2, pus_k, S1)
         snap.us_exp[elig].astype(np.int64),
     ).astype(np.int32)
     cap_rows = config.flat_tindex_factor * max(int(snap.us_rel.shape[0]), 1024)
-    # size the join BEFORE materializing it: a popular group with a huge
-    # closure in-degree must disable the index, not OOM
-    tgt_sorted = tgt[t_order]
-    join_rows = int(
-        (
-            np.searchsorted(tgt_sorted, pe, "right")
-            - np.searchsorted(tgt_sorted, pe, "left")
-        ).sum()
+    got = t_join_core(
+        ek1, pe, w, cl_k1, cl_k2, cl.c_d_until, cl.c_p_until, cap_rows
     )
-    if join_rows + pe.shape[0] > cap_rows:
+    if got is None:
         return None
-    reps, ii = _expand_join(tgt_sorted, pe)
-    jj = t_order[ii]
-    T_k1 = np.concatenate([ek1, ek1[reps]])
-    T_k2 = np.concatenate([pe, cl_k1[jj]])
-    T_d = np.concatenate([w, np.minimum(w[reps], cl.c_d_until[jj])])
-    T_p = np.concatenate([w, np.minimum(w[reps], cl.c_p_until[jj])])
-    o2 = np.lexsort((T_k2, T_k1))
-    T_k1, T_k2 = T_k1[o2], T_k2[o2]
-    T_d, T_p = T_d[o2], T_p[o2]
-    first = np.ones(T_k1.shape[0], bool)
-    first[1:] = (T_k1[1:] != T_k1[:-1]) | (T_k2[1:] != T_k2[:-1])
-    st = np.nonzero(first)[0]
-    T_k1, T_k2 = T_k1[first], T_k2[first]
-    T_d = np.maximum.reduceat(T_d, st)
-    T_p = np.maximum.reduceat(T_p, st)
     return (
-        T_k1, T_k2, T_d, T_p,
+        *got,
         tuple(int(s) for s in np.unique(snap.us_rel[elig])),
         bad_slots.size == 0,
     )
@@ -587,7 +579,7 @@ def build_flat_arrays(
     padded host arrays (merged into DeviceSnapshot.arrays) and the static
     FlatMeta — or None when keys don't pack into int32 (num_nodes ·
     num_slots ≥ 2³¹; such graphs use the legacy engine)."""
-    from ..store.closure import NEVER, build_closure
+    from ..store.closure import NEVER, NO_EXP, build_closure
 
     radix = _node_radix(snap)
     if radix is None:
@@ -737,9 +729,50 @@ def build_flat_arrays(
 
     wc_nodes = snap.wildcard_node_of_type[snap.wildcard_node_of_type >= 0]
 
+    # ---- permission fold (P-index): rewrites → root-level tables -------
+    fold_kw: Dict = {}
+    if BS and plan is not None:
+        from .fold import fold_permissions, fold_tindex_join
+
+        fr = fold_permissions(snap, config, plan, cl)
+        tj2 = (
+            fold_tindex_join(fr, cl, N, S1, config.flat_tindex_factor)
+            if fr is not None
+            else None
+        )
+        if fr is not None and tj2 is not None:
+            pf_k1 = _pack(fr.e_slot, N, fr.e_res)
+            pf_hascav = bool((fr.e_cav != 0).any())
+            pf_hasuntil = bool((fr.e_until != NO_EXP).any())
+            pfh = build_hash([pf_k1, fr.e_k2])
+            out["pfh_off"] = pfh.off
+            out["pfx"] = interleave_buckets(
+                pfh,
+                [pf_k1, fr.e_k2]
+                + ([fr.e_cav, fr.e_ctx] if pf_hascav else [])
+                + ([fr.e_until] if pf_hasuntil else []),
+            )
+            T2_k1, T2_k2, T2_d, T2_p = tj2
+            pft = build_hash([T2_k1, T2_k2])
+            out["pfth_off"] = pft.off
+            out["pftx"] = interleave_buckets(pft, [T2_k1, T2_k2, T2_d, T2_p])
+            fold_kw = dict(
+                fold_pairs=fr.pairs,
+                pf_e_cap=_round_cap(pfh.cap),
+                pf_t_cap=_round_cap(pft.cap),
+                pf_hascav=pf_hascav,
+                pf_hasuntil=pf_hasuntil,
+                pf_haswc=bool(
+                    np.isin(fr.e_k2.astype(np.int64) // S1, wc_nodes).any()
+                ),
+                pf_has_e=pf_k1.shape[0] > 0,
+                pf_has_t=T2_k1.shape[0] > 0,
+            )
+
     meta = FlatMeta(
         N=N, S1=S1,
         **rc_kw,
+        **fold_kw,
         e_cap=_round_cap(eh.cap), e_n=_ceil_pow2(max(eh.n, 1)),
         usr_cap=_round_cap(usr.index.cap),
         usr_gn=_ceil_pow2(max(usr.index.n, 1)),
@@ -1357,6 +1390,12 @@ def make_flat_fn(
         if ts_slot in rc_geom
     }
     rel_slots = frozenset(plan.rel_leaf_slots)
+    # permission fold: BASE answers come from the pf_e/pf_t probe pair;
+    # folded programs compile to nothing.  Any delta level reverts to the
+    # walked program (fold tables don't see overlay adds/tombstones)
+    fold_on = bool(meta.fold_pairs) and meta.delta is None
+    folded_pairs = frozenset(meta.fold_pairs) if fold_on else frozenset()
+    pf_slots = frozenset(s for _, s in folded_pairs)
     cyclic = _eval_cyclic_pairs(compiled)
     KU = cfg.us_leaf_cap
     K = cfg.arrow_fanout
@@ -1573,7 +1612,86 @@ def make_flat_fn(
         w_k2 = jnp.where((q_wc >= 0) & (q_srel1 == 0), q_wc * S1c, -1)
         wcl_k = jnp.where(q_wcc >= 0, q_wcc * S1c, -1)
         us_fans = dict(meta.us_fanout_by_slot)
-        us_fan_max = max(us_fans.values(), default=0)
+        # the dynamic root leaf serves exactly the dispatch's static slot
+        # set: base sites whose slots can't occur compile to nothing (a
+        # fully folded dispatch is JUST the two pf probes)
+        dyn_e = any(s in meta.e_slots for s in slots)
+        dyn_us_fan = max((us_fans.get(s, 0) for s in slots), default=0)
+        t_cover = meta.has_tindex and all(
+            s in meta.t_slots for s in slots if s in meta.us_slots
+        )
+        dyn_t = meta.has_tindex and t_cover and any(
+            s in meta.t_slots for s in slots
+        )
+
+        pfL = _lay(
+            ["k1", "k2"]
+            + (["cav", "ctx"] if meta.pf_hascav else [])
+            + (["until"] if meta.pf_hasuntil else [])
+        )
+
+        def pf_probe(slot, nodes):
+            """Folded-permission test at a [B, ...] node lattice: ONE
+            direct-identity probe (pf_e) + ONE membership probe (pf_t),
+            the whole rewrite pre-joined at prepare time (engine/fold.py).
+            ``slot=None`` = dynamic (q_perm is the slot).  Fold tables
+            are exact — no fan caps, so no overflow contributions."""
+            nd = nodes.ndim
+            zn = jnp.zeros(nodes.shape, bool)
+            d = p = zn
+            exists = nodes >= 0
+            sc = bq(q_perm, nd) if slot is None else jnp.int32(slot)
+            k1 = sc * Nc + jnp.where(exists, nodes, 0)
+            if meta.pf_has_e:
+                def pe_site(k2q):
+                    blk, mine = pblock(
+                        arrs["pfh_off"], arrs["pfx"], meta.pf_e_cap, (k1, k2q)
+                    )
+                    hit = blk_hit(blk, (k1, k2q), mine) & exists[..., None]
+                    live = hit
+                    if meta.pf_hasuntil:
+                        u = jnp.where(hit, blk[..., pfL["until"]], 0)
+                        live = hit & (u > now)
+                    if not meta.pf_hascav:
+                        hd = hp = live
+                    else:
+                        cav = jnp.where(live, blk[..., pfL["cav"]], 0)
+                        if tri is None:
+                            hd, hp = live & (cav == 0), live
+                        else:
+                            ctxc = jnp.where(live, blk[..., pfL["ctx"]], -1)
+                            qb = jnp.broadcast_to(
+                                bq(q_ctx, cav.ndim), cav.shape
+                            )
+                            t = tri(cav, ctxc, qb, tables)
+                            hd, hp = live & (t == 2), live & (t >= 1)
+                    return (
+                        por(jnp.any(hd, axis=-1)), por(jnp.any(hp, axis=-1))
+                    )
+
+                ed, ep = pe_site(bq(q_k2, nd))
+                d, p = d | ed, p | ep
+                if meta.pf_haswc:
+                    wd, wp = pe_site(bq(w_k2, nd))
+                    d, p = d | wd, p | wp
+            if meta.pf_has_t:
+                def pt_site(k2q):
+                    blk, mine = pblock(
+                        arrs["pfth_off"], arrs["pftx"], meta.pf_t_cap,
+                        (k1, k2q),
+                    )
+                    hit = blk_hit(blk, (k1, k2q), mine) & exists[..., None]
+                    return (
+                        por(jnp.any(hit & (blk[..., 2] > now), axis=-1)),
+                        por(jnp.any(hit & (blk[..., 3] > now), axis=-1)),
+                    )
+
+                td, tp = pt_site(bq(q_k2, nd))
+                d, p = d | td, p | tp
+                if meta.has_wc_closure:
+                    wtd, wtp = pt_site(bq(wcl_k, nd))
+                    d, p = d | wtd, p | wtp
+            return d, p
 
         # Every eval function returns (definite, possible, ovf, used):
         # d/p shaped like the node lattice, ovf/used reduced to [B].
@@ -1596,7 +1714,7 @@ def make_flat_fn(
             # by `exists` wherever the (possibly aliased) probe lands
             k1 = sc * Nc + jnp.where(exists, nodes, 0)
 
-            run_e = bool(meta.e_slots) if dyn else (slot in meta.e_slots)
+            run_e = dyn_e if dyn else (slot in meta.e_slots)
             run_ed = dm is not None and dm.has_adds and (
                 bool(dm.e_slots) if dyn else (slot in dm.e_slots)
             )
@@ -1654,8 +1772,8 @@ def make_flat_fn(
                     d, p = d | wd, p | wp
 
             # T-index fast path: one probe folds {userset edge × closure}
-            use_t = meta.has_tindex and (
-                meta.t_all if dyn else (slot in meta.t_slots)
+            use_t = dyn_t if dyn else (
+                meta.has_tindex and slot in meta.t_slots
             )
             if use_t:
                 def t_site(k2q):
@@ -1781,10 +1899,10 @@ def make_flat_fn(
             # (the forced pass replaces voided T answers)
             run_ku = (
                 (not use_t)
-                or (dyn and not meta.t_all)
+                or (dyn and not t_cover)
                 or (dm is not None and dm.t_dirty)
             )
-            KU_site = min(KU, us_fan_max if dyn else us_fans.get(slot, 0))
+            KU_site = min(KU, dyn_us_fan if dyn else us_fans.get(slot, 0))
             if run_ku and KU_site > 0 and BS:
                 ublk, valid, over = ku_fetch("usr", meta.usr_cap, KU_site)
                 ovf = ovf | over
@@ -1858,7 +1976,7 @@ def make_flat_fn(
             progs = [
                 (tname, tid, expr)
                 for (tname, tid, expr) in perm_programs.get(slot, ())
-                if tname in types
+                if tname in types and (tname, slot) not in folded_pairs
             ]
             if progs:
                 ntype = jnp.where(
@@ -1952,6 +2070,11 @@ def make_flat_fn(
             d, p, ovf, used = zn, zn, zB, zB
             if slot in rel_slots:
                 d, p, ovf, used = leaf(slot, nodes)
+            if slot in pf_slots:
+                # folded permission reached as an arrow target / ref from
+                # an unfolded program: its base answer is the probe pair
+                fd, fp = pf_probe(slot, nodes)
+                d, p = d | fd, p | fp
             pd, pp, po, pu = eval_progs(slot, nodes, stack, types, ar_hops)
             d, p = d | pd, p | pp
             ovf, used = ovf | po, used | pu
@@ -2109,6 +2232,11 @@ def make_flat_fn(
             ovf_out = lovf | (q_cl_ovf & lused)
         else:
             d_out, p_out, ovf_out = zB, zB, zB
+        if fold_on and any(s in pf_slots for s in slots):
+            # one dynamic pf site answers every folded permission in the
+            # dispatch — for a fully folded slot set this IS the kernel
+            fd, fp = pf_probe(None, q_res)
+            d_out, p_out = d_out | fd, p_out | fp
         for slot in slots:
             if not perm_programs.get(slot):
                 continue

@@ -1,0 +1,447 @@
+"""Permission folding (P-index): whole union-of-{leaf, arrow-chain}
+rewrites flattened into root-level probe tables at prepare time.
+
+The flat kernel (engine/flat.py) removed per-query *loops*; this layer
+removes per-query *levels*.  A `document#view = viewer + folder->view`
+check still walks the doc→folder→…→root lattice at trace time, paying an
+e-probe + T-probe + arrow-range per level — ~20 dependent gathers into
+multi-GB tables for BASELINE config 3's 5-hop world.  Folding joins the
+rewrite's arrow chains into the leaf rows once per revision, so the same
+check is ONE direct-identity probe (pf_e) plus ONE membership probe
+(pf_t), regardless of depth — the full Leopard construction: resource-
+side ancestor flattening ⋈ userset edges ⋈ the member closure
+(store/closure.py), with expiries folded along paths through the same
+max-min two-plane semiring.
+
+Eligibility is per (type, permission): the program must be a union tree
+over relation leaves, same-type folded permissions, and arrows through
+caveat-free tuplesets whose targets are relations or already-folded
+permissions (self-recursive hierarchies go through the ancestor closure
+of engine/flat.py:_arrow_closure; mutual cross-type recursion stays on
+the walked path).  Direct rows keep their caveat/ctx columns (the CEL VM
+gates them at the probe site); userset rows under the fold must be
+caveat-free and not permission-valued — the same bar the T-index sets.
+
+Folded tables serve BASE data only.  A Watch-delta level rides on the
+unfolded walk (engine/flat.py compiles the full program when a delta is
+present), which keeps add/tombstone semantics exact without Leopard's
+incremental-maintenance machinery; compaction re-folds.
+
+Replaces the server-side evaluation behind the reference's
+CheckBulkPermissions (/root/reference/client/client.go:238-266) for the
+deep-nesting worlds where the walked kernel was 20× off its target.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..schema.compiler import CompiledSchema
+from ..store.closure import NO_EXP, _expand_join
+from .plan import DevicePlan, EngineConfig, ExprIR
+
+
+@dataclass
+class _Rows:
+    """Folded rows of one (type, permission): direct-identity rows (the
+    pf_e side; caveats ride along) and userset rows (the pf_t side;
+    caveat-free by eligibility).  ``until`` is epoch-relative seconds
+    with NO_EXP = never expires — the min over the path's arrow/leaf
+    expiries."""
+
+    e_res: np.ndarray
+    e_k2: np.ndarray
+    e_cav: np.ndarray
+    e_ctx: np.ndarray
+    e_until: np.ndarray
+    u_res: np.ndarray
+    u_subj: np.ndarray
+    u_srel: np.ndarray
+    u_until: np.ndarray
+
+    @property
+    def total(self) -> int:
+        return int(self.e_res.shape[0] + self.u_res.shape[0])
+
+
+def _empty_rows() -> _Rows:
+    z = np.zeros(0, np.int32)
+    return _Rows(z, z, z, z, z, z, z, z, z)
+
+
+def _concat_rows(parts: List[_Rows]) -> _Rows:
+    if not parts:
+        return _empty_rows()
+    return _Rows(*(
+        np.concatenate([getattr(p, f) for p in parts])
+        for f in ("e_res", "e_k2", "e_cav", "e_ctx", "e_until",
+                  "u_res", "u_subj", "u_srel", "u_until")
+    ))
+
+
+def _until_of(exp: np.ndarray) -> np.ndarray:
+    return np.where(exp == 0, np.int64(NO_EXP), exp.astype(np.int64)).astype(
+        np.int32
+    )
+
+
+def _dedup_rows(r: _Rows) -> _Rows:
+    """Max-until dedup per identity: folding through multiple paths keeps
+    the most permissive admissibility, exactly like the closure's
+    group_max."""
+    if r.e_res.shape[0]:
+        o = np.lexsort((r.e_ctx, r.e_cav, r.e_k2, r.e_res))
+        er, ek, ec, ex, eu = (
+            r.e_res[o], r.e_k2[o], r.e_cav[o], r.e_ctx[o], r.e_until[o]
+        )
+        first = np.ones(er.shape[0], bool)
+        first[1:] = (
+            (er[1:] != er[:-1]) | (ek[1:] != ek[:-1])
+            | (ec[1:] != ec[:-1]) | (ex[1:] != ex[:-1])
+        )
+        st = np.nonzero(first)[0]
+        er, ek, ec, ex = er[first], ek[first], ec[first], ex[first]
+        eu = np.maximum.reduceat(eu, st)
+    else:
+        er, ek, ec, ex, eu = (r.e_res,) * 5
+    if r.u_res.shape[0]:
+        o = np.lexsort((r.u_srel, r.u_subj, r.u_res))
+        ur, us, ul, uu = r.u_res[o], r.u_subj[o], r.u_srel[o], r.u_until[o]
+        first = np.ones(ur.shape[0], bool)
+        first[1:] = (
+            (ur[1:] != ur[:-1]) | (us[1:] != us[:-1]) | (ul[1:] != ul[:-1])
+        )
+        st = np.nonzero(first)[0]
+        ur, us, ul = ur[first], us[first], ul[first]
+        uu = np.maximum.reduceat(uu, st)
+    else:
+        ur, us, ul, uu = (r.u_res,) * 4
+    return _Rows(er, ek, ec, ex, eu, ur, us, ul, uu)
+
+
+def _lift(rows: _Rows, src: np.ndarray, dst: np.ndarray,
+          p_until: np.ndarray) -> _Rows:
+    """Re-key ``rows`` through join pairs (src → dst): every row at
+    res == dst lifts to res = src with until min'd against the pair's
+    path admissibility.  Both row sets must be sorted by res."""
+    out_parts: List[_Rows] = []
+    if rows.e_res.shape[0] and src.shape[0]:
+        reps, ii = _expand_join(rows.e_res, dst)
+        if reps.shape[0]:
+            out_parts.append(_Rows(
+                src[reps], rows.e_k2[ii], rows.e_cav[ii], rows.e_ctx[ii],
+                np.minimum(rows.e_until[ii], p_until[reps]),
+                *(np.zeros(0, np.int32),) * 4,
+            ))
+    if rows.u_res.shape[0] and src.shape[0]:
+        reps, ii = _expand_join(rows.u_res, dst)
+        if reps.shape[0]:
+            out_parts.append(_Rows(
+                *(np.zeros(0, np.int32),) * 5,
+                src[reps], rows.u_subj[ii], rows.u_srel[ii],
+                np.minimum(rows.u_until[ii], p_until[reps]),
+            ))
+    return _concat_rows(out_parts)
+
+
+def _sorted_by_res(r: _Rows) -> _Rows:
+    oe = np.argsort(r.e_res, kind="stable")
+    ou = np.argsort(r.u_res, kind="stable")
+    return _Rows(
+        r.e_res[oe], r.e_k2[oe], r.e_cav[oe], r.e_ctx[oe], r.e_until[oe],
+        r.u_res[ou], r.u_subj[ou], r.u_srel[ou], r.u_until[ou],
+    )
+
+
+@dataclass
+class FoldResult:
+    """Folded rows keyed ready for table build: pf_e identity rows and
+    pf_u userset rows, both carrying the owning permission slot."""
+
+    e_slot: np.ndarray
+    e_res: np.ndarray
+    e_k2: np.ndarray
+    e_cav: np.ndarray
+    e_ctx: np.ndarray
+    e_until: np.ndarray
+    u_slot: np.ndarray
+    u_res: np.ndarray
+    u_subj: np.ndarray
+    u_srel: np.ndarray
+    u_until: np.ndarray
+    #: the folded (type_name, perm_slot) pairs — the kernel skips these
+    #: programs when no delta level is present
+    pairs: Tuple[Tuple[str, int], ...]
+
+
+def _union_leaves(expr: ExprIR) -> Optional[List[ExprIR]]:
+    """Flatten a union tree to its leaves; None when the tree contains
+    intersection/exclusion (ineligible for folding)."""
+    tag = expr[0]
+    if tag == "union":
+        out: List[ExprIR] = []
+        for c in expr[1]:
+            got = _union_leaves(c)
+            if got is None:
+                return None
+            out.extend(got)
+        return out
+    if tag in ("ref", "arrow", "nil"):
+        return [expr]
+    return None
+
+
+def fold_permissions(
+    snap, config: EngineConfig, plan: DevicePlan, cl
+) -> Optional[FoldResult]:
+    """Fold every eligible (type, permission) of the snapshot's schema.
+    Returns None when folding is disabled, inapplicable, or over budget
+    (the walked kernel answers those worlds exactly as before)."""
+    if not config.flat_fold or not plan.topo_programs:
+        return None
+    if cl.ovf_src.shape[0]:
+        # overflowed closure sources make the T-side incomplete; the
+        # walked path flags affected queries per site — folding can't
+        return None
+    compiled: CompiledSchema = snap.compiled
+    S1 = snap.num_slots + 1
+
+    # slot-granular userset eligibility, the T-index's bar: caveated /
+    # permission-valued rows and rows whose group may extend through a
+    # permission chain (pus) can't fold into an until-only table
+    bad_us = (snap.us_caveat != 0) | (snap.us_perm != 0)
+    if snap.pus_n.shape[0]:
+        pus_k = np.sort(snap.pus_n.astype(np.int64) * S1 + snap.pus_r + 1)
+        uk = snap.us_subj.astype(np.int64) * S1 + snap.us_srel + 1
+        pos = np.clip(np.searchsorted(pus_k, uk), 0, pus_k.shape[0] - 1)
+        bad_us |= pus_k[pos] == uk
+    bad_rel_slots = set(np.unique(snap.us_rel[bad_us]).tolist())
+    cav_ts_slots = set(np.unique(snap.ar_rel[snap.ar_caveat != 0]).tolist())
+
+    # interner type id per schema type (node_type holds interner ids)
+    itid: Dict[str, int] = {
+        t: snap.interner.type_lookup(t) for t in compiled.type_ids
+    }
+    ntype = snap.node_type
+    e_type = ntype[np.clip(snap.e_res, 0, max(snap.num_nodes - 1, 0))]
+    us_type = ntype[np.clip(snap.us_res, 0, max(snap.num_nodes - 1, 0))]
+    ar_type = ntype[np.clip(snap.ar_res, 0, max(snap.num_nodes - 1, 0))]
+    ar_ctype = ntype[np.clip(snap.ar_child, 0, max(snap.num_nodes - 1, 0))]
+
+    rel_leaf = frozenset(plan.rel_leaf_slots)
+    budget = config.flat_fold_factor * max(
+        int(snap.e_rel.shape[0] + snap.us_rel.shape[0]), 4096
+    )
+    spent = 0
+
+    def leaf_rows(tname: str, rel_slot: int) -> Optional[_Rows]:
+        if rel_slot in bad_rel_slots:
+            return None
+        tid = itid[tname]
+        m = (snap.e_rel == rel_slot) & (e_type == tid)
+        e_k2 = (
+            snap.e_subj[m].astype(np.int64) * S1 + snap.e_srel1[m]
+        ).astype(np.int32)
+        mu = (snap.us_rel == rel_slot) & (us_type == tid)
+        return _Rows(
+            snap.e_res[m], e_k2, snap.e_caveat[m], snap.e_ctx[m],
+            _until_of(snap.e_exp[m]),
+            snap.us_res[mu], snap.us_subj[mu], snap.us_srel[mu],
+            _until_of(snap.us_exp[mu]),
+        )
+
+    def arrow_pairs(tname: str, ts_slot: int):
+        """(src, dst, p_until) arrow rows of ``tname`` under ``ts_slot``,
+        sorted by dst for _lift."""
+        m = (snap.ar_rel == ts_slot) & (ar_type == itid[tname]) & (
+            snap.ar_child >= 0
+        )
+        src, dst = snap.ar_res[m], snap.ar_child[m]
+        p_until = _until_of(snap.ar_exp[m])
+        o = np.argsort(dst, kind="stable")
+        return src[o], dst[o], p_until[o]
+
+    folded: Dict[Tuple[str, int], _Rows] = {}
+    name_of_slot = {v: k for k, v in compiled.slot_of_name.items()}
+
+    for (tname, tid, slot, expr) in plan.topo_programs:
+        leaves = _union_leaves(expr)
+        if leaves is None:
+            continue
+        ct = compiled.types[compiled.type_ids[tname]]
+        tid_i = itid[tname]
+        parts: List[_Rows] = []
+        self_ts: Optional[int] = None
+        ok = True
+        for child in leaves:
+            tag = child[0]
+            if tag == "nil":
+                continue
+            if tag == "ref":
+                # slots are per-NAME: the same slot can be a relation on
+                # one type and a permission on another — resolve against
+                # THIS type's definition
+                s = child[1]
+                sname = name_of_slot.get(s, "")
+                if sname in compiled.schema.definitions[tname].relations:
+                    got = leaf_rows(tname, s)
+                elif (tname, s) in folded:
+                    got = folded[(tname, s)]
+                else:
+                    got = None
+                if got is None:
+                    ok = False
+                    break
+                parts.append(got)
+                continue
+            # arrow
+            ts_slot = plan.ts_slots[child[1]]
+            right = child[2]
+            if ts_slot in cav_ts_slots:
+                ok = False
+                break
+            relation = ct.relations.get(ts_slot)
+            if relation is None:
+                continue  # no such tupleset on this type: contributes ∅
+            if any(a.relation_slot >= 0 or a.wildcard for a in relation.allowed):
+                # arrows traverse direct subjects only; userset/wildcard
+                # tupleset subjects keep the walked path
+                ok = False
+                break
+            child_types = {ct2 for a in relation.allowed
+                           for ct2 in (compiled.types[a.type_id].name,)}
+            if right == slot and child_types == {tname}:
+                if self_ts is not None and self_ts != ts_slot:
+                    ok = False  # two distinct self-recursive tuplesets
+                    break
+                self_ts = ts_slot
+                continue
+            src, dst, p_until = arrow_pairs(tname, ts_slot)
+            for c_t in sorted(child_types):
+                c_has_rel = (
+                    right in rel_leaf
+                    and name_of_slot.get(right)
+                    in compiled.schema.definitions[c_t].relations
+                )
+                if c_has_rel:
+                    got = leaf_rows(c_t, right)
+                elif (c_t, right) in folded:
+                    got = folded[(c_t, right)]
+                elif compiled.schema.definitions[c_t].item(
+                    name_of_slot.get(right, "")
+                ) is None:
+                    continue  # child type lacks the item: contributes ∅
+                else:
+                    got = None
+                if got is None:
+                    ok = False
+                    break
+                parts.append(_lift(_sorted_by_res(got), src, dst, p_until))
+            if not ok:
+                break
+        if not ok:
+            continue
+        rows = _dedup_rows(_concat_rows(parts))
+        if self_ts is not None:
+            from .flat import _arrow_closure  # deferred: flat imports us
+
+            built = _arrow_closure(snap, self_ts)
+            if built is None:
+                continue  # data cycle / over cap: keep the walked path
+            c_src, c_anc, c_d, _c_p = built  # cav-free ts ⇒ d == p
+            # slots are per-NAME: the closure selects by slot only, so
+            # another type sharing the tupleset name contributes pairs
+            # whose SOURCE is not this type — drop them, or folded grants
+            # would leak onto that type's resources under this perm slot
+            tm = ntype[np.clip(c_src, 0, max(snap.num_nodes - 1, 0))] == tid_i
+            c_src, c_anc, c_d = c_src[tm], c_anc[tm], c_d[tm]
+            o = np.argsort(c_anc, kind="stable")
+            rows = _dedup_rows(_concat_rows([
+                rows, _lift(_sorted_by_res(rows), c_src[o], c_anc[o], c_d[o]),
+            ]))
+        if spent + rows.total > budget:
+            continue  # over budget: this pair stays on the walked path
+        spent += rows.total
+        folded[(tname, slot)] = rows
+
+    if not folded:
+        return None
+    pairs = tuple(sorted(folded))
+    return FoldResult(
+        e_slot=np.concatenate([
+            np.full(folded[p].e_res.shape[0], p[1], np.int32) for p in pairs
+        ]),
+        e_res=np.concatenate([folded[p].e_res for p in pairs]),
+        e_k2=np.concatenate([folded[p].e_k2 for p in pairs]),
+        e_cav=np.concatenate([folded[p].e_cav for p in pairs]),
+        e_ctx=np.concatenate([folded[p].e_ctx for p in pairs]),
+        e_until=np.concatenate([folded[p].e_until for p in pairs]),
+        u_slot=np.concatenate([
+            np.full(folded[p].u_res.shape[0], p[1], np.int32) for p in pairs
+        ]),
+        u_res=np.concatenate([folded[p].u_res for p in pairs]),
+        u_subj=np.concatenate([folded[p].u_subj for p in pairs]),
+        u_srel=np.concatenate([folded[p].u_srel for p in pairs]),
+        u_until=np.concatenate([folded[p].u_until for p in pairs]),
+        pairs=pairs,
+    )
+
+
+def t_join_core(
+    k1: np.ndarray, pe: np.ndarray, w: np.ndarray,
+    cl_k1: np.ndarray, cl_k2: np.ndarray,
+    c_d: np.ndarray, c_p: np.ndarray, cap_rows: int,
+) -> Optional[Tuple[np.ndarray, ...]]:
+    """The T-index join shared by the base table (flat.py _tindex_join)
+    and the fold (fold_tindex_join): userset entries (k1, group-key pe,
+    until w) ⋈ closure-by-target, plus the direct group-identity entries,
+    deduped max-per-plane.  Sizes the join BEFORE materializing it;
+    returns None past ``cap_rows`` (a popular group with a huge closure
+    in-degree must disable the index, not OOM)."""
+    t_order = np.argsort(cl_k2, kind="stable")
+    tgt_sorted = cl_k2[t_order]
+    join_rows = int(
+        (
+            np.searchsorted(tgt_sorted, pe, "right")
+            - np.searchsorted(tgt_sorted, pe, "left")
+        ).sum()
+    )
+    if join_rows + pe.shape[0] > cap_rows:
+        return None
+    reps, ii = _expand_join(tgt_sorted, pe)
+    jj = t_order[ii]
+    T_k1 = np.concatenate([k1, k1[reps]])
+    T_k2 = np.concatenate([pe, cl_k1[jj]])
+    T_d = np.concatenate([w, np.minimum(w[reps], c_d[jj])])
+    T_p = np.concatenate([w, np.minimum(w[reps], c_p[jj])])
+    o2 = np.lexsort((T_k2, T_k1))
+    T_k1, T_k2, T_d, T_p = T_k1[o2], T_k2[o2], T_d[o2], T_p[o2]
+    first = np.ones(T_k1.shape[0], bool)
+    first[1:] = (T_k1[1:] != T_k1[:-1]) | (T_k2[1:] != T_k2[:-1])
+    st = np.nonzero(first)[0]
+    return (
+        T_k1[first], T_k2[first],
+        np.maximum.reduceat(T_d, st), np.maximum.reduceat(T_p, st),
+    )
+
+
+def fold_tindex_join(fr: FoldResult, cl, N: int, S1: int,
+                     factor: int) -> Optional[Tuple[np.ndarray, ...]]:
+    """pf_t: folded userset rows ⋈ closure-by-target, plus the direct
+    group-identity entries — the T-index join over the FOLDED rows.
+    Returns (k1, k2, d_until, p_until) or None when over budget (the
+    caller then drops folding; the walk still answers)."""
+    if fr.u_res.shape[0] == 0:
+        z = np.zeros(0, np.int32)
+        return z, z, z, z
+    k1 = (fr.u_slot.astype(np.int64) * N + fr.u_res).astype(np.int32)
+    pe = (fr.u_subj.astype(np.int64) * S1 + fr.u_srel + 1).astype(np.int32)
+    cl_k1 = (cl.c_src.astype(np.int64) * S1 + cl.c_srel1).astype(np.int32)
+    cl_k2 = (cl.c_g.astype(np.int64) * S1 + cl.c_grel + 1).astype(np.int32)
+    return t_join_core(
+        k1, pe, fr.u_until, cl_k1, cl_k2, cl.c_d_until, cl.c_p_until,
+        factor * max(int(pe.shape[0]), 1024),
+    )

@@ -80,6 +80,13 @@ class EngineConfig:
     #: rc_candidates/_arrow_closure): a depth-D folder tree evaluates in
     #: ONE level instead of D unrolled recursion levels
     flat_rc_index: bool = True
+    #: fold whole union/arrow-chain permission rewrites into root-level
+    #: probe tables (engine/fold.py P-index): a 5-hop nested check
+    #: becomes ~2 probes; ineligible shapes keep the walked path
+    flat_fold: bool = True
+    #: folded row budget as a multiple of (E + US) row counts; pairs
+    #: beyond it stay on the walked path
+    flat_fold_factor: int = 16
 
     @staticmethod
     def for_schema(compiled: CompiledSchema, **overrides) -> "EngineConfig":

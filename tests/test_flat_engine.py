@@ -221,9 +221,12 @@ def _deep_chain_world(chain=14, **cfg):
 
 def test_deep_recursion_beyond_budget_falls_back_not_wrong():
     # folder chain deeper than the recursion budget, with the flattened
-    # ancestor index DISABLED: queries needing the deep walk must surface
-    # as possible/overflow (host fallback), and shallow queries stay exact
-    engine, dsnap, oracle, checks = _deep_chain_world(flat_rc_index=False)
+    # ancestor index AND the permission fold DISABLED: queries needing the
+    # deep walk must surface as possible/overflow (host fallback), and
+    # shallow queries stay exact
+    engine, dsnap, oracle, checks = _deep_chain_world(
+        flat_rc_index=False, flat_fold=False
+    )
     d, p, ovf = engine.check_batch(dsnap, checks, now_us=NOW)
     # never a wrong definite
     for i, q in enumerate(checks):
@@ -236,10 +239,26 @@ def test_deep_recursion_beyond_budget_falls_back_not_wrong():
     assert bool(d[2]) == (oracle.check_relationship(checks[2]) == T)
 
 
+def test_deep_recursion_folded_exact_on_device():
+    # with the permission fold (default) and the rc index off, the SAME
+    # deep chain resolves exactly at the root probe pair
+    engine, dsnap, oracle, checks = _deep_chain_world(flat_rc_index=False)
+    assert dsnap.flat_meta.fold_pairs, "permissions should be folded"
+    d, p, ovf = engine.check_batch(dsnap, checks, now_us=NOW)
+    from gochugaru_tpu.engine.oracle import F
+
+    for i, q in enumerate(checks):
+        want = oracle.check_relationship(q)
+        assert not ovf[i]
+        assert bool(d[i]) == (want == T), q
+        assert bool(p[i]) == (want != F), q
+
+
 def test_deep_recursion_flattened_exact_on_device():
-    # with the resource-side Leopard index (default), the SAME deep chain
-    # resolves exactly on device — no host fallback, no overflow
-    engine, dsnap, oracle, checks = _deep_chain_world()
+    # with the resource-side Leopard index and the fold disabled, the
+    # SAME deep chain resolves exactly through the walked rc lattice —
+    # no host fallback, no overflow
+    engine, dsnap, oracle, checks = _deep_chain_world(flat_fold=False)
     assert dsnap.flat_meta.rc_slots, "hierarchy should be flattened"
     d, p, ovf = engine.check_batch(dsnap, checks, now_us=NOW)
     from gochugaru_tpu.engine.oracle import F

@@ -1,0 +1,199 @@
+"""Permission-fold (engine/fold.py P-index) semantics.
+
+Differential coverage for the folded root-probe path: deep nesting
+(config-3 shape), slot-name collisions across types, expiry folding
+along arrow paths, budget/eligibility fallbacks.  The walked kernel and
+the host oracle pin the semantics (reference behavior:
+/root/reference/client/client_test.go:151-186 transitive checks).
+"""
+
+import numpy as np
+
+from gochugaru_tpu import rel
+from gochugaru_tpu.engine.oracle import F, T
+
+from test_device_engine import setup as _setup  # noqa: E402
+from test_flat_engine import world  # noqa: E402
+
+NOW = 1_700_000_000_000_000
+
+DOCS = """
+definition user {}
+definition group { relation member: user | group#member }
+definition folder {
+    relation parent: folder
+    relation viewer: user | group#member
+    permission view = viewer + parent->view
+}
+definition document {
+    relation folder: folder
+    relation viewer: user | group#member
+    permission view = viewer + folder->view
+}
+"""
+
+
+def _docs_world(**cfg):
+    rng = np.random.default_rng(9)
+    rels = []
+    # nested groups g0 ⊇ g1#member ⊇ g2#member …, users at leaves
+    for i in range(7):
+        if i % 4 != 3:
+            rels.append(rel.must_from_tuple(f"group:g{i}#member", f"group:g{i+1}#member"))
+        for u in rng.choice(24, 2, replace=False):
+            rels.append(rel.must_from_tuple(f"group:g{i}#member", f"user:u{u}"))
+    # folder forest, arity 3, depth ~3
+    for i in range(1, 15):
+        rels.append(rel.must_from_tuple(f"folder:f{i}#parent", f"folder:f{(i-1)//3}"))
+    for i in range(15):
+        if i % 2 == 0:
+            rels.append(rel.must_from_tuple(
+                f"folder:f{i}#viewer", f"group:g{int(rng.integers(7))}#member"
+            ))
+        else:
+            rels.append(rel.must_from_tuple(
+                f"folder:f{i}#viewer", f"user:u{int(rng.integers(24))}"
+            ))
+    for d in range(40):
+        rels.append(rel.must_from_tuple(
+            f"document:d{d}#folder", f"folder:f{int(rng.integers(15))}"
+        ))
+        if d % 3 == 0:
+            rels.append(rel.must_from_tuple(
+                f"document:d{d}#viewer", f"group:g{int(rng.integers(7))}#member"
+            ))
+    return world(DOCS, rels, **cfg)
+
+
+def _assert_differential(engine, dsnap, oracle, checks):
+    d, p, ovf = engine.check_batch(dsnap, checks, now_us=NOW)
+    for i, q in enumerate(checks):
+        want = oracle.check_relationship(q)
+        assert not ovf[i], q
+        assert bool(d[i]) == (want == T), q
+        assert bool(p[i]) == (want != F), q
+
+
+def test_fold_differential_docs_world():
+    engine, dsnap, oracle = _docs_world()
+    assert dsnap.flat_meta.fold_pairs, "docs schema should fold"
+    checks = [
+        rel.must_from_triple(f"document:d{d}", "view", f"user:u{u}")
+        for d in range(40)
+        for u in range(0, 24, 3)
+    ] + [
+        rel.must_from_triple(f"folder:f{f}", "view", f"user:u{u}")
+        for f in range(15)
+        for u in range(0, 24, 5)
+    ]
+    _assert_differential(engine, dsnap, oracle, checks)
+
+
+def test_fold_matches_walked_kernel():
+    folded = _docs_world()
+    walked = _docs_world(flat_fold=False)
+    assert not walked[1].flat_meta.fold_pairs
+    checks = [
+        rel.must_from_triple(f"document:d{d}", "view", f"user:u{u}")
+        for d in range(40) for u in range(24)
+    ]
+    fd, fp, fo = folded[0].check_batch(folded[1], checks, now_us=NOW)
+    wd, wp, wo = walked[0].check_batch(walked[1], checks, now_us=NOW)
+    assert (np.asarray(fd) == np.asarray(wd)).all()
+    assert (np.asarray(fp) == np.asarray(wp)).all()
+
+
+SLOT_COLLIDE = """
+definition user {}
+definition folder {
+    relation parent: folder
+    relation viewer: user
+    permission view = viewer + parent->view
+}
+definition document {
+    relation parent: folder
+    relation viewer: user
+    relation banned: user
+    permission view = viewer - banned
+}
+"""
+
+
+def test_fold_slot_collision_no_leak_across_types():
+    # `parent` is ONE slot on two types; document.view is an exclusion
+    # (unfolded) that ignores document.parent entirely.  The folded
+    # folder.view rows must not leak onto document nodes through the
+    # slot-level ancestor closure
+    rels = [
+        rel.must_from_tuple("folder:root#viewer", "user:alice"),
+        rel.must_from_tuple("folder:kid#parent", "folder:root"),
+        rel.must_from_tuple("document:d#parent", "folder:kid"),
+        rel.must_from_tuple("document:d#viewer", "user:bob"),
+        rel.must_from_tuple("document:d2#parent", "folder:kid"),
+        rel.must_from_tuple("document:d2#viewer", "user:bob"),
+        rel.must_from_tuple("document:d2#banned", "user:bob"),
+    ]
+    engine, dsnap, oracle = world(SLOT_COLLIDE, rels)
+    assert ("folder", dsnap.flat_meta.fold_pairs[0][1]) in dsnap.flat_meta.fold_pairs
+    checks = [
+        rel.must_from_triple("document:d", "view", "user:alice"),  # F: no arrow in doc.view
+        rel.must_from_triple("document:d", "view", "user:bob"),  # T: direct
+        rel.must_from_triple("document:d2", "view", "user:bob"),  # F: banned
+        rel.must_from_triple("folder:kid", "view", "user:alice"),  # T: ancestor
+    ]
+    _assert_differential(engine, dsnap, oracle, checks)
+
+
+def test_fold_expiry_along_arrow_path(tmp_path=None):
+    import datetime
+
+    exp_soon = datetime.datetime.fromtimestamp(
+        (NOW / 1_000_000) + 3600, tz=datetime.timezone.utc
+    )
+    exp_past = datetime.datetime.fromtimestamp(
+        (NOW / 1_000_000) - 3600, tz=datetime.timezone.utc
+    )
+    rels = [
+        rel.must_from_tuple("folder:root#viewer", "user:alice"),
+        # live arrow edge that expires in an hour
+        rel.must_from_tuple("folder:kid#parent", "folder:root").with_expiration(exp_soon),
+        # dead arrow edge: must contribute nothing through the fold
+        rel.must_from_tuple("folder:dead#parent", "folder:root").with_expiration(exp_past),
+        rel.must_from_tuple("document:d#folder", "folder:kid"),
+        rel.must_from_tuple("document:dx#folder", "folder:dead"),
+    ]
+    engine, dsnap, oracle = world(DOCS, rels)
+    assert dsnap.flat_meta.fold_pairs
+    checks = [
+        rel.must_from_triple("document:d", "view", "user:alice"),  # T via live path
+        rel.must_from_triple("document:dx", "view", "user:alice"),  # F via dead path
+        rel.must_from_triple("folder:dead", "view", "user:alice"),  # F
+        rel.must_from_triple("folder:kid", "view", "user:alice"),  # T
+    ]
+    _assert_differential(engine, dsnap, oracle, checks)
+
+
+def test_fold_budget_zero_disables_but_stays_correct():
+    engine, dsnap, oracle = _docs_world(flat_fold_factor=0)
+    assert not dsnap.flat_meta.fold_pairs
+    checks = [
+        rel.must_from_triple(f"document:d{d}", "view", f"user:u{u}")
+        for d in range(10) for u in range(8)
+    ]
+    _assert_differential(engine, dsnap, oracle, checks)
+
+
+def test_fold_delta_reverts_to_walk():
+    # a delta level rides the folded base: the FlatMeta keeps the fold
+    # pairs (same compiled-kernel cache key family) but the kernel must
+    # take the walked path (fold_on requires delta is None) — the
+    # delta-semantics themselves (adds grant / tombstones revoke through
+    # the bypass) are covered by the client-level delta tests
+    from dataclasses import replace as _dc_replace
+
+    from gochugaru_tpu.engine.flat import DeltaMeta
+
+    engine, dsnap, oracle = _docs_world()
+    assert dsnap.flat_meta.fold_pairs
+    dmeta = _dc_replace(dsnap.flat_meta, delta=DeltaMeta(has_adds=True))
+    assert dmeta.fold_pairs == dsnap.flat_meta.fold_pairs

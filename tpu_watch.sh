@@ -1,0 +1,27 @@
+#!/bin/bash
+# TPU tunnel watcher (round 4). One bounded pass: probe the axon tunnel;
+# if alive, immediately run the bench TPU child (it emits a JSON line per
+# batch size, so even a mid-ramp kill leaves a real number on stdout).
+# Designed to be re-launched by the agent after each exit.
+cd /root/repo || exit 1
+mkdir -p tpu_attempts
+log() { echo "[$(date +%H:%M:%S)] $*" >> tpu_attempts/log.txt; }
+
+probe() {
+  timeout 90 python -u -c "import jax; print(len(jax.devices()), jax.default_backend())" \
+    >> tpu_attempts/log.txt 2>&1
+}
+
+for attempt in 1 2; do
+  if probe; then
+    log "probe OK — running TPU bench child"
+    TS=$(date +%H%M%S)
+    timeout 420 python bench.py --child tpu \
+      > "tpu_attempts/bench_${TS}.out" 2> "tpu_attempts/bench_${TS}.err"
+    log "bench child rc=$? → tpu_attempts/bench_${TS}.out"
+    exit 0
+  fi
+  log "probe FAIL (attempt ${attempt})"
+  [ "$attempt" = 1 ] && sleep 240
+done
+exit 1
