@@ -180,8 +180,8 @@ def measure_batch(engine, dsnap, snap, users, repos, slot, B, note,
     # dispatch round trip of a same-signature null program
     stage(f"measuring p99 B={B}")
     null_fn = jax.jit(
-        lambda arrs, tid_map, now, qr, qp, qs, qsr, qw, qc, qself, qctx:
-        (qself, qself, qself)
+        lambda arrs, tid_map, now, qm, qctx:
+        (qm[6] != 0, qm[6] != 0, qm[6] != 0)
     )
     jax.block_until_ready(null_fn(*args))
 

@@ -128,16 +128,14 @@ def repeat_harness_flat(engine, dsnap, slots, iters: int):
         tuple(slots), caveat_plan=engine.caveat_plan, jit=False,
     )
 
-    def fn(arrs, tid_map, now, q_res, q_perm, q_subj, q_srel1, q_wc,
-           q_ctx, q_self, qctx):
+    def fn(arrs, tid_map, now, qm, qctx):
         def body(i, carry):
             d0, p0, o0 = carry
             d, p, o = raw(
-                arrs, tid_map, now, jnp.roll(q_res, i), q_perm, q_subj,
-                q_srel1, q_wc, q_ctx, q_self, qctx,
+                arrs, tid_map, now, qm.at[0].set(jnp.roll(qm[0], i)), qctx
             )
             return d0 ^ d, p0 ^ p, o0 | o
-        z = jnp.zeros(q_res.shape[0], bool)
+        z = jnp.zeros(qm.shape[1], bool)
         return lax.fori_loop(0, iters, body, (z, z, z))
 
     return jax.jit(fn)

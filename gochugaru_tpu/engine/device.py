@@ -1011,6 +1011,8 @@ class DeviceEngine:
         )
         if len(slots) > self.config.flat_max_slots:
             return None
+        from .flat import build_qm
+
         if jit:
             fn = self._flat_fn_for(slots, dsnap.flat_meta)
         else:
@@ -1021,22 +1023,10 @@ class DeviceEngine:
                 slots, caveat_plan=self.caveat_plan, jit=False,
             )
         BP = _ceil_pow2(B, max(bucket_min, self.config.batch_bucket_min))
-
-        def padq(a, fill):
-            a = np.asarray(a)
-            out = np.full(BP, fill, a.dtype)
-            out[:B] = a
-            return jnp.asarray(out)
-
-        q_srel1 = np.where(
-            queries["q_srel"] >= 0, queries["q_srel"] + 1, 0
-        ).astype(np.int32)
+        # ONE packed query matrix (flat.QM_LAYOUT) → one device transfer
         args = (
             dsnap.arrays, dsnap.tid_map, now,
-            padq(queries["q_res"], -1), padq(queries["q_perm"], -1),
-            padq(queries["q_subj"], -1), padq(q_srel1, 0),
-            padq(queries["q_wc"], -1), padq(queries["q_ctx"], -1),
-            padq(queries["q_self"], False),
+            jnp.asarray(build_qm(queries, BP)),
             self._qctx_device(qctx),
         )
         return fn, args

@@ -63,6 +63,32 @@ from .plan import DevicePlan, EngineConfig, ExprIR, _eval_cyclic_pairs
 # static metadata (part of the traced-function cache key)
 # ---------------------------------------------------------------------------
 
+#: packed query-matrix row layout (int32[QM_ROWS, B]): the kernel takes
+#: ONE batched query argument — q_self rides as 0/1, row 7 is padding so
+#: the leading dim stays pow2.  Builders: DeviceEngine.flat_fn_and_args,
+#: ShardedEngine._dispatch_flat (data axis = axis 1 there).
+QM_LAYOUT = ("q_res", "q_perm", "q_subj", "q_srel1", "q_wc", "q_ctx",
+             "q_self", "pad")
+QM_ROWS = len(QM_LAYOUT)
+
+
+def build_qm(queries: Dict[str, "np.ndarray"], BP: int) -> "np.ndarray":
+    """The packed QM_LAYOUT matrix from length-B query columns, padded to
+    ``BP`` — the ONE builder both the single-chip and sharded dispatchers
+    use, so the pad conventions (-1 keys; 0 for srel1/self/pad) cannot
+    drift."""
+    B = queries["q_res"].shape[0]
+    qm = np.full((QM_ROWS, BP), -1, np.int32)
+    qm[3] = qm[6] = qm[7] = 0
+    qm[0, :B] = queries["q_res"]
+    qm[1, :B] = queries["q_perm"]
+    qm[2, :B] = queries["q_subj"]
+    qm[3, :B] = np.where(queries["q_srel"] >= 0, queries["q_srel"] + 1, 0)
+    qm[4, :B] = queries["q_wc"]
+    qm[5, :B] = queries["q_ctx"]
+    qm[6, :B] = queries["q_self"]
+    return qm
+
 
 @dataclass(frozen=True)
 class DeltaMeta:
@@ -159,13 +185,12 @@ class FlatMeta:
     #: T-index: the materialized (slot·N+res, member-key) → until-values
     #: join of userset edges with the closure — a userset grant test is
     #: ONE probe.  ``t_slots`` are the slots it covers (no caveated /
-    #: permission-valued userset rows); ``t_all`` = it covers every
-    #: us-bearing slot, so the dynamic root leaf can skip the KU path
+    #: permission-valued userset rows); the dynamic root leaf skips the
+    #: KU path when it covers every us-bearing slot of the dispatch
     has_tindex: bool = False
     t_cap: int = 4
     t_n: int = 8
     t_slots: Tuple[int, ...] = ()
-    t_all: bool = False
     #: any permission-valued userset rows in THIS snapshot (drives whether
     #: the interleaved userset view carries a ``perm`` column)
     us_hasperm: bool = False
@@ -497,7 +522,7 @@ def _run_maxes(gk: np.ndarray, glo: np.ndarray, ghi: np.ndarray, N: int):
 
 def _tindex_join(snap, config: EngineConfig, cl, us_gk, cl_k1, cl_k2, pus_k, S1):
     """The T-index join (userset edges ⋈ closure-by-target) shared by both
-    layout builders: returns (T_k1, T_k2, T_d, T_p, t_slots, t_all) or
+    layout builders: returns (T_k1, T_k2, T_d, T_p, t_slots) or
     None when disabled/ineligible/oversized.  For slots whose userset rows
     carry no caveats and no permission-valued subjects, {edge expiry ×
     closure semiring} folds into ONE (slot·N+res, member-key) →
@@ -535,7 +560,6 @@ def _tindex_join(snap, config: EngineConfig, cl, us_gk, cl_k1, cl_k2, pus_k, S1)
     return (
         *got,
         tuple(int(s) for s in np.unique(snap.us_rel[elig])),
-        bad_slots.size == 0,
     )
 
 
@@ -684,10 +708,10 @@ def build_flat_arrays(
         out["ovf_k"] = _pad(ovf_k, _ceil_pow2(max(ovf_k.shape[0], 1)), -1)
 
     # ---- T-index: userset edges ⋈ closure-by-target (shared join) -------
-    t_kw = dict(has_tindex=False, t_cap=4, t_n=8, t_slots=(), t_all=False)
+    t_kw = dict(has_tindex=False, t_cap=4, t_n=8, t_slots=())
     tj = _tindex_join(snap, config, cl, us_gk, cl_k1, cl_k2, pus_k, S1)
     if tj is not None:
-        T_k1, T_k2, T_d, T_p, t_slots, t_all = tj
+        T_k1, T_k2, T_d, T_p, t_slots = tj
         th = build_hash([T_k1, T_k2])
         if BS:
             out["th_off"] = th.off
@@ -704,7 +728,6 @@ def build_flat_arrays(
             t_cap=_round_cap(th.cap),
             t_n=_ceil_pow2(max(th.n, 1)),
             t_slots=t_slots,
-            t_all=t_all,
         )
 
     # resource-side Leopard index: flattened ancestor closures for
@@ -977,10 +1000,10 @@ def build_flat_arrays_sharded(
         M, max(64, config.arrow_fanout),
     )
 
-    t_kw = dict(has_tindex=False, t_cap=4, t_n=8, t_slots=(), t_all=False)
+    t_kw = dict(has_tindex=False, t_cap=4, t_n=8, t_slots=())
     tj = _tindex_join(snap, config, cl, us_gk, cl_k1, cl_k2, pus_k, S1)
     if tj is not None:
-        T_k1, T_k2, T_d, T_p, t_slots, t_all = tj
+        T_k1, T_k2, T_d, T_p, t_slots = tj
         th = build_hash([T_k1, T_k2], min_size=ms)
         out["th_off"], out["tx"] = _stack_point(th, [T_k1, T_k2, T_d, T_p], M)
         t_kw = dict(
@@ -988,7 +1011,6 @@ def build_flat_arrays_sharded(
             t_cap=_round_cap(th.cap),
             t_n=_ceil_pow2(max(th.n, 1)),
             t_slots=t_slots,
-            t_all=t_all,
         )
 
     ar_dd = _arrow_data_depth(snap)
@@ -1417,8 +1439,13 @@ def make_flat_fn(
                     out.add(tname_of_tid[a.type_id])
         return frozenset(out)
 
-    def fn(arrs, tid_map, now, q_res, q_perm, q_subj, q_srel1, q_wc,
-           q_ctx, q_self, qctx):
+    def fn(arrs, tid_map, now, qm, qctx):
+        # packed query matrix int32[8, B] (QM_LAYOUT): one host→device
+        # transfer per dispatch instead of seven — on a remote-attached
+        # chip each extra arg is a tunnel round-trip in the p99
+        q_res, q_perm, q_subj = qm[0], qm[1], qm[2]
+        q_srel1, q_wc, q_ctx = qm[3], qm[4], qm[5]
+        q_self = qm[6] != 0
         if tri is not None:
             tables = {
                 "ectx_vi": arrs["ectx_vi"], "ectx_vf": arrs["ectx_vf"],

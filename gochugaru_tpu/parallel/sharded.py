@@ -38,6 +38,7 @@ from ..engine.device import (
     _make_check_fn,
     _pad_payload,
 )
+from ..engine.flat import build_qm
 from ..engine.plan import EngineConfig
 from ..rel.relationship import Relationship
 from ..schema.compiler import CompiledSchema
@@ -129,8 +130,7 @@ class ShardedEngine(DeviceEngine):
         qctx_spec = {k: P() for k in ("vi", "vf", "pr", "host")}
         in_specs = (
             arr_spec, P(), P(),  # arrays, tid_map, now
-            P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
-            P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+            P(None, DATA_AXIS),  # packed query matrix (flat.QM_LAYOUT)
             qctx_spec,
         )
         fn = jax.jit(
@@ -264,32 +264,19 @@ class ShardedEngine(DeviceEngine):
         )
         BP = per * D
 
-        def padq(a, fill):
-            a = np.asarray(a)
-            out = np.full(BP, fill, a.dtype)
-            out[:B] = a
-            return out
-
-        q_srel1 = np.where(
-            queries["q_srel"] >= 0, queries["q_srel"] + 1, 0
-        ).astype(np.int32)
         all_slots = sorted(
             {int(s) for s in np.unique(queries["q_perm"]) if s >= 0}
         )
         now = jnp.int32(snap.now_rel32(now_us))
-        dsh = NamedSharding(self.mesh, P(DATA_AXIS))
+        # packed query matrix (flat.QM_LAYOUT): batch rides axis 1, which
+        # partitions over the data axis — ONE sharded transfer; the rare
+        # multi-chunk path (more distinct permissions than
+        # flat_max_slots) ships only the small perm row per chunk and
+        # splices it on device
+        dsh = NamedSharding(self.mesh, P(None, DATA_AXIS))
         rep = NamedSharding(self.mesh, P())
-
-        def put(a):
-            return jax.device_put(a, dsh)
-
-        args_fixed = (
-            put(padq(queries["q_res"], -1)),
-            put(padq(queries["q_subj"], -1)), put(padq(q_srel1, 0)),
-            put(padq(queries["q_wc"], -1)), put(padq(queries["q_ctx"], -1)),
-            put(padq(queries["q_self"], False)),
-            {k: jax.device_put(v, rep) for k, v in qctx.items()},
-        )
+        qm_dev = jax.device_put(build_qm(queries, BP), dsh)
+        qctx_dev = {k: jax.device_put(v, rep) for k, v in qctx.items()}
         arr_keys = tuple(sorted(dsnap.arrays.keys()))
         # batches with more distinct permissions than flat_max_slots are
         # evaluated in slot chunks (each query's slot lives in exactly one
@@ -297,19 +284,26 @@ class ShardedEngine(DeviceEngine):
         # cost stays bounded instead of unrolling one program per slot
         cap = max(self.config.flat_max_slots, 1)
         q_perm = queries["q_perm"]
+        multi = len(all_slots) > cap
+        if multi:
+            row_sh = NamedSharding(self.mesh, P(DATA_AXIS))
+            set_perm = jax.jit(
+                lambda q, pc: q.at[1].set(pc), out_shardings=dsh
+            )
         d = p = ovf = None
         for at in range(0, max(len(all_slots), 1), cap):
             chunk = tuple(all_slots[at : at + cap])
-            if len(all_slots) > cap:
-                perm_col = np.where(
+            if multi:
+                pc = np.full(BP, -1, np.int32)
+                pc[:B] = np.where(
                     np.isin(q_perm, np.asarray(chunk, np.int32)), q_perm, -1
                 )
+                qmc = set_perm(qm_dev, jax.device_put(pc, row_sh))
             else:
-                perm_col = q_perm
+                qmc = qm_dev
             fn = self._flat_sharded_fn(chunk, dsnap.flat_meta, arr_keys)
             cd, cp, covf = fn(
-                dsnap.arrays, dsnap.tid_map, now,
-                args_fixed[0], put(padq(perm_col, -1)), *args_fixed[1:],
+                dsnap.arrays, dsnap.tid_map, now, qmc, qctx_dev,
             )
             d = cd if d is None else d | cd
             p = cp if p is None else p | cp

@@ -430,7 +430,6 @@ def test_tindex_ineligible_slots_fall_back():
         assert viewer in meta.t_slots
         assert reader not in meta.t_slots
         assert auditor not in meta.t_slots
-        assert not meta.t_all
     checks = [
         rel.must_from_triple("doc:d", "view", "user:u"),
         rel.must_from_triple("doc:d", "read", "user:u").with_caveat("", {"x": 5}),
