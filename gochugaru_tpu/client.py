@@ -45,6 +45,7 @@ from .utils import metrics as _metrics
 from .utils.context import Context
 from .utils.errors import (
     AlreadyExistsError,
+    BulkCheckItemError,
     OverlapKeyMissingError,
     PartialDeletionError,
     UnavailableError,
@@ -325,7 +326,15 @@ class Client:
                             if ovf[i]
                             else "checks.fallback_conditional"
                         )
-                        out.append(oracle.check_relationship(r) == T)
+                        try:
+                            out.append(oracle.check_relationship(r) == T)
+                        except Exception as e:
+                            # per-item error: abort with partial results,
+                            # mirroring the reference's bulk mapping loop
+                            # (client/client.go:279-283).  Not retriable —
+                            # the reference retries the RPC, not the
+                            # per-item mapping
+                            raise BulkCheckItemError(i, out, e) from e
                     else:
                         out.append(bool(d[i]))
                 return out

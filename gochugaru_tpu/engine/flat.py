@@ -1224,7 +1224,14 @@ def build_delta_arrays(
 
     S1 = meta.S1
     N = meta.N
-    acc = _acc_collapse(getattr(prev_dsnap, "delta_acc", None), di, N, S1)
+    prev_acc = getattr(prev_dsnap, "delta_acc", None)
+    acc = _acc_collapse(prev_acc, di, N, S1)
+    # chain-stable anchor for the shape floor below: the BASE revision's
+    # edge count (a floor derived from the oscillating current count
+    # would retrace on every boundary crossing)
+    acc["base_edges"] = (
+        prev_acc["base_edges"] if prev_acc else int(prev_snap.num_edges)
+    )
     if meta.rc_slots:
         # rows of a FLATTENED tupleset shift its ancestor closure: bail
         # EARLY (before any table builds) to a full rebuild.  Incremental
@@ -1252,28 +1259,42 @@ def build_delta_arrays(
     g_k1 = pk(acc["g_rel"], N, acc["g_res"])
     g_k2 = pk(acc["g_subj"], S1, acc["g_srel1"])
 
+    # shape floor: every dl_* table pre-sizes to F rows (2F buckets), so
+    # a chain of Watch revisions reuses ONE compiled kernel — without it,
+    # each pow2 row-count boundary retraces (~1s), dominating the
+    # re-index loop.  Scaled down for small graphs where retraces are
+    # cheap and the floor would out-size the base
+    F = min(
+        config.flat_delta_floor,
+        _ceil_pow2(max(64, acc["base_edges"] // 4)),
+    )
+
+    def floored_hash(cols):
+        return build_hash(cols, min_size=2 * F)
+
     kw = {}
     if n_adds:
-        eh = build_hash([a_k1, a_k2])
+        eh = floored_hash([a_k1, a_k2])
         out["dl_eh_off"] = eh.off
         out["dl_ehx"] = interleave_buckets(
             eh,
             [a_k1, a_k2]
             + ([acc["a_cav"], acc["a_ctx"]] if meta.e_hascav else [])
             + ([acc["a_exp"]] if meta.e_hasexp else []),
+            pad=F,
         )
         kw.update(
             has_adds=True,
-            e_cap=_round_cap(eh.cap),
+            e_cap=_round_cap(max(8, eh.cap)),
             e_slots=tuple(int(s) for s in np.unique(acc["a_rel"])),
             e_hascav=meta.e_hascav,
             e_hasexp=meta.e_hasexp,
         )
     if n_tombs:
-        tb = build_hash([g_k1, g_k2])
+        tb = floored_hash([g_k1, g_k2])
         out["dl_tb_off"] = tb.off
-        out["dl_tbx"] = interleave_buckets(tb, [g_k1, g_k2])
-        kw.update(has_tombs=True, tb_cap=_round_cap(tb.cap))
+        out["dl_tbx"] = interleave_buckets(tb, [g_k1, g_k2], pad=F)
+        kw.update(has_tombs=True, tb_cap=_round_cap(max(8, tb.cap)))
 
     # delta userset view (adds with a subject relation)
     am = acc["a_srel1"] > 0
@@ -1281,10 +1302,10 @@ def build_delta_arrays(
         gk_all = a_k1[am]
         order = np.argsort(gk_all, kind="stable")
         u_gk = gk_all[order]
-        usr = build_range_hash(u_gk)
+        usr = build_range_hash(u_gk, min_size=2 * F)
         out["dl_usr_off"] = usr.index.off
         out["dl_usgx"] = interleave_buckets(
-            usr.index, [usr.gk, usr.glo, usr.ghi]
+            usr.index, [usr.gk, usr.glo, usr.ghi], pad=F
         )
         cols = [acc["a_subj"][am][order], (acc["a_srel1"][am] - 1)[order]]
         if meta.us_hascav:
@@ -1294,20 +1315,22 @@ def build_delta_arrays(
         if meta.us_hasperm:
             # permission-valued delta rows bail above: flag column is 0
             cols += [np.zeros(int(am.sum()), np.int32)]
-        fan = _round_fan(min(usr.max_run, 32))
-        out["dl_usx"] = interleave_rows(cols, pad=max(64, fan))
+        # fan floor 8: per-group occupancy creeps up as a chain
+        # accumulates, and each pow2 step would retrace
+        fan = _round_fan(max(8, min(usr.max_run, 32)))
+        out["dl_usx"] = interleave_rows(cols, pad=max(F, fan))
         kw.update(
             has_us=True,
-            us_cap=_round_cap(usr.index.cap),
+            us_cap=_round_cap(max(8, usr.index.cap)),
             us_fan=fan,
             us_slots=tuple(int(s) for s in np.unique(acc["a_rel"][am])),
         )
     gm = acc["g_srel1"] > 0
     if gm.any():
-        utb = build_hash([g_k1[gm], g_k2[gm]])
+        utb = floored_hash([g_k1[gm], g_k2[gm]])
         out["dl_utb_off"] = utb.off
-        out["dl_utbx"] = interleave_buckets(utb, [g_k1[gm], g_k2[gm]])
-        kw.update(has_ustomb=True, utb_cap=_round_cap(utb.cap))
+        out["dl_utbx"] = interleave_buckets(utb, [g_k1[gm], g_k2[gm]], pad=F)
+        kw.update(has_ustomb=True, utb_cap=_round_cap(max(8, utb.cap)))
         if meta.has_tindex:
             dirty = np.unique(
                 g_k1[gm][
@@ -1315,10 +1338,10 @@ def build_delta_arrays(
                 ]
             )
             if dirty.size:
-                td = build_hash([dirty])
+                td = floored_hash([dirty])
                 out["dl_td_off"] = td.off
-                out["dl_tdx"] = interleave_buckets(td, [dirty])
-                kw.update(t_dirty=True, td_cap=_round_cap(td.cap))
+                out["dl_tdx"] = interleave_buckets(td, [dirty], pad=F)
+                kw.update(t_dirty=True, td_cap=_round_cap(max(8, td.cap)))
 
     # delta arrow view (tupleset relations, direct subjects)
     ts = np.asarray(sorted(compiled.tupleset_slots), np.int64)
@@ -1326,21 +1349,21 @@ def build_delta_arrays(
     if aam.any():
         gk_all = a_k1[aam]
         order = np.argsort(gk_all, kind="stable")
-        arr = build_range_hash(gk_all[order])
+        arr = build_range_hash(gk_all[order], min_size=2 * F)
         out["dl_arr_off"] = arr.index.off
         out["dl_argx"] = interleave_buckets(
-            arr.index, [arr.gk, arr.glo, arr.ghi]
+            arr.index, [arr.gk, arr.glo, arr.ghi], pad=F
         )
         cols = [acc["a_subj"][aam][order]]
         if meta.ar_hascav:
             cols += [acc["a_cav"][aam][order], acc["a_ctx"][aam][order]]
         if meta.ar_hasexp:
             cols += [acc["a_exp"][aam][order]]
-        fan = _round_fan(min(arr.max_run, 32))
-        out["dl_arx"] = interleave_rows(cols, pad=max(64, fan))
+        fan = _round_fan(max(8, min(arr.max_run, 32)))
+        out["dl_arx"] = interleave_rows(cols, pad=max(F, fan))
         kw.update(
             has_ar=True,
-            ar_cap=_round_cap(arr.index.cap),
+            ar_cap=_round_cap(max(8, arr.index.cap)),
             ar_fan=fan,
             ar_slots=tuple(int(s) for s in np.unique(acc["a_rel"][aam])),
         )
@@ -1348,10 +1371,12 @@ def build_delta_arrays(
     if gam.any():
         # identity for arrow-candidate masking is (group key, child node) —
         # the kernel holds the child id, not the packed subject key
-        atb = build_hash([g_k1[gam], acc["g_subj"][gam]])
+        atb = floored_hash([g_k1[gam], acc["g_subj"][gam]])
         out["dl_atb_off"] = atb.off
-        out["dl_atbx"] = interleave_buckets(atb, [g_k1[gam], acc["g_subj"][gam]])
-        kw.update(has_artomb=True, atb_cap=_round_cap(atb.cap))
+        out["dl_atbx"] = interleave_buckets(
+            atb, [g_k1[gam], acc["g_subj"][gam]], pad=F
+        )
+        kw.update(has_artomb=True, atb_cap=_round_cap(max(8, atb.cap)))
 
     return out, DeltaMeta(**kw), acc
 

@@ -75,6 +75,12 @@ class EngineConfig:
     #: max(this, E/8) trigger compaction: the next prepare rebuilds the
     #: base instead of growing the overlay (engine/flat.py delta level)
     flat_delta_min_compact: int = 65_536
+    #: dl_* table shape floor: delta tables pre-size to this many rows so
+    #: consecutive revisions keep ONE compiled kernel instead of
+    #: retracing at every pow2 row-count boundary (a retrace costs ~1s —
+    #: the dominant term of the Watch-reindex loop without the floor);
+    #: beyond the floor, shapes double (log-many retraces per chain)
+    flat_delta_floor: int = 16_384
     #: flatten self-recursive arrow hierarchies into precomputed ancestor
     #: closures (the resource-side Leopard index, engine/flat.py
     #: rc_candidates/_arrow_closure): a depth-D folder tree evaluates in

@@ -173,6 +173,12 @@ class NativeInterner:
                 )
             return out
 
+    def node_type_tail(self, start: int) -> np.ndarray:
+        """Type ids of nodes interned at or after ``start`` (see
+        store/interner.py).  The C fill is one flat memcpy, so slicing
+        it keeps no Python-loop constant."""
+        return self.node_type_array()[start:]
+
     # -- columnar bulk entry points --------------------------------------
     def node_batch(self, type_name: str, ids: Sequence[str]) -> np.ndarray:
         """Intern many ids of one type; returns int32 node ids."""

@@ -287,9 +287,14 @@ class ShardedEngine(DeviceEngine):
         multi = len(all_slots) > cap
         if multi:
             row_sh = NamedSharding(self.mesh, P(DATA_AXIS))
-            set_perm = jax.jit(
-                lambda q, pc: q.at[1].set(pc), out_shardings=dsh
-            )
+            # one jitted splice per engine: a fresh jax.jit here would
+            # retrace on every multi-chunk dispatch
+            set_perm = self.__dict__.get("_set_perm_fn")
+            if set_perm is None:
+                set_perm = jax.jit(
+                    lambda q, pc: q.at[1].set(pc), out_shardings=dsh
+                )
+                self._set_perm_fn = set_perm
         d = p = ovf = None
         for at in range(0, max(len(all_slots), 1), cap):
             chunk = tuple(all_slots[at : at + cap])

@@ -31,6 +31,7 @@ revision is a consistent snapshot of it (consistency/consistency.go).
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Any, List, Mapping, Optional, Sequence, Tuple
 
@@ -230,6 +231,169 @@ def _lower_delta(
     return res, rel_s, subj, srel1, cav, ctx, exp_us
 
 
+#: host-side LSM compaction floor: once the accumulated overlay (adds +
+#: tombstones) crosses max(this, E/8), apply_delta materializes the chain
+#: into a fresh base instead of growing it.  Mirrors the device's
+#: EngineConfig.flat_delta_min_compact so host and device compact on the
+#: same revision (the device bails to a full prepare at the same bound,
+#: which touches every view and would materialize anyway).
+LSM_COMPACT_MIN = 65_536
+
+
+class _lazycol:
+    """Non-data descriptor for one deferred Snapshot column: first access
+    materializes the whole snapshot (filling the instance __dict__, after
+    which instance attributes win and this descriptor is never consulted
+    again)."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __get__(self, obj, owner=None):
+        if obj is None:
+            return self
+        obj._materialize()
+        return obj.__dict__[self.name]
+
+
+#: every Snapshot column derived from the primary arrays — exactly the
+#: fields LsmSnapshot defers until something actually reads them
+_LAZY_FIELDS = (
+    "e_rel", "e_res", "e_subj", "e_srel1", "e_caveat", "e_ctx", "e_exp",
+    "e_exp_us",
+    "us_rel", "us_res", "us_subj", "us_srel", "us_caveat", "us_ctx",
+    "us_exp", "us_perm", "pus_n", "pus_r",
+    "ms_subj", "ms_res", "ms_rel", "ms_caveat", "ms_ctx", "ms_exp",
+    "mp_subj", "mp_srel", "mp_res", "mp_rel", "mp_caveat", "mp_ctx",
+    "mp_exp",
+    "ar_rel", "ar_res", "ar_child", "ar_caveat", "ar_ctx", "ar_exp",
+)
+
+
+class LsmSnapshot(Snapshot):
+    """Deferred-merge snapshot: a materialized base plus one collapsed,
+    (rel,res,subj,srel1)-sorted overlay of adds and a tombstone set of
+    base rows.  ``apply_delta`` returns these so a Watch-driven revision
+    costs O(D log E) host work instead of rewriting E rows — the host
+    half of BASELINE config 5's re-index budget.
+
+    The device's incremental prepare reads only ``delta_info`` and the
+    eager scalars (num_nodes, node_type, wildcard table, us_used_keys);
+    every derived column is a non-data descriptor that materializes the
+    full merge on first touch (host oracle fallback, exports, full
+    device prepares), after which the instance behaves exactly like the
+    snapshot the eager path would have produced — same
+    ``finish_snapshot``, so identical by construction."""
+
+    def __init__(self, base: Snapshot, revision: int, *, interner,
+                 contexts, ov, gone_base: np.ndarray, num_nodes: int,
+                 node_type: np.ndarray, wc: np.ndarray):
+        # deliberately NOT calling the dataclass __init__: column fields
+        # stay unset so the class-level _lazycol descriptors fire
+        self.revision = revision
+        self.compiled = base.compiled
+        self.interner = interner
+        self.num_nodes = num_nodes
+        self.num_slots = base.num_slots
+        self.epoch_us = base.epoch_us
+        self.node_type = node_type
+        self.wildcard_node_of_type = wc
+        self.contexts = contexts
+        # conservative carry-forward: eligible deltas never grow the set
+        # (new userset subjects bail the device to a full prepare, which
+        # materializes and recomputes); a stale superset only causes
+        # extra full prepares, never wrong answers
+        self.us_used_keys = getattr(base, "us_used_keys", None)
+        self._lsm_base = base
+        self._lsm_ov = ov  # dict of sorted overlay columns
+        self._lsm_gone = gone_base  # sorted unique base-row tombstones
+        self._lsm_lock = threading.Lock()  # one merge even under races
+
+    @property
+    def num_edges(self) -> int:
+        if self.__dict__.get("_lsm_done"):
+            return int(self.__dict__["e_rel"].shape[0])
+        return int(
+            self._lsm_base.e_rel.shape[0]
+            - self._lsm_gone.shape[0]
+            + self._lsm_ov["rel"].shape[0]
+        )
+
+    def _materialize(self, compact_ctx: bool = False) -> bool:
+        if self.__dict__.get("_lsm_done"):
+            return False
+        with self._lsm_lock:
+            return self._materialize_locked(compact_ctx)
+
+    def _materialize_locked(self, compact_ctx: bool) -> bool:
+        if self.__dict__.get("_lsm_done"):
+            return False
+        base, ov = self._lsm_base, self._lsm_ov
+        keep = np.ones(base.e_rel.shape[0], dtype=bool)
+        keep[self._lsm_gone] = False
+        old_rr = _pack_rr(base.e_rel, base.e_res)[keep]
+        old_ss = _pack_ss(base.e_subj, base.e_srel1)[keep]
+        new_rr = _pack_rr(ov["rel"], ov["res"])
+        new_ss = _pack_ss(ov["subj"], ov["srel1"])
+        E0, A = old_rr.shape[0], new_rr.shape[0]
+        pos_old, pos_new = merge_positions(old_rr, old_ss, new_rr, new_ss)
+
+        def interleave(old: np.ndarray, new: np.ndarray) -> np.ndarray:
+            out = np.empty(E0 + A, dtype=old.dtype)
+            out[pos_old] = old[keep]
+            out[pos_new] = new
+            return out
+
+        e_ctx = interleave(base.e_ctx, ov["ctx"])
+        contexts = self.contexts
+        renumbered = False
+        if compact_ctx:
+            # renumbering is only sound at BUILD time (before the device
+            # consumed this revision's delta_info): the caller flags the
+            # delta contexts_renumbered so baked-in ctx ids are not
+            # trusted.  A lazy (post-handoff) materialization must never
+            # compact — the device may already hold the old ids
+            used = e_ctx >= 0
+            if not used.any():
+                renumbered = bool(contexts)
+                contexts = []
+            else:
+                live_ctx, inv = np.unique(e_ctx[used], return_inverse=True)
+                if len(contexts) > live_ctx.shape[0]:
+                    contexts = [contexts[i] for i in live_ctx]
+                    e_ctx[used] = inv.astype(np.int32)
+                    renumbered = True
+            self.contexts = contexts
+        nxt = finish_snapshot(
+            self.revision, self.compiled, self.interner,
+            e_rel=interleave(base.e_rel, ov["rel"]),
+            e_res=interleave(base.e_res, ov["res"]),
+            e_subj=interleave(base.e_subj, ov["subj"]),
+            e_srel1=interleave(base.e_srel1, ov["srel1"]),
+            e_caveat=interleave(base.e_caveat, ov["cav"]),
+            e_ctx=e_ctx,
+            e_exp=interleave(base.e_exp, ov["exp"]),
+            e_exp_us=interleave(base.e_exp_us, ov["exp_us"]),
+            contexts=contexts, epoch_us=self.epoch_us,
+        )
+        for f in _LAZY_FIELDS:
+            self.__dict__[f] = getattr(nxt, f)
+        # finish_snapshot recomputes the used-userset set from the merged
+        # rows — replace the conservative carry-forward with the truth
+        self.__dict__["us_used_keys"] = nxt.us_used_keys
+        self.__dict__["_lsm_done"] = True
+        # drop the chain state: a materialized snapshot otherwise pins
+        # the whole previous base's columns (~2× E-row memory) forever
+        self._lsm_base = self._lsm_ov = self._lsm_gone = None
+        return renumbered
+
+
+for _f in _LAZY_FIELDS:
+    setattr(LsmSnapshot, _f, _lazycol(_f))
+
+
 def apply_delta(
     prev: Snapshot,
     revision: int,
@@ -237,6 +401,7 @@ def apply_delta(
     deletes: Sequence[Relationship],
     *,
     interner: Optional[Interner] = None,
+    defer: Optional[bool] = None,
 ) -> Snapshot:
     """Next-revision Snapshot from the previous one plus a collapsed delta.
 
@@ -245,7 +410,13 @@ def apply_delta(
     ``deletes`` are tuple keys to remove (extra keys not present are
     ignored, matching DELETE semantics).  A key must not appear in both —
     the store collapses the delta last-writer-wins before calling this.
-    """
+
+    ``defer`` controls the host LSM: True returns an LsmSnapshot whose
+    column merge is deferred to first access (O(D log E) now); False
+    merges eagerly; None (default) defers unless the previous snapshot
+    carries a live lookup index (advance_lookup_index needs merged-row
+    positions) or the accumulated overlay would cross the compaction
+    bound (then the merge is due anyway)."""
     interner = interner if interner is not None else prev.interner
     compiled = prev.compiled
     contexts = list(prev.contexts)
@@ -265,95 +436,143 @@ def apply_delta(
     d_res, d_rel, d_subj, d_srel1, _, _, _ = _lower_delta(
         compiled, interner, deletes, d_contexts
     )
-
-    # tombstone every row whose identity is re-added or deleted
-    gone = np.concatenate([
-        _locate(prev, a_rel, a_res, a_subj, a_srel1),
-        _locate(prev, d_rel, d_res, d_subj, d_srel1),
-    ]) if (len(adds) + len(deletes)) else np.empty(0, np.int64)
-    keep = np.ones(prev.e_rel.shape[0], dtype=bool)
-    keep[gone[gone >= 0]] = False
-
-    # sort the additions by the primary order
-    a_order = np.lexsort((a_srel1, a_subj, a_res, a_rel))
     a_exp32 = _exp_to_rel32(a_exp_us, prev.epoch_us)
+    a_order = np.lexsort((a_srel1, a_subj, a_res, a_rel))
 
-    # merge positions: surviving old rows and sorted additions interleaved
-    # by (rel,res | subj,srel1); computed on the packed keys so the merge
-    # itself is one argsort-free scatter.
-    old_rr = _pack_rr(prev.e_rel, prev.e_res)[keep]
-    old_ss = _pack_ss(prev.e_subj, prev.e_srel1)[keep]
-    new_rr = _pack_rr(a_rel, a_res)[a_order]
-    new_ss = _pack_ss(a_subj, a_srel1)[a_order]
-    E0, A = old_rr.shape[0], new_rr.shape[0]
-
-    # interleave positions: two-level merge by (rel,res | subj,srel1)
-    pos_old, pos_new = merge_positions(old_rr, old_ss, new_rr, new_ss)
-
-    def interleave(old: np.ndarray, new: np.ndarray) -> np.ndarray:
-        out = np.empty(E0 + A, dtype=old.dtype)
-        out[pos_old] = old[keep]
-        out[pos_new] = new
-        return out
-
-    e_rel = interleave(prev.e_rel, a_rel[a_order].astype(np.int32))
-    e_res = interleave(prev.e_res, a_res[a_order].astype(np.int32))
-    e_subj = interleave(prev.e_subj, a_subj[a_order].astype(np.int32))
-    e_srel1 = interleave(prev.e_srel1, a_srel1[a_order].astype(np.int32))
-    e_cav = interleave(prev.e_caveat, a_cav[a_order])
-    e_ctx = interleave(prev.e_ctx, a_ctx[a_order])
-    e_exp = interleave(prev.e_exp, a_exp32[a_order])
-    e_exp_us = interleave(prev.e_exp_us, a_exp_us[a_order])
-
-    # compact contexts only when the dead fraction is substantial:
-    # renumbering invalidates the ctx ids baked into device-resident base
-    # tables, forcing the engine's delta-prepare into a full rebuild, so
-    # small deltas keep indices append-only stable
-    renumbered = False
-    used = e_ctx >= 0
-    n_used = int(np.count_nonzero(used))
-    if n_used == 0:
-        renumbered = bool(contexts)
-        contexts = []
-    elif len(contexts) > CTX_COMPACT_MIN and len(contexts) > 2 * n_used:
-        live_ctx, inv = np.unique(e_ctx[used], return_inverse=True)
-        contexts = [contexts[i] for i in live_ctx]
-        e_ctx = e_ctx.copy()
-        e_ctx[used] = inv.astype(np.int32)
-        renumbered = True
-
-    nxt = finish_snapshot(
-        revision, compiled, interner,
-        e_rel=e_rel, e_res=e_res, e_subj=e_subj, e_srel1=e_srel1,
-        e_caveat=e_cav, e_ctx=e_ctx, e_exp=e_exp, e_exp_us=e_exp_us,
-        contexts=contexts, epoch_us=prev.epoch_us,
+    # resolve the chain: an unmaterialized LsmSnapshot extends its own
+    # base/overlay; anything else (plain or already-materialized) starts
+    # a fresh chain with itself as base
+    chained = isinstance(prev, LsmSnapshot) and not prev.__dict__.get(
+        "_lsm_done"
     )
+    base = prev._lsm_base if chained else prev
+    ov0 = prev._lsm_ov if chained else {
+        k: np.zeros(0, np.int64 if k in ("rel", "res", "subj", "srel1", "exp_us") else np.int32)
+        for k in ("rel", "res", "subj", "srel1", "cav", "ctx", "exp", "exp_us")
+    }
+    gone0 = prev._lsm_gone if chained else np.zeros(0, np.int64)
+
+    # locate this delta's identities in the base and in the overlay
+    all_rel = np.concatenate([a_rel, d_rel])
+    all_res = np.concatenate([a_res, d_res])
+    all_subj = np.concatenate([a_subj, d_subj])
+    all_srel1 = np.concatenate([a_srel1, d_srel1])
+    base_hit = _locate(base, all_rel, all_res, all_subj, all_srel1)
+    ov_hit = find_in_view(
+        _pack_rr(ov0["rel"], ov0["res"]), _pack_ss(ov0["subj"], ov0["srel1"]),
+        _pack_rr(all_rel, all_res), _pack_ss(all_subj, all_srel1),
+    )
+
+    # per-revision removal set (delta_info.g_*): identities live at prev —
+    # a base row not already tombstoned, or an overlay row
+    base_live = base_hit >= 0
+    if gone0.size:
+        pos = np.searchsorted(gone0, base_hit)
+        already = (pos < gone0.shape[0]) & (
+            gone0[np.clip(pos, 0, gone0.shape[0] - 1)] == base_hit
+        )
+        base_live &= ~already
+    was_live = base_live | (ov_hit >= 0)
+    g_rel = all_rel[was_live].astype(np.int32)
+    g_res = all_res[was_live].astype(np.int32)
+    g_subj = all_subj[was_live].astype(np.int32)
+    g_srel1 = all_srel1[was_live].astype(np.int32)
+
+    # new chain state: tombstones grow by the base hits; replaced/deleted
+    # overlay rows drop; sorted adds merge in
+    gone = np.union1d(gone0, base_hit[base_hit >= 0])
+    ov_keep = np.ones(ov0["rel"].shape[0], dtype=bool)
+    ov_keep[ov_hit[ov_hit >= 0]] = False
+    new_cols = {
+        "rel": a_rel[a_order], "res": a_res[a_order],
+        "subj": a_subj[a_order], "srel1": a_srel1[a_order],
+        "cav": a_cav[a_order], "ctx": a_ctx[a_order],
+        "exp": a_exp32[a_order], "exp_us": a_exp_us[a_order],
+    }
+    pos_old, pos_new = merge_positions(
+        _pack_rr(ov0["rel"], ov0["res"])[ov_keep],
+        _pack_ss(ov0["subj"], ov0["srel1"])[ov_keep],
+        _pack_rr(new_cols["rel"], new_cols["res"]),
+        _pack_ss(new_cols["subj"], new_cols["srel1"]),
+    )
+    O0, A = int(ov_keep.sum()), new_cols["rel"].shape[0]
+    ov = {}
+    for k in ov0:
+        out = np.empty(O0 + A, dtype=ov0[k].dtype)
+        out[pos_old] = ov0[k][ov_keep]
+        out[pos_new] = new_cols[k].astype(ov0[k].dtype)
+        ov[k] = out
+
+    over_bound = ov["rel"].shape[0] + gone.shape[0] > max(
+        LSM_COMPACT_MIN, base.e_rel.shape[0] // 8
+    )
+    # contexts-list compaction check on an O(delta)-maintained UPPER bound
+    # of live context uses (base count at chain start + overlay ctx rows;
+    # tombstones only shrink the truth, so this over-estimates and
+    # compacts no more often than the exact check would)
+    base_nctx = (
+        prev.__dict__.get("_lsm_base_nctx") if chained else None
+    )
+    if base_nctx is None:
+        base_nctx = int(np.count_nonzero(base.e_ctx >= 0))
+    nctx_ub = base_nctx + int(np.count_nonzero(ov["ctx"] >= 0))
+    ctx_over = len(contexts) > CTX_COMPACT_MIN and (
+        nctx_ub == 0 or len(contexts) > 2 * nctx_ub
+    )
+    if defer is None:
+        defer = (
+            getattr(prev, "_lookup_index", None) is None
+            and not over_bound
+            and not ctx_over
+        )
+
+    num_nodes = max(len(interner), 1)
+    node_type = np.concatenate([
+        base.node_type, interner.node_type_tail(base.node_type.shape[0])
+    ]) if num_nodes > base.node_type.shape[0] else base.node_type
+    wc = np.full(max(interner.num_types, 1), -1, dtype=np.int32)
+    from ..rel.relationship import WILDCARD_ID
+
+    for tname in compiled.type_ids:
+        n = interner.lookup(tname, WILDCARD_ID)
+        if n >= 0:
+            wc[interner.type_lookup(tname)] = n
+
+    nxt = LsmSnapshot(
+        base, revision, interner=interner, contexts=contexts, ov=ov,
+        gone_base=gone, num_nodes=num_nodes, node_type=node_type, wc=wc,
+    )
+    nxt._lsm_base_nctx = base_nctx
+    renumbered = False
+    if not defer:
+        renumbered = nxt._materialize(compact_ctx=ctx_over)
     if not renumbered:
         nxt._ctx_index = ctx_index  # still valid: indices were append-only
-    # attach the machine-readable delta for the device engine's
-    # incremental prepare (identity columns of removed rows come from the
-    # previous snapshot's primary arrays)
-    gone_rows = (
-        np.unique(gone[gone >= 0]) if gone.size else np.empty(0, np.int64)
-    )
     nxt.delta_info = DeltaInfo(
         prev_revision=prev.revision,
         a_rel=a_rel.astype(np.int32), a_res=a_res.astype(np.int32),
         a_subj=a_subj.astype(np.int32), a_srel1=a_srel1.astype(np.int32),
         a_cav=a_cav, a_ctx=a_ctx, a_exp=a_exp32,
-        g_rel=prev.e_rel[gone_rows], g_res=prev.e_res[gone_rows],
-        g_subj=prev.e_subj[gone_rows], g_srel1=prev.e_srel1[gone_rows],
+        g_rel=g_rel, g_res=g_res, g_subj=g_subj, g_srel1=g_srel1,
         contexts_renumbered=renumbered,
     )
-    # carry the lookup index forward: when the previous snapshot has one,
-    # advance it by the delta (O(E + D log E) merges) instead of letting
-    # the next lookup pay a full O(E log E) rebuild (round-2 Weak #4)
-    if getattr(prev, "_lookup_index", None) is not None:
-        from ..engine.lookup import advance_lookup_index
+    if not defer and not chained:
+        # carry the lookup index forward: when the previous snapshot has
+        # one, advance it by the delta (O(E + D log E) merges) instead of
+        # letting the next lookup pay a full O(E log E) rebuild.  Only on
+        # the unchained eager path: gone_rows must index PREV's merged
+        # rows, and a chain's base_hit indexes the base instead (and
+        # misses overlay-only deletions) — a chained prev simply lets the
+        # next lookup rebuild
+        if getattr(prev, "_lookup_index", None) is not None:
+            from ..engine.lookup import advance_lookup_index
 
-        advance_lookup_index(
-            prev, nxt,
-            gone_rows=np.unique(gone[gone >= 0]) if gone.size else gone,
-            a_rel=a_rel, a_res=a_res, a_subj=a_subj, a_srel1=a_srel1,
-        )
+            prev_rows = (
+                np.unique(base_hit[base_hit >= 0])
+                if base_hit.size else np.zeros(0, np.int64)
+            )
+            advance_lookup_index(
+                prev, nxt, gone_rows=prev_rows,
+                a_rel=a_rel, a_res=a_res, a_subj=a_subj, a_srel1=a_srel1,
+            )
     return nxt

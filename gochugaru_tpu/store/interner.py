@@ -91,3 +91,10 @@ class Interner:
         """int32[num_nodes] type id per node (snapshot-time copy)."""
         with self._lock:
             return np.asarray(self._node_type, dtype=np.int32)
+
+    def node_type_tail(self, start: int) -> np.ndarray:
+        """Type ids of nodes interned at or after ``start`` — lets the
+        O(delta) snapshot path extend a base node_type array without
+        copying the full list (store/delta.py LsmSnapshot)."""
+        with self._lock:
+            return np.asarray(self._node_type[start:], dtype=np.int32)

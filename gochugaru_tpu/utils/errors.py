@@ -60,6 +60,29 @@ class PartialDeletionError(AuthzError):
     """DeleteAtomic did not complete (client/client.go:331-333)."""
 
 
+class BulkCheckItemError(AuthzError):
+    """One item of a bulk Check failed to evaluate.  The reference's
+    CheckBulkPermissions maps per-item errors by aborting the result walk
+    and returning the results accumulated so far alongside the error
+    (client/client.go:279-283); ``results`` carries those partial
+    per-item booleans and ``index`` the failing item's position.
+
+    Never retriable (``is_retriable`` short-circuits on the class): the
+    reference retries the RPC, not the per-item mapping — and the
+    substring classifier must not re-match retry phrases inside the
+    embedded cause message.  Not a PermanentError subclass because the
+    retry envelope unwraps those to their cause, which would lose the
+    partial results."""
+
+    def __init__(self, index: int, results, cause: BaseException) -> None:
+        super().__init__(
+            f"check item {index} failed: {type(cause).__name__}: {cause}"
+        )
+        self.index = index
+        self.results = results
+        self.__cause__ = cause
+
+
 class OverlapKeyMissingError(RuntimeError):
     """Raised (the reference panics) when WithOverlapRequired is set and a
     request carries no overlap key (client/client.go:182-191)."""
@@ -72,7 +95,7 @@ def is_retriable(err: BaseException) -> bool:
     """The retry classifier (client/client.go:193-203): Unavailable /
     DeadlineExceeded classes, the two SpiceDB compat strings, or a context
     deadline error; everything else is permanent."""
-    if isinstance(err, PermanentError):
+    if isinstance(err, (PermanentError, BulkCheckItemError)):
         return False
     if isinstance(err, (UnavailableError, DeadlineExceededError)):
         return True
