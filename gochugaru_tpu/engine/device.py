@@ -51,6 +51,10 @@ from ..schema.compiler import CompiledSchema
 from ..store.snapshot import Snapshot
 from .plan import DevicePlan, EngineConfig, build_plan
 
+#: edge-count floor for the prepare-time lookup-index prewarm thread:
+#: small worlds build the index in microseconds inside the first lookup
+LOOKUP_PREWARM_MIN_EDGES = 65_536
+
 I32_MAX = 2**31 - 1
 
 
@@ -757,6 +761,25 @@ class DeviceEngine:
         tid_map = np.full(max(self.plan.num_schema_types, 1), -1, dtype=np.int32)
         for tname, tid in self.compiled.type_ids.items():
             tid_map[tid] = snap.interner.type_lookup(tname)
+        if (
+            self.config.lookup_prewarm
+            and snap.num_edges >= LOOKUP_PREWARM_MIN_EDGES
+            and getattr(snap, "_lookup_index", None) is None
+        ):
+            # build the transposed lookup index off-thread (numpy sorts
+            # release the GIL): the first lookup_resources at 1M+ docs
+            # then joins a mostly-finished build instead of paying the
+            # whole O(E log E) cold start inside a user-facing query
+            # (/root/reference/client/client.go:508-552 is the surface)
+            import threading
+
+            from .lookup import lookup_index
+
+            threading.Thread(
+                target=lookup_index, args=(snap,),
+                kwargs={"mark_used": False},
+                name="gochugaru-lookup-prewarm", daemon=True,
+            ).start()
         return DeviceSnapshot(
             revision=snap.revision,
             arrays=arrays,

@@ -38,6 +38,7 @@ a delta, incrementally advances) the transposed index.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
@@ -114,10 +115,37 @@ def _perm_tables(snap: Snapshot):
     return perm_table, perm_slots_of_tid
 
 
-def lookup_index(snap: Snapshot) -> LookupIndex:
+_BUILD_LOCK_GUARD = threading.Lock()
+
+
+def lookup_index(snap: Snapshot, mark_used: bool = True) -> LookupIndex:
+    """The transposed index, built once per snapshot.  ``mark_used``
+    records that lookups are actually consumed on this snapshot — the
+    signal apply_delta's defer heuristic reads; the prepare-time prewarm
+    passes False so merely prewarming never pushes Watch revisions onto
+    the eager O(E) path (store/delta.py)."""
+    if mark_used:
+        snap._lookup_used = True
     idx = getattr(snap, "_lookup_index", None)
     if idx is not None:
         return idx
+    # race-safe: the prepare-time prewarm thread (engine/device.py) and a
+    # first user lookup may arrive together — one builds, the other
+    # waits.  Lock creation itself goes through a module-level guard so
+    # two racers can't each mint their own lock and build twice
+    with _BUILD_LOCK_GUARD:
+        lock = getattr(snap, "_lookup_build_lock", None)
+        if lock is None:
+            lock = threading.Lock()
+            snap._lookup_build_lock = lock
+    with lock:
+        idx = getattr(snap, "_lookup_index", None)
+        if idx is not None:
+            return idx
+        return _build_lookup_index(snap)
+
+
+def _build_lookup_index(snap: Snapshot) -> LookupIndex:
     NS1 = snap.num_slots + 1
     order = lexsort2(snap.e_subj, snap.e_srel1)
     rs_key = (

@@ -75,6 +75,11 @@ class EngineConfig:
     #: max(this, E/8) trigger compaction: the next prepare rebuilds the
     #: base instead of growing the overlay (engine/flat.py delta level)
     flat_delta_min_compact: int = 65_536
+    #: prewarm the transposed lookup index in a background thread at full
+    #: prepare time (worlds ≥ LOOKUP_PREWARM_MIN_EDGES edges): cold
+    #: lookup_resources joins a mostly-finished build instead of paying
+    #: the O(E log E) sort inside the first user-facing query
+    lookup_prewarm: bool = True
     #: dl_* table shape floor: delta tables pre-size to this many rows so
     #: consecutive revisions keep ONE compiled kernel instead of
     #: retracing at every pow2 row-count boundary (a retrace costs ~1s —

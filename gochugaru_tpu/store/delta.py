@@ -165,8 +165,17 @@ def _locate(
     out = np.full(D, -1, dtype=np.int64)
     if D == 0 or prev.e_rel.shape[0] == 0:
         return out
-    prev_rr = _pack_rr(prev.e_rel, prev.e_res)
-    prev_ss = _pack_ss(prev.e_subj, prev.e_srel1)
+    # packed identity keys cached per snapshot: a delta chain locates
+    # against the same base every revision, and re-packing 2·E int64
+    # columns per delta was the only remaining O(E) term of the LSM path
+    packed = prev.__dict__.get("_packed_id_keys")
+    if packed is None:
+        packed = (
+            _pack_rr(prev.e_rel, prev.e_res),
+            _pack_ss(prev.e_subj, prev.e_srel1),
+        )
+        prev.__dict__["_packed_id_keys"] = packed
+    prev_rr, prev_ss = packed
     q_rr = _pack_rr(rel, res)
     q_ss = _pack_ss(subj, srel1)
     lo = np.searchsorted(prev_rr, q_rr, side="left")
@@ -520,8 +529,12 @@ def apply_delta(
         nctx_ub == 0 or len(contexts) > 2 * nctx_ub
     )
     if defer is None:
+        # "_lookup_used" (set when a lookup actually consumes the index,
+        # engine/lookup.py) — NOT mere index presence: the prepare-time
+        # prewarm plants an index on every big snapshot, and keying on it
+        # would push all Watch revisions onto the eager O(E) path
         defer = (
-            getattr(prev, "_lookup_index", None) is None
+            not getattr(prev, "_lookup_used", False)
             and not over_bound
             and not ctx_over
         )
