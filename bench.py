@@ -279,9 +279,12 @@ def child_main(mode: str, note: str | None) -> None:
         from gochugaru_tpu.utils.platform import force_cpu_platform
 
         force_cpu_platform()
+        # SPEC world even on the CPU fallback (10k repos × 1k users,
+        # ramp to the 100k-class batch): a degraded run must measure the
+        # config it names, just slower — never a silently smaller graph
         run_bench(
-            batches=(8_192, 32_768),
-            world_kw=dict(n_repos=2_000, n_users=500, n_teams=50, n_orgs=5),
+            batches=(8_192, 32_768, 131_072),
+            world_kw={},
             budget_s=CPU_CHILD_TIMEOUT_S,
             note=note or "degraded: cpu fallback",
         )
