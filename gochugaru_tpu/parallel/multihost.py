@@ -1,0 +1,221 @@
+"""Multi-host (multi-process) deployment: jax.distributed startup, a
+global mesh spanning processes, and the 2-process CPU dryrun that proves
+the bucket-sharded tables + replicated overlays work across process
+boundaries.
+
+This is the distributed-communication backend SURVEY.md §5 maps from the
+reference's gRPC process boundary (/root/reference/client/client.go:31-61):
+collectives ride ICI *within* a slice and DCN *across* slices, selected
+by XLA from the mesh layout — the code is identical either way.
+
+Deployment shape for BASELINE config 5's v5e-16 (two v5e-8 slices):
+
+- one process per host; each calls :func:`initialize` (coordinator =
+  host 0), then builds the SAME snapshot tables from its replicated
+  store feed — the standard multihost pattern: identical host inputs +
+  ``jax.device_put(x, NamedSharding(global_mesh, spec))`` yield one
+  consistent global array.
+- mesh ``(data, model)`` from :func:`global_mesh`: the model (edge-
+  bucket) axis should stay WITHIN a slice so the per-probe psum-OR /
+  single-owner broadcasts ride ICI; the data (query-batch) axis crosses
+  slices over DCN, where the only traffic is the per-dispatch query
+  matrix and the result planes (no per-probe collectives cross DCN).
+  ``global_mesh`` lays devices out process-major, which produces exactly
+  that split when ``data`` is a multiple of the process count.
+- Watch deltas: the ``dl_*`` overlays are replicated (engine/flat.py),
+  so each host ships the same small overlay per revision — the
+  cross-host delta path costs O(delta) per host, never O(E/M)·M.
+
+The dryrun (driver hook: ``__graft_entry__.dryrun_multichip``'s
+multi-process mode) runs this file as a module in N spawned processes on
+the CPU backend (the moral equivalent of serve-testing, SURVEY.md §4)
+and verifies every process's local result shards against the host
+oracle.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """``jax.distributed.initialize`` with env-var defaults
+    (GOCHUGARU_COORDINATOR / GOCHUGARU_NUM_PROCESSES /
+    GOCHUGARU_PROCESS_ID) — call once per process, before any jax
+    computation.  On a single process (no env, no args) this is a no-op
+    so the same entrypoint serves both deployments."""
+    coordinator_address = coordinator_address or os.environ.get(
+        "GOCHUGARU_COORDINATOR"
+    )
+    if num_processes is None:
+        num_processes = int(os.environ.get("GOCHUGARU_NUM_PROCESSES") or "1")
+    if process_id is None:
+        process_id = int(os.environ.get("GOCHUGARU_PROCESS_ID") or "0")
+    if num_processes <= 1:
+        return
+    if not coordinator_address:
+        # fail FAST: silently running each host as its own single-process
+        # JAX would surface only as a confusing mesh-size error later
+        raise ValueError(
+            "multi-process init requires a coordinator address "
+            "(GOCHUGARU_COORDINATOR) when GOCHUGARU_NUM_PROCESSES > 1"
+        )
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh(data: int, model: int):
+    """A (data × model) mesh over every device of every process,
+    process-major: with ``data`` a multiple of the process count, each
+    data row's ``model`` group stays within one process/slice (probe
+    collectives on ICI; only the batch axis crosses DCN)."""
+    from .mesh import make_mesh
+
+    return make_mesh(data, model)
+
+
+# ---------------------------------------------------------------------------
+# 2-process CPU dryrun
+# ---------------------------------------------------------------------------
+
+
+def _worker_main() -> None:
+    """One dryrun process: init distributed CPU JAX, build the shared
+    world, run the sharded check step over the GLOBAL mesh, verify the
+    locally-addressable result rows against the host oracle."""
+    from gochugaru_tpu.utils.platform import force_cpu_platform
+
+    n_local = int(os.environ["GOCHUGARU_DRYRUN_LOCAL_DEVICES"])
+    force_cpu_platform(n_local)
+    initialize()
+    import numpy as np
+
+    import jax
+
+    import __graft_entry__ as ge
+    from gochugaru_tpu.engine.oracle import T
+    from gochugaru_tpu.parallel import ShardedEngine
+
+    pid = jax.process_index()
+    n_dev = len(jax.devices())
+    model = 2 if n_local % 2 == 0 else 1
+    data = n_dev // model
+    mesh = global_mesh(data, model)
+
+    cs, snap, oracle, checks = ge._world(n_checks=32)
+    engine = ShardedEngine(cs, mesh)
+    dsnap = engine.prepare(snap)
+    queries, _, qctx = engine._lower_queries(snap, checks, dsnap.strings)
+    d, p, ovf = engine._dispatch_columns(
+        dsnap, queries, qctx, ge.NOW_US, fetch=False
+    )
+    # every process verifies ITS addressable shard rows (deduped: the
+    # model axis replicates each data shard); row index = the global
+    # position on the data-partitioned axis 0
+    seen = set()
+    checked = 0
+    for shard, oshard in zip(d.addressable_shards, ovf.addressable_shards):
+        lo = shard.index[0].start or 0
+        if lo in seen:
+            continue
+        seen.add(lo)
+        vals = np.asarray(shard.data)
+        ovals = np.asarray(oshard.data)
+        for j, got in enumerate(vals):
+            gi = lo + j
+            if gi >= len(checks):
+                continue
+            assert not ovals[j], (
+                f"proc {pid}: unexpected overflow at {checks[gi]} (row {gi})"
+            )
+            want = oracle.check_relationship(checks[gi]) == T
+            assert bool(got) == want, (
+                f"proc {pid}: mismatch at {checks[gi]} (row {gi})"
+            )
+            checked += 1
+    print(f"DRYRUN-OK proc={pid} devices={n_dev} mesh={data}x{model} "
+          f"verified={checked}/{len(checks)}", flush=True)
+
+
+def dryrun_multihost(
+    n_processes: int = 2, n_devices: int = 8, timeout_s: int = 600
+) -> None:
+    """Spawn ``n_processes`` CPU processes (each with
+    ``n_devices // n_processes`` virtual devices), run the full sharded
+    check step over the process-spanning global mesh, and require every
+    process to verify its result shards.  The multi-process analogue of
+    ``__graft_entry__.dryrun_multichip``."""
+    assert n_devices % n_processes == 0
+    local = n_devices // n_processes
+    # a fresh coordinator port per run: a stale worker from a timed-out
+    # previous run holding the hardcoded port would otherwise absorb the
+    # new run's joins into a zombie coordinator
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+    procs = []
+    for pid in range(n_processes):
+        env = dict(
+            os.environ,
+            GOCHUGARU_COORDINATOR=coordinator,
+            GOCHUGARU_NUM_PROCESSES=str(n_processes),
+            GOCHUGARU_PROCESS_ID=str(pid),
+            GOCHUGARU_DRYRUN_LOCAL_DEVICES=str(local),
+            JAX_PLATFORMS="cpu",
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "gochugaru_tpu.parallel.multihost"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))
+            )),
+        ))
+    outs = []
+    ok = True
+    for pid, pr in enumerate(procs):
+        try:
+            out, _ = pr.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            pr.kill()
+            out, _ = pr.communicate()
+            ok = False
+        outs.append(out)
+        if pr.returncode != 0 or "DRYRUN-OK" not in (out or ""):
+            ok = False
+    if not ok:
+        for pid, out in enumerate(outs):
+            tail = "\n".join((out or "").splitlines()[-12:])
+            print(f"--- proc {pid} tail ---\n{tail}", file=sys.stderr)
+        raise RuntimeError("multi-host dryrun failed")
+    total = 0
+    want = None
+    for out in outs:
+        for line in (out or "").splitlines():
+            if line.startswith("DRYRUN-OK"):
+                print(line)
+                frac = line.rsplit("verified=", 1)[1]
+                k, n = frac.split("/")
+                total += int(k)
+                want = int(n)
+    if want is not None and total < want:
+        raise RuntimeError(
+            f"dryrun shards covered only {total}/{want} checks across"
+            " processes — data-axis partitioning is dropping rows"
+        )
+
+
+if __name__ == "__main__":
+    _worker_main()

@@ -1,0 +1,18 @@
+"""Multi-host (multi-process) skeleton: jax.distributed over CPU.
+
+The 2-process dryrun is the moral equivalent of the reference's
+serve-testing container (SURVEY.md §4): real process boundaries, real
+collectives (Gloo), the same sharded kernel.  It closes SURVEY §5's
+"distributed communication backend" item — ICI/DCN selection is XLA's
+job once the mesh spans processes (parallel/multihost.py docstring maps
+the v5e-16 deployment).
+"""
+
+from gochugaru_tpu.parallel.multihost import dryrun_multihost
+
+
+def test_two_process_dryrun():
+    # spawns 2 CPU processes × 4 virtual devices joined by
+    # jax.distributed; every process verifies its addressable result
+    # shards and the parent asserts full batch coverage
+    dryrun_multihost(n_processes=2, n_devices=8)
