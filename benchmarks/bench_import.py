@@ -60,6 +60,22 @@ def main() -> None:
     emit("bulk_import_edges_per_sec", rate, "edges/sec", rate / 1_000_000)
     note(f"import: {dt:.1f}s for {args.edges:,} edges")
 
+    # columnar path: same shape, fresh id space, no per-edge objects —
+    # the native restore API (Client.import_relationship_columns)
+    rids = [f"cd{i % n_docs}" for i in range(args.edges)]
+    sids = [f"cu{i // n_docs}" for i in range(args.edges)]
+    t0 = time.perf_counter()
+    c.import_relationship_columns(
+        ctx, resource_type="doc", resource_ids=rids,
+        resource_relation="reader", subject_type="user", subject_ids=sids,
+    )
+    dt = time.perf_counter() - t0
+    emit(
+        "bulk_import_columnar_edges_per_sec", args.edges / dt, "edges/sec",
+        args.edges / dt / 1_000_000,
+    )
+    note(f"columnar import: {dt:.1f}s for {args.edges:,} edges")
+
     full = consistency.full()
     t0 = time.perf_counter()
     assert c.check_one(

@@ -25,7 +25,17 @@ from __future__ import annotations
 
 import contextlib
 import threading
-from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Tuple
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Iterable,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 import numpy as np
 
@@ -500,6 +510,37 @@ class Client:
             if len(chunk) >= IMPORT_BUFFER:
                 flush()
         flush()
+
+    def import_relationship_columns(
+        self,
+        ctx: Context,
+        *,
+        resource_type: str,
+        resource_ids: Sequence[str],
+        resource_relation: str,
+        subject_type: str,
+        subject_ids: Sequence[str],
+        subject_relation: str = "",
+    ) -> None:
+        """Columnar bulk restore: one relationship shape, ids as parallel
+        string columns — the native-path complement of
+        ``import_relationships`` for the plain rows that dominate
+        restores (no per-edge objects; batch interning; one validation).
+        Falls back to a retried TOUCH import on AlreadyExists, like the
+        reference's recovery (client/client.go:448-463)."""
+        self._check_overlap(ctx)
+        kw = dict(
+            resource_type=resource_type, resource_ids=resource_ids,
+            resource_relation=resource_relation,
+            subject_type=subject_type, subject_ids=subject_ids,
+            subject_relation=subject_relation,
+        )
+        try:
+            self._store.import_columns(**kw)
+        except AlreadyExistsError:
+            retry_retriable_errors(
+                ctx, lambda: self._store.import_columns(**kw, touch=True)
+            )
 
     def export_relationships(
         self, ctx: Context, revision: str

@@ -51,7 +51,7 @@ from ..utils.errors import (
     PreconditionFailedError,
     RevisionUnavailableError,
 )
-from .columns import ColumnSegment, pack_keys, relationships_to_columns
+from .columns import KEY_DT, ColumnSegment, pack_keys, relationships_to_columns
 from .interner import Interner
 from .snapshot import Snapshot, build_snapshot, build_snapshot_from_columns
 
@@ -564,6 +564,89 @@ class Store:
             self._new_data.notify_all()
             return RevisionToken(self._head_rev)
 
+    def import_columns(
+        self,
+        *,
+        resource_type: str,
+        resource_ids: Sequence[str],
+        resource_relation: str,
+        subject_type: str,
+        subject_ids: Sequence[str],
+        subject_relation: str = "",
+        touch: bool = False,
+    ) -> str:
+        """Columnar bulk import: one (resource type, relation, subject
+        type[, subject relation]) SHAPE per call, ids as parallel string
+        columns.  This is the restore path the S2-compression lesson
+        points at (SURVEY.md §2.1 — "compress the boundary": intern
+        strings host-side, ship int32 columns): no per-edge Relationship
+        objects, one validation for the whole call, batch interning.
+        Caveated/expiring rows use the object path
+        (``import_relationships``).  Returns the minted revision; raises
+        AlreadyExistsError (nothing applied) on any live duplicate
+        unless ``touch``."""
+        B = len(resource_ids)
+        if len(subject_ids) != B:
+            raise ValueError("resource_ids and subject_ids lengths differ")
+        with self._lock:
+            compiled = self._require_schema()
+            now_us = self._now_us()
+            # shape validation: wildcardness is part of the validation
+            # shape, so a mixed batch validates BOTH representatives
+            concrete = next((s for s in subject_ids if s != "*"), None)
+            reps = ([concrete] if concrete is not None else []) + (
+                ["*"] if "*" in subject_ids else []
+            )
+            for rep in reps or (["x"] if B == 0 else []):
+                compiled.validate_relationship(Relationship(
+                    resource_type=resource_type,
+                    resource_id=resource_ids[0] if B else "x",
+                    resource_relation=resource_relation,
+                    subject_type=subject_type,
+                    subject_id=rep,
+                    subject_relation=subject_relation,
+                ))
+            if B == 0:
+                return RevisionToken(self._head_rev)
+            itn = self.interner
+            if hasattr(itn, "node_batch"):
+                res = itn.node_batch(resource_type, resource_ids)
+                subj = itn.node_batch(subject_type, subject_ids)
+            else:
+                res = np.fromiter(
+                    (itn.node(resource_type, i) for i in resource_ids),
+                    np.int32, B,
+                )
+                subj = np.fromiter(
+                    (itn.node(subject_type, i) for i in subject_ids),
+                    np.int32, B,
+                )
+            slot_of = compiled.slot_of_name
+            cols = {
+                "res": res,
+                "rel": np.full(B, slot_of[resource_relation], np.int32),
+                "subj": subj,
+                "srel1": np.full(
+                    B,
+                    slot_of[subject_relation] + 1 if subject_relation else 0,
+                    np.int32,
+                ),
+                "caveat": np.zeros(B, np.int32),
+                "ctx": np.full(B, -1, np.int32),
+                "exp_us": np.zeros(B, np.int64),
+            }
+
+            def describe(i: int) -> str:
+                srel = f"#{subject_relation}" if subject_relation else ""
+                return (
+                    f"{resource_type}:{resource_ids[i]}#{resource_relation}"
+                    f"@{subject_type}:{subject_ids[i]}{srel}"
+                )
+
+            return self._commit_columns_locked(
+                cols, now_us, touch, describe=describe
+            )
+
     def _import_columnar_locked(
         self,
         batch: List[Relationship],
@@ -575,32 +658,75 @@ class Store:
             batch, compiled, self.interner,
             self._base_contexts, self._base_ctx_index,
         )
+        return self._commit_columns_locked(
+            cols, now_us, touch, describe=lambda i: str(batch[i])
+        )
+
+    def _commit_columns_locked(
+        self,
+        cols: Dict[str, np.ndarray],
+        now_us: int,
+        touch: bool,
+        *,
+        describe,
+    ) -> str:
+        """Shared commit of lowered int columns: batch dedup, existence
+        vs the live dict and base segments, one immutable ColumnSegment,
+        one revision.  ``describe`` lazily renders a row for error
+        messages — the columnar API derives it from the columns, the
+        object path from the batch."""
+        B = int(cols["res"].shape[0])
         keys = pack_keys(cols["res"], cols["rel"], cols["subj"], cols["srel1"])
         order = np.argsort(keys, kind="stable")
         skeys = keys[order]
-        dup = np.zeros(len(batch), bool)
-        if len(batch) > 1:
+        dup = np.zeros(B, bool)
+        if B > 1:
             eq = skeys[1:] == skeys[:-1]
             if touch:
                 # TOUCH upsert: the LAST occurrence of a key wins
                 dup[order[:-1][eq]] = True
             elif eq.any():
                 raise AlreadyExistsError(
-                    f"relationship already exists: {batch[int(order[1:][eq][0])]}"
+                    f"relationship already exists: {describe(int(order[1:][eq][0]))}"
                 )
-        # existence vs the live dict (object keys) and base segments
+        # existence vs the live dict: probe the (small) dict against the
+        # sorted batch keys — O(live · log B), no per-batch-row Python
         dict_hits: List[_Key] = []
         if self._live:
-            for i, r in enumerate(batch):
-                if dup[i]:
+            compiled = self._require_schema()
+            slot_of = compiled.slot_of_name
+            probe = np.empty(1, KEY_DT)
+            for key, existing in self._live.items():
+                if not self._is_live(existing, now_us):
                     continue
-                existing = self._live.get(r.key())
-                if existing is not None and self._is_live(existing, now_us):
+                res = self.interner.lookup(
+                    existing.resource_type, existing.resource_id
+                )
+                subj = self.interner.lookup(
+                    existing.subject_type, existing.subject_id
+                )
+                if res < 0 or subj < 0:
+                    continue  # never interned → cannot collide
+                rel_s = slot_of.get(existing.resource_relation)
+                if existing.subject_relation:
+                    ss = slot_of.get(existing.subject_relation)
+                    if ss is None:
+                        continue
+                    srel1 = ss + 1
+                else:
+                    srel1 = 0
+                if rel_s is None:
+                    continue
+                probe["h"] = (rel_s << 32) | res
+                probe["l"] = (int(subj) << 32) | srel1
+                pos = int(np.searchsorted(skeys, probe[0]))
+                if pos < B and skeys[pos] == probe[0]:
                     if not touch:
                         raise AlreadyExistsError(
-                            f"relationship already exists: {r}"
+                            "relationship already exists: "
+                            f"{describe(int(order[pos]))}"
                         )
-                    dict_hits.append(r.key())
+                    dict_hits.append(key)
         seg_hits: List[Tuple[ColumnSegment, np.ndarray]] = []
         for seg in self._segments:
             hit, rows = seg.rows_of_keys(keys)
@@ -613,7 +739,7 @@ class Store:
                     if not touch:
                         first = int(np.nonzero(hit)[0][int(np.argmax(alive))])
                         raise AlreadyExistsError(
-                            f"relationship already exists: {batch[first]}"
+                            f"relationship already exists: {describe(first)}"
                         )
                     seg_hits.append((seg, live_rows[alive]))
                 # an expired base row is superseded either way

@@ -1,0 +1,140 @@
+"""Columnar bulk-import semantics (Store.import_columns /
+Client.import_relationship_columns).
+
+The contract mirrors the object path's BulkImport behavior
+(/root/reference/client/client.go:438-465): duplicates — in-batch,
+against the live dict, or against base segments — raise
+AlreadyExistsError with NOTHING applied; the client falls back to a
+retried TOUCH that upserts instead.
+"""
+
+import pytest
+
+from gochugaru_tpu import consistency, rel
+from gochugaru_tpu.client import Client
+from gochugaru_tpu.utils.context import background
+from gochugaru_tpu.utils.errors import AlreadyExistsError
+
+SCHEMA = """
+definition user {}
+definition doc {
+    relation reader: user
+    permission read = reader
+}
+"""
+
+
+def _client() -> Client:
+    c = Client()
+    c.write_schema(background(), SCHEMA)
+    return c
+
+
+def test_columnar_import_visibility_and_parity():
+    c = _client()
+    ctx = background()
+    c.import_relationship_columns(
+        ctx, resource_type="doc", resource_ids=[f"d{i}" for i in range(50)],
+        resource_relation="reader",
+        subject_type="user", subject_ids=[f"u{i % 7}" for i in range(50)],
+    )
+    cs = consistency.full()
+    assert c.check_one(ctx, cs, rel.must_from_triple("doc:d3", "read", "user:u3"))
+    assert not c.check_one(ctx, cs, rel.must_from_triple("doc:d3", "read", "user:u4"))
+    got = sorted(
+        str(r) for r in c.read_relationships(ctx, cs, rel.Filter("doc", "d3"))
+    )
+    assert got == ["doc:d3#reader@user:u3"]
+
+
+def test_columnar_import_in_batch_duplicate_raises_atomically():
+    c = _client()
+    with pytest.raises(AlreadyExistsError):
+        c._store.import_columns(
+            resource_type="doc", resource_ids=["a", "b", "a"],
+            resource_relation="reader",
+            subject_type="user", subject_ids=["u", "u", "u"],
+        )
+    # nothing applied
+    assert not c.check_one(
+        background(), consistency.full(),
+        rel.must_from_triple("doc:b", "read", "user:u"),
+    )
+
+
+def test_columnar_import_duplicate_vs_live_dict_touches_via_client():
+    c = _client()
+    ctx = background()
+    txn = rel.Txn()
+    txn.create(rel.must_from_triple("doc:a", "reader", "user:u"))
+    c.write(ctx, txn)
+    # client path: AlreadyExists → TOUCH fallback upserts, no error
+    c.import_relationship_columns(
+        ctx, resource_type="doc", resource_ids=["a", "b"],
+        resource_relation="reader", subject_type="user",
+        subject_ids=["u", "u"],
+    )
+    cs = consistency.full()
+    assert c.check_one(ctx, cs, rel.must_from_triple("doc:b", "read", "user:u"))
+    got = list(c.read_relationships(ctx, cs, rel.Filter("doc", "a")))
+    assert len(got) == 1  # upsert, not a duplicate row
+
+
+def test_columnar_import_duplicate_vs_segment_raises_then_touch():
+    c = _client()
+    ctx = background()
+    c.import_relationship_columns(
+        ctx, resource_type="doc", resource_ids=[f"d{i}" for i in range(20)],
+        resource_relation="reader",
+        subject_type="user", subject_ids=["u"] * 20,
+    )
+    with pytest.raises(AlreadyExistsError):
+        c._store.import_columns(
+            resource_type="doc", resource_ids=["d5", "x"],
+            resource_relation="reader",
+            subject_type="user", subject_ids=["u", "u"],
+        )
+    # client-level recovery
+    c.import_relationship_columns(
+        ctx, resource_type="doc", resource_ids=["d5", "x"],
+        resource_relation="reader",
+        subject_type="user", subject_ids=["u", "u"],
+    )
+    cs = consistency.full()
+    assert c.check_one(ctx, cs, rel.must_from_triple("doc:x", "read", "user:u"))
+    got = list(c.read_relationships(ctx, cs, rel.Filter("doc", "d5")))
+    assert len(got) == 1
+
+
+def test_columnar_import_invalid_shape_rejected():
+    c = _client()
+    with pytest.raises(Exception):
+        c._store.import_columns(
+            resource_type="doc", resource_ids=["a"],
+            resource_relation="reader",
+            subject_type="doc", subject_ids=["b"],  # doc not allowed
+        )
+
+
+def test_columnar_import_userset_subjects():
+    c = Client()
+    ctx = background()
+    c.write_schema(ctx, """
+    definition user {}
+    definition team { relation member: user }
+    definition doc {
+        relation reader: user | team#member
+        permission read = reader
+    }
+    """)
+    txn = rel.Txn()
+    txn.create(rel.must_from_tuple("team:eng#member", "user:bob"))
+    c.write(ctx, txn)
+    c.import_relationship_columns(
+        ctx, resource_type="doc", resource_ids=["a", "b"],
+        resource_relation="reader",
+        subject_type="team", subject_ids=["eng", "eng"],
+        subject_relation="member",
+    )
+    cs = consistency.full()
+    assert c.check_one(ctx, cs, rel.must_from_triple("doc:a", "read", "user:bob"))
