@@ -1,0 +1,82 @@
+"""The int32 key-packing bound (engine/flat.py _node_radix).
+
+The flat engine packs (slot, node) and (subject, srel+1) into single
+int32 columns; a graph with pow2(num_nodes) · (num_slots+1) ≥ 2³¹ can't
+pack and falls back to the legacy two-phase kernel — ~1.1k checks/s on
+the CPU proxy vs millions on the flat path (measured at 4.1M nodes ×
+511 slots, 4M edges).  These tests pin (a) where the bound trips and
+(b) that the fallback stays CORRECT, so the cliff is a measured,
+documented performance edge — never a wrong answer.  README "Status &
+known limits" carries the operator-facing numbers.
+"""
+
+import numpy as np
+
+from gochugaru_tpu import rel
+from gochugaru_tpu.engine.flat import _node_radix
+from gochugaru_tpu.schema import compile_schema, parse_schema
+
+from test_flat_engine import world  # noqa: E402
+
+NOW = 1_700_000_000_000_000
+
+
+class _FakeSnap:
+    def __init__(self, num_nodes, num_slots):
+        self.num_nodes = num_nodes
+        self.num_slots = num_slots
+
+
+def test_radix_bound_formula():
+    # pow2(nodes) · (slots+1) < 2³¹ packs; at/over it does not
+    assert _node_radix(_FakeSnap(1 << 20, 63)) is not None
+    assert _node_radix(_FakeSnap((1 << 25) + 1, 31)) is None  # 2²⁶·32 = 2³¹
+    assert _node_radix(_FakeSnap(1 << 25, 30)) is not None
+    # headroom doubling never pushes past the bound
+    n, s1 = _node_radix(_FakeSnap(1000, 7))
+    assert n * s1 < 2**31 and n >= 2048  # doubled for delta headroom
+
+
+def test_unpackable_world_stays_correct_on_legacy_path():
+    # many slots push a modest world over the packing bound (formula
+    # pinned above at full scale); the legacy two-phase kernel must
+    # answer exactly (differential).  Kept to 48 relations so the
+    # legacy kernel's compile stays test-suite-fast
+    rels_txt = "\n".join(f"    relation r{i}: user" for i in range(48))
+    schema = (
+        "definition user {}\n"
+        f"definition res {{\n{rels_txt}\n    permission p = r0 + r1\n}}"
+    )
+    cs = compile_schema(parse_schema(schema))
+    assert cs.num_slots >= 49
+    rows = []
+    # enough nodes that pow2(nodes)·(slots+1) ≥ 2³¹ requires millions —
+    # too slow for a unit test, so assert the bound formula separately
+    # (above) and exercise the legacy path by disabling flat here
+    for i in range(40):
+        rows.append(rel.must_from_triple(f"res:d{i}", "r0", f"user:u{i % 7}"))
+        if i % 3 == 0:
+            rows.append(rel.must_from_triple(f"res:d{i}", "r1", f"user:u{(i + 1) % 7}"))
+    from gochugaru_tpu.caveats import compile_cel
+    from gochugaru_tpu.engine.device import DeviceEngine
+    from gochugaru_tpu.engine.oracle import Oracle
+    from gochugaru_tpu.engine.plan import EngineConfig
+    from gochugaru_tpu.store.interner import Interner
+    from gochugaru_tpu.store.snapshot import build_snapshot
+
+    snap = build_snapshot(1, cs, Interner(), rows, epoch_us=NOW)
+    oracle = Oracle(cs, rows, {}, now_us=NOW)
+    engine = DeviceEngine(cs, EngineConfig.for_schema(cs, use_flat=False))
+    dsnap = engine.prepare(snap)
+    assert dsnap.flat_meta is None
+    checks = [
+        rel.must_from_triple(f"res:d{i}", "p", f"user:u{u}")
+        for i in range(40)
+        for u in range(7)
+    ]
+    from gochugaru_tpu.engine.oracle import T
+
+    d, p, ovf = engine.check_batch(dsnap, checks, now_us=NOW)
+    for i, q in enumerate(checks):
+        want = oracle.check_relationship(q) == T
+        assert bool(d[i]) == want or ovf[i], q
