@@ -178,10 +178,12 @@ def main() -> None:
     )
     emit(
         "docs_5hop_bulk_check_throughput", rate, "checks/sec/chip",
-        rate / NORTH_STAR_RATE,
+        rate / NORTH_STAR_RATE, edges=int(snap.num_edges), batch=int(B),
     )
     p50, p99, mean = latency_percentiles(roundtrip, reps=20)
-    emit("docs_5hop_batch_p99_latency", p99, "ms", NORTH_STAR_P99_MS / max(p99, 1e-9))
+    emit("docs_5hop_batch_p99_latency", p99, "ms",
+         NORTH_STAR_P99_MS / max(p99, 1e-9),
+         edges=int(snap.num_edges), batch=int(B))
     note(f"p50={p50:.2f}ms p99={p99:.2f}ms mean={mean:.2f}ms")
 
     # device-lookup latency at config-3 scale: backs engine/lookup.py's

@@ -142,7 +142,8 @@ def main() -> None:
     ship = np.asarray(lat_ship[1:]) if len(lat_ship) > 1 else np.asarray(lat_ship)
     total_ms = mat.mean() + ship.mean()
     rate = args.delta / (total_ms / 1000)
-    emit("watch_reindex_updates_per_sec", rate, "updates/sec", rate / 1_000_000)
+    emit("watch_reindex_updates_per_sec", rate, "updates/sec", rate / 1_000_000,
+         edges=int(args.edges), batch=int(args.delta))
     note(
         f"delta={args.delta} materialize={mat.mean():.1f}ms "
         f"device-overlay+probe={ship.mean():.1f}ms total={total_ms:.1f}ms/delta "

@@ -57,7 +57,8 @@ def main() -> None:
     c.import_relationships(ctx, rels)
     dt = time.perf_counter() - t0
     rate = args.edges / dt
-    emit("bulk_import_edges_per_sec", rate, "edges/sec", rate / 1_000_000)
+    emit("bulk_import_edges_per_sec", rate, "edges/sec", rate / 1_000_000,
+         edges=int(args.edges))
     note(f"import: {dt:.1f}s for {args.edges:,} edges")
 
     # columnar path: same shape, fresh id space, no per-edge objects —
@@ -72,7 +73,7 @@ def main() -> None:
     dt = time.perf_counter() - t0
     emit(
         "bulk_import_columnar_edges_per_sec", args.edges / dt, "edges/sec",
-        args.edges / dt / 1_000_000,
+        args.edges / dt / 1_000_000, edges=int(args.edges),
     )
     note(f"columnar import: {dt:.1f}s for {args.edges:,} edges")
 
@@ -86,7 +87,8 @@ def main() -> None:
     t0 = time.perf_counter()
     n = sum(1 for _ in c.export_relationships(ctx, c.read_schema(ctx)[1]))
     dt = time.perf_counter() - t0
-    emit("bulk_export_edges_per_sec", n / dt, "edges/sec", n / dt / 1_000_000)
+    emit("bulk_export_edges_per_sec", n / dt, "edges/sec", n / dt / 1_000_000,
+         edges=int(n))
     note(f"export: {dt:.1f}s for {n:,} live edges")
 
 

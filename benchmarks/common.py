@@ -21,7 +21,12 @@ NORTH_STAR_RATE = 10_000_000  # checks/sec/chip
 NORTH_STAR_P99_MS = 2.0
 
 
-def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
+def emit(
+    metric: str, value: float, unit: str, vs_baseline: float, **extra
+) -> None:
+    """One JSON metric line.  ``extra`` carries measurement-context
+    fields (edges, batch, ...) so a headline number can never silently
+    describe a smaller world than its config names."""
     print(
         json.dumps(
             {
@@ -29,6 +34,7 @@ def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
                 "value": round(float(value), 4),
                 "unit": unit,
                 "vs_baseline": round(float(vs_baseline), 4),
+                **extra,
             }
         )
     )

@@ -117,7 +117,7 @@ def main() -> int:
         lines, notes, reason = run_config(name, cmd, timeout_s, env)
         all_notes.append((name, notes))
         if not lines:
-            rows.append((name, "—", "failed", "—", "—", reason or "no output"))
+            rows.append((name, "—", "failed", "—", "—", "—", "—", reason or "no output"))
             continue
         for parsed in lines:
             vs = parsed.get("vs_baseline")
@@ -127,6 +127,8 @@ def main() -> int:
                 f"{parsed.get('value', 0):,.1f}",
                 parsed.get("unit", ""),
                 f"{vs:.4f}" if isinstance(vs, (int, float)) else "—",
+                f"{parsed['edges']:,}" if "edges" in parsed else "—",
+                f"{parsed['batch']:,}" if "batch" in parsed else "—",
                 parsed.get("note", ""),
             ))
 
@@ -143,8 +145,8 @@ def main() -> int:
             " vs_baseline in each bench's JSON output.\n\n"
         )
         f.write(
-            "| Config | Metric | Value | Unit | vs north star | Note |\n"
-            "|---|---|---|---|---|---|\n"
+            "| Config | Metric | Value | Unit | vs north star | Edges | Batch | Note |\n"
+            "|---|---|---|---|---|---|---|---|\n"
         )
         for r in rows:
             f.write("| " + " | ".join(str(x) for x in r) + " |\n")
