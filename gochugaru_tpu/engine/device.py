@@ -1049,7 +1049,7 @@ class DeviceEngine:
         # ONE packed query matrix (flat.QM_LAYOUT) → one device transfer
         args = (
             dsnap.arrays, dsnap.tid_map, now,
-            jnp.asarray(build_qm(queries, BP)),
+            jnp.asarray(build_qm(queries, BP, dsnap.flat_meta)),
             self._qctx_device(qctx),
         )
         return fn, args

@@ -67,26 +67,46 @@ from .plan import DevicePlan, EngineConfig, ExprIR, _eval_cyclic_pairs
 #: ONE batched query argument — q_self rides as 0/1, row 7 is padding so
 #: the leading dim stays pow2.  Builders: DeviceEngine.flat_fn_and_args,
 #: ShardedEngine._dispatch_flat (data axis = axis 1 there).
-QM_LAYOUT = ("q_res", "q_perm", "q_subj", "q_srel1", "q_wc", "q_ctx",
-             "q_self", "pad")
+QM_LAYOUT = ("q_res", "q_perm", "q_subj", "q_srel1_dense", "q_wc",
+             "q_ctx", "q_self", "q_perm_k1")
 QM_ROWS = len(QM_LAYOUT)
 
 
-def build_qm(queries: Dict[str, "np.ndarray"], BP: int) -> "np.ndarray":
+from functools import lru_cache
+
+
+@lru_cache(maxsize=128)
+def _dense_np(t: Tuple[int, ...]) -> np.ndarray:
+    return np.asarray(t, np.int32) if t else np.full(1, -1, np.int32)
+
+
+def build_qm(queries: Dict[str, "np.ndarray"], BP: int, meta: "FlatMeta"):
     """The packed QM_LAYOUT matrix from length-B query columns, padded to
     ``BP`` — the ONE builder both the single-chip and sharded dispatchers
-    use, so the pad conventions (-1 keys; 0 for srel1/self/pad) cannot
-    drift."""
+    use, so the pad conventions (-1 keys; 0 for srel1/self) cannot drift.
+
+    Slot-bearing rows map through the meta's DENSE slot maps here on the
+    host: row 3 carries the dense srel1 (-1 = the subject relation can
+    never match a stored key), row 7 the dense k1 id of q_perm (-1 =
+    inactive — the root probes miss, programs still evaluate)."""
     B = queries["q_res"].shape[0]
+    k1d = _dense_np(meta.k1_dense)
+    k2d = _dense_np(meta.k2_dense)
     qm = np.full((QM_ROWS, BP), -1, np.int32)
-    qm[3] = qm[6] = qm[7] = 0
+    qm[3] = qm[6] = 0
     qm[0, :B] = queries["q_res"]
     qm[1, :B] = queries["q_perm"]
     qm[2, :B] = queries["q_subj"]
-    qm[3, :B] = np.where(queries["q_srel"] >= 0, queries["q_srel"] + 1, 0)
+    srel = queries["q_srel"]
+    sd = k2d[np.clip(srel, 0, k2d.shape[0] - 1)]
+    qm[3, :B] = np.where(srel < 0, 0, np.where(sd >= 0, sd + 1, -1))
     qm[4, :B] = queries["q_wc"]
     qm[5, :B] = queries["q_ctx"]
     qm[6, :B] = queries["q_self"]
+    qp = queries["q_perm"]
+    qm[7, :B] = np.where(
+        qp >= 0, k1d[np.clip(qp, 0, k1d.shape[0] - 1)], -1
+    )
     return qm
 
 
@@ -215,6 +235,13 @@ class FlatMeta:
     #: recursive folder tree of depth 4 compiles 4 levels, not the full
     #: flat_recursion budget.  Pow2-bucketed for delta stability
     ar_data_depth: int = -1
+    #: dense slot remap (SlotMaps): raw slot → packed k1 / k2 id, -1 =
+    #: inactive (a key using it can never match).  Static kernel sites
+    #: map at trace time; the query matrix maps on the host (build_qm).
+    #: This is what moves the int32 cliff from schema-slot count to
+    #: ACTIVE-slot count
+    k1_dense: Tuple[int, ...] = ()
+    k2_dense: Tuple[int, ...] = ()
     #: permission fold (engine/fold.py P-index): (type_name, perm_slot)
     #: pairs whose BASE evaluation is the pf_e/pf_t probe pair — their
     #: programs compile to nothing when no delta level rides the base
@@ -287,19 +314,76 @@ def _pack(a: np.ndarray, radix: int, b) -> np.ndarray:
     return (a.astype(np.int64) * radix + b).astype(np.int32)
 
 
-def _node_radix(snap) -> Optional[Tuple[int, int]]:
-    """(N, S1) packing radices with delta headroom, or None when keys
-    don't fit int32 (such graphs use the legacy engine)."""
+@dataclass(frozen=True)
+class SlotMaps:
+    """Dense remap of the ACTIVE slots — the packing radices cover only
+    slots that actually appear in keys, not the schema's full slot count.
+    A 100M-node world with 15 active slots packs fine even when the
+    schema declares hundreds (the int32 cliff moves from
+    pow2(nodes)·(schema slots+1) to pow2(nodes)·(active slots+1)).
+
+    ``k1[slot]`` → dense row-key id (slots with stored/folded rows;
+    queried permissions map through the same table, -1 = can never
+    match).  ``k2[slot]`` → dense subject-relation id (slots appearing
+    in any subject-relation position); ``S1`` = len(active k2) + 1, the
+    k2 radix (0 stays "direct subject")."""
+
+    k1: np.ndarray  # int32[num_slots] → dense id or -1
+    k2: np.ndarray  # int32[num_slots] → dense id or -1
+    k1_raw: np.ndarray  # int32[n_k1] dense → raw slot (inverse)
+    k2_raw: np.ndarray  # int32[S1-1] dense → raw slot (inverse)
+    n_k1: int
+    S1: int
+
+
+def _active_maps(snap, cl, extra_k1) -> SlotMaps:
+    """The dense slot maps of one snapshot (+closure, + fold slots)."""
+    ns = max(snap.num_slots, 1)
+    k1_raw = np.unique(np.concatenate([
+        snap.e_rel, snap.us_rel, snap.ar_rel,
+        np.asarray(sorted(extra_k1), np.int32),
+    ]).astype(np.int64))
+    k2_raw = np.unique(np.concatenate([
+        snap.e_srel1[snap.e_srel1 > 0] - 1,
+        snap.us_srel,
+        cl.c_srel1[cl.c_srel1 > 0] - 1,
+        cl.c_grel,
+        snap.pus_r,
+        cl.ovf_srel1[cl.ovf_srel1 > 0] - 1,
+    ]).astype(np.int64))
+    k1 = np.full(ns, -1, np.int32)
+    k1[k1_raw] = np.arange(k1_raw.shape[0], dtype=np.int32)
+    k2 = np.full(ns, -1, np.int32)
+    k2[k2_raw] = np.arange(k2_raw.shape[0], dtype=np.int32)
+    return SlotMaps(
+        k1=k1, k2=k2,
+        k1_raw=k1_raw.astype(np.int32), k2_raw=k2_raw.astype(np.int32),
+        n_k1=int(k1_raw.shape[0]),
+        S1=int(k2_raw.shape[0]) + 1,
+    )
+
+
+def _m_srel1(maps: SlotMaps, srel1: np.ndarray) -> np.ndarray:
+    """Raw srel1 column (0 = direct, else slot+1) → dense srel1."""
+    return np.where(
+        srel1 == 0, 0, maps.k2[np.clip(srel1 - 1, 0, None)] + 1
+    ).astype(np.int32)
+
+
+def _node_radix(snap, maps: SlotMaps) -> Optional[int]:
+    """The node packing radix N with delta headroom, or None when the
+    DENSE keys still don't fit int32 (such graphs use the legacy
+    engine)."""
     N = _ceil_pow2(max(snap.num_nodes, 1), 8)
-    S1 = snap.num_slots + 1
-    if N * snap.num_slots >= 2**31 or N * S1 >= 2**31:
+    width = max(maps.n_k1, maps.S1, 1)
+    if N * width >= 2**31:
         return None
     # headroom for Watch-driven deltas: new nodes (fresh users/resources)
     # must stay under the packing radix or every delta-prepare bails to a
     # full rebuild — double N whenever the key space still fits int32
-    if N < 2 * snap.num_nodes and 2 * N * S1 < 2**31 and 2 * N * snap.num_slots < 2**31:
+    if N < 2 * snap.num_nodes and 2 * N * width < 2**31:
         N *= 2
-    return N, S1
+    return N
 
 
 def _view_flags_of(snap) -> Dict[str, bool]:
@@ -505,9 +589,12 @@ def _arrow_data_depth(snap, cap: int = 64, ts_slot: Optional[int] = None) -> int
     return -1
 
 
-def _run_maxes(gk: np.ndarray, glo: np.ndarray, ghi: np.ndarray, N: int):
-    """Per-slot max run length of a packed (slot·N + res) range index
-    (pow2-bucketed so retraces are rare)."""
+def _run_maxes(gk: np.ndarray, glo: np.ndarray, ghi: np.ndarray, N: int,
+               inv: np.ndarray):
+    """Per-RAW-slot max run length of a packed (dense_slot·N + res) range
+    index (pow2-bucketed so retraces are rare).  ``inv`` maps the packed
+    DENSE slot ids back to raw slots (SlotMaps.k1_raw) — the kernel's
+    static gating is raw-slot keyed."""
     fans: Dict[int, int] = {}
     if gk.shape[0]:
         slots_of = gk.astype(np.int64) // N
@@ -516,11 +603,14 @@ def _run_maxes(gk: np.ndarray, glo: np.ndarray, ghi: np.ndarray, N: int):
         first[1:] = slots_of[1:] != slots_of[:-1]
         starts = np.nonzero(first)[0]
         for s, m in zip(slots_of[starts], np.maximum.reduceat(lens, starts)):
-            fans[int(s)] = _round_fan(int(m))
+            fans[int(inv[int(s)])] = _round_fan(int(m))
     return tuple(sorted(fans.items()))
 
 
-def _tindex_join(snap, config: EngineConfig, cl, us_gk, cl_k1, cl_k2, pus_k, S1):
+def _tindex_join(
+    snap, config: EngineConfig, cl, us_gk, cl_k1, cl_k2, pus_k,
+    maps: SlotMaps,
+):
     """The T-index join (userset edges ⋈ closure-by-target) shared by both
     layout builders: returns (T_k1, T_k2, T_d, T_p, t_slots) or
     None when disabled/ineligible/oversized.  For slots whose userset rows
@@ -532,7 +622,7 @@ def _tindex_join(snap, config: EngineConfig, cl, us_gk, cl_k1, cl_k2, pus_k, S1)
     if not (config.flat_tindex and snap.us_rel.shape[0]):
         return None
     ok = (snap.us_caveat == 0) & (snap.us_perm == 0)
-    pe_all = _pack(snap.us_subj, S1, snap.us_srel + 1)
+    pe_all = _pack(snap.us_subj, maps.S1, maps.k2[snap.us_srel] + 1)
     if snap.pus_n.shape[0]:
         pus_sorted = np.sort(pus_k)
         pos = np.clip(
@@ -601,25 +691,54 @@ def build_flat_arrays(
 ) -> Optional[Tuple[Dict[str, np.ndarray], FlatMeta]]:
     """Hash-index the snapshot + flatten its membership closure.  Returns
     padded host arrays (merged into DeviceSnapshot.arrays) and the static
-    FlatMeta — or None when keys don't pack into int32 (num_nodes ·
-    num_slots ≥ 2³¹; such graphs use the legacy engine)."""
+    FlatMeta — or None when even the DENSE keys don't pack into int32
+    (pow2(num_nodes) · max(active k1 slots, active srels+1) ≥ 2³¹; such
+    graphs use the legacy engine)."""
     from ..store.closure import NEVER, NO_EXP, build_closure
 
-    radix = _node_radix(snap)
-    if radix is None:
+    # cheap pre-bail for clearly-over-bound worlds, BEFORE the closure
+    # and fold are paid for: distinct stored slots lower-bound the dense
+    # width (the closure/fold can only add to it)
+    Npre = _ceil_pow2(max(snap.num_nodes, 1), 8)
+    width_lb = max(
+        np.unique(np.concatenate(
+            [snap.e_rel, snap.us_rel, snap.ar_rel]
+        )).shape[0] if snap.e_rel.shape[0] else 1,
+        (np.unique(snap.us_srel).shape[0] + 1)
+        if snap.us_srel.shape[0] else 1,
+        1,
+    )
+    if Npre * width_lb >= 2**31:
         return None
-    N, S1 = radix
 
     cl = build_closure(snap, per_source_cap=config.closure_source_cap)
 
-    e_k1 = _pack(snap.e_rel, N, snap.e_res)
-    e_k2 = _pack(snap.e_subj, S1, snap.e_srel1)
-    us_gk = _pack(snap.us_rel, N, snap.us_res)
-    ar_gk = _pack(snap.ar_rel, N, snap.ar_res)
-    cl_k1 = _pack(cl.c_src, S1, cl.c_srel1)
-    cl_k2 = _pack(cl.c_g, S1, cl.c_grel + 1)
-    pus_k = _pack(snap.pus_n, S1, snap.pus_r + 1)
-    ovf_k = _pack(cl.ovf_src, S1, cl.ovf_srel1)
+    # the permission fold runs BEFORE key packing: folded permission
+    # slots join the k1 radix (engine/fold.py packs its internal keys in
+    # int64 with raw radices, so it is cliff-immune itself)
+    BS = config.flat_blockslice
+    fr = None
+    if BS and plan is not None:
+        from .fold import fold_permissions
+
+        fr = fold_permissions(snap, config, plan, cl)
+
+    maps = _active_maps(
+        snap, cl, {slot for _, slot in fr.pairs} if fr is not None else ()
+    )
+    N = _node_radix(snap, maps)
+    if N is None:
+        return None
+    S1 = maps.S1
+
+    e_k1 = _pack(maps.k1[snap.e_rel], N, snap.e_res)
+    e_k2 = _pack(snap.e_subj, S1, _m_srel1(maps, snap.e_srel1))
+    us_gk = _pack(maps.k1[snap.us_rel], N, snap.us_res)
+    ar_gk = _pack(maps.k1[snap.ar_rel], N, snap.ar_res)
+    cl_k1 = _pack(cl.c_src, S1, _m_srel1(maps, cl.c_srel1))
+    cl_k2 = _pack(cl.c_g, S1, maps.k2[cl.c_grel] + 1)
+    pus_k = _pack(snap.pus_n, S1, maps.k2[snap.pus_r] + 1)
+    ovf_k = _pack(cl.ovf_src, S1, _m_srel1(maps, cl.ovf_srel1))
 
     eh = build_hash([e_k1, e_k2])
     usr = build_range_hash(us_gk)
@@ -629,7 +748,6 @@ def build_flat_arrays(
     ovfh = build_hash([ovf_k])
 
     out: Dict[str, np.ndarray] = {}
-    BS = config.flat_blockslice
     # view flags, computed up front: they pick the interleaved layouts
     flags = _view_flags_of(snap)
     e_hascav, e_hasexp = flags["e_hascav"], flags["e_hasexp"]
@@ -666,7 +784,9 @@ def build_flat_arrays(
         out["usr_off"] = usr.index.off
         out["usgx"] = interleave_buckets(usr.index, [usr.gk, usr.glo, usr.ghi])
         out["usx"] = interleave_rows(
-            [snap.us_subj, snap.us_srel]
+            # srel rides DENSE (maps.k2): gk packing in the kernel must
+            # match the dense closure/T keys
+            [snap.us_subj, maps.k2[snap.us_srel]]
             + ([snap.us_caveat, snap.us_ctx] if us_hascav else [])
             + ([snap.us_exp] if us_hasexp else [])
             + ([snap.us_perm] if us_hasperm else []),
@@ -696,6 +816,12 @@ def build_flat_arrays(
         put_hash("push", push)
         put_hash("ovfh", ovfh)
 
+        # dense srel column for the scattered ku path (the raw us_srel
+        # base column no longer matches the dense closure keys)
+        out["us_srel_d"] = _pad(
+            maps.k2[snap.us_srel],
+            _ceil_pow2(max(int(snap.us_rel.shape[0]), 1)), -1,
+        )
         E = _ceil_pow2(max(e_k1.shape[0], 1))
         out["e_k1"] = _pad(e_k1, E, -1)
         out["e_k2"] = _pad(e_k2, E, -1)
@@ -709,7 +835,7 @@ def build_flat_arrays(
 
     # ---- T-index: userset edges ⋈ closure-by-target (shared join) -------
     t_kw = dict(has_tindex=False, t_cap=4, t_n=8, t_slots=())
-    tj = _tindex_join(snap, config, cl, us_gk, cl_k1, cl_k2, pus_k, S1)
+    tj = _tindex_join(snap, config, cl, us_gk, cl_k1, cl_k2, pus_k, maps)
     if tj is not None:
         T_k1, T_k2, T_d, T_p, t_slots = tj
         th = build_hash([T_k1, T_k2])
@@ -754,24 +880,25 @@ def build_flat_arrays(
 
     # ---- permission fold (P-index): rewrites → root-level tables -------
     fold_kw: Dict = {}
-    if BS and plan is not None:
-        from .fold import fold_permissions, fold_tindex_join
+    if fr is not None:
+        from .fold import fold_tindex_join
 
-        fr = fold_permissions(snap, config, plan, cl)
-        tj2 = (
-            fold_tindex_join(fr, cl, N, S1, config.flat_tindex_factor)
-            if fr is not None
-            else None
-        )
-        if fr is not None and tj2 is not None:
-            pf_k1 = _pack(fr.e_slot, N, fr.e_res)
+        tj2 = fold_tindex_join(fr, cl, N, maps, config.flat_tindex_factor)
+        if tj2 is not None:
+            # fold rows carry RAW int64 (subj·(num_slots+1)+srel1) keys —
+            # decompose and repack dense
+            S1_raw = snap.num_slots + 1
+            pf_subj = (fr.e_k2 // S1_raw).astype(np.int32)
+            pf_srel1 = (fr.e_k2 % S1_raw).astype(np.int32)
+            pf_k1 = _pack(maps.k1[fr.e_slot], N, fr.e_res)
+            pf_k2 = _pack(pf_subj, S1, _m_srel1(maps, pf_srel1))
             pf_hascav = bool((fr.e_cav != 0).any())
             pf_hasuntil = bool((fr.e_until != NO_EXP).any())
-            pfh = build_hash([pf_k1, fr.e_k2])
+            pfh = build_hash([pf_k1, pf_k2])
             out["pfh_off"] = pfh.off
             out["pfx"] = interleave_buckets(
                 pfh,
-                [pf_k1, fr.e_k2]
+                [pf_k1, pf_k2]
                 + ([fr.e_cav, fr.e_ctx] if pf_hascav else [])
                 + ([fr.e_until] if pf_hasuntil else []),
             )
@@ -785,15 +912,15 @@ def build_flat_arrays(
                 pf_t_cap=_round_cap(pft.cap),
                 pf_hascav=pf_hascav,
                 pf_hasuntil=pf_hasuntil,
-                pf_haswc=bool(
-                    np.isin(fr.e_k2.astype(np.int64) // S1, wc_nodes).any()
-                ),
+                pf_haswc=bool(np.isin(pf_subj, wc_nodes).any()),
                 pf_has_e=pf_k1.shape[0] > 0,
                 pf_has_t=T2_k1.shape[0] > 0,
             )
 
     meta = FlatMeta(
         N=N, S1=S1,
+        k1_dense=tuple(int(x) for x in maps.k1),
+        k2_dense=tuple(int(x) for x in maps.k2),
         **rc_kw,
         **fold_kw,
         e_cap=_round_cap(eh.cap), e_n=_ceil_pow2(max(eh.n, 1)),
@@ -808,8 +935,8 @@ def build_flat_arrays(
         pus_cap=_round_cap(push.cap), pus_n=_ceil_pow2(max(push.n, 1)),
         ovf_cap=_round_cap(ovfh.cap), ovf_n=_ceil_pow2(max(ovfh.n, 1)),
         has_ovf=ovfh.n > 0,
-        ar_fanout_by_slot=_run_maxes(arr.gk, arr.glo, arr.ghi, N),
-        us_fanout_by_slot=_run_maxes(usr.gk, usr.glo, usr.ghi, N),
+        ar_fanout_by_slot=_run_maxes(arr.gk, arr.glo, arr.ghi, N, maps.k1_raw),
+        us_fanout_by_slot=_run_maxes(usr.gk, usr.glo, usr.ghi, N, maps.k1_raw),
         **t_kw,
         e_hascav=e_hascav,
         e_hasexp=e_hasexp,
@@ -944,21 +1071,24 @@ def build_flat_arrays_sharded(
     from ..store.closure import build_closure
 
     M = model_size
-    radix = _node_radix(snap)
-    if radix is None:
-        return None
-    N, S1 = radix
-
     cl = build_closure(snap, per_source_cap=config.closure_source_cap)
 
-    e_k1 = _pack(snap.e_rel, N, snap.e_res)
-    e_k2 = _pack(snap.e_subj, S1, snap.e_srel1)
-    us_gk = _pack(snap.us_rel, N, snap.us_res)
-    ar_gk = _pack(snap.ar_rel, N, snap.ar_res)
-    cl_k1 = _pack(cl.c_src, S1, cl.c_srel1)
-    cl_k2 = _pack(cl.c_g, S1, cl.c_grel + 1)
-    pus_k = _pack(snap.pus_n, S1, snap.pus_r + 1)
-    ovf_k = _pack(cl.ovf_src, S1, cl.ovf_srel1)
+    # no fold under sharding (the walked kernel answers): k1 actives are
+    # the stored-row slots only
+    maps = _active_maps(snap, cl, ())
+    N = _node_radix(snap, maps)
+    if N is None:
+        return None
+    S1 = maps.S1
+
+    e_k1 = _pack(maps.k1[snap.e_rel], N, snap.e_res)
+    e_k2 = _pack(snap.e_subj, S1, _m_srel1(maps, snap.e_srel1))
+    us_gk = _pack(maps.k1[snap.us_rel], N, snap.us_res)
+    ar_gk = _pack(maps.k1[snap.ar_rel], N, snap.ar_res)
+    cl_k1 = _pack(cl.c_src, S1, _m_srel1(maps, cl.c_srel1))
+    cl_k2 = _pack(cl.c_g, S1, maps.k2[cl.c_grel] + 1)
+    pus_k = _pack(snap.pus_n, S1, maps.k2[snap.pus_r] + 1)
+    ovf_k = _pack(cl.ovf_src, S1, _m_srel1(maps, cl.ovf_srel1))
 
     flags = _view_flags_of(snap)
 
@@ -986,7 +1116,8 @@ def build_flat_arrays_sharded(
     arr = build_range_hash(ar_gk, min_size=ms)
     out["usr_off"], out["usgx"], out["usx"], usr_cap = _stack_range(
         usr,
-        [snap.us_subj, snap.us_srel]
+        # srel rides DENSE, matching the dense closure/T keys
+        [snap.us_subj, maps.k2[snap.us_srel]]
         + ([snap.us_caveat, snap.us_ctx] if flags["us_hascav"] else [])
         + ([snap.us_exp] if flags["us_hasexp"] else [])
         + ([snap.us_perm] if flags["us_hasperm"] else []),
@@ -1001,7 +1132,7 @@ def build_flat_arrays_sharded(
     )
 
     t_kw = dict(has_tindex=False, t_cap=4, t_n=8, t_slots=())
-    tj = _tindex_join(snap, config, cl, us_gk, cl_k1, cl_k2, pus_k, S1)
+    tj = _tindex_join(snap, config, cl, us_gk, cl_k1, cl_k2, pus_k, maps)
     if tj is not None:
         T_k1, T_k2, T_d, T_p, t_slots = tj
         th = build_hash([T_k1, T_k2], min_size=ms)
@@ -1030,6 +1161,8 @@ def build_flat_arrays_sharded(
     wc_nodes = snap.wildcard_node_of_type[snap.wildcard_node_of_type >= 0]
     meta = FlatMeta(
         N=N, S1=S1,
+        k1_dense=tuple(int(x) for x in maps.k1),
+        k2_dense=tuple(int(x) for x in maps.k2),
         rc_slots=tuple(sorted(rc_list)),
         e_cap=_round_cap(eh.cap), e_n=_ceil_pow2(max(eh.n, 1)),
         usr_cap=_round_cap(usr_cap),
@@ -1043,8 +1176,8 @@ def build_flat_arrays_sharded(
         pus_cap=_round_cap(push.cap), pus_n=_ceil_pow2(max(push.n, 1)),
         ovf_cap=_round_cap(ovfh.cap), ovf_n=_ceil_pow2(max(ovfh.n, 1)),
         has_ovf=ovfh.n > 0,
-        ar_fanout_by_slot=_run_maxes(arr.gk, arr.glo, arr.ghi, N),
-        us_fanout_by_slot=_run_maxes(usr.gk, usr.glo, usr.ghi, N),
+        ar_fanout_by_slot=_run_maxes(arr.gk, arr.glo, arr.ghi, N, maps.k1_raw),
+        us_fanout_by_slot=_run_maxes(usr.gk, usr.glo, usr.ghi, N, maps.k1_raw),
         **t_kw,
         **flags,
         blockslice=True,
@@ -1082,16 +1215,18 @@ def _perm_table(compiled: CompiledSchema, interner) -> np.ndarray:
 _ACC_COLS = ("rel", "res", "subj", "srel1", "cav", "ctx", "exp")
 
 
-def _acc_collapse(acc: Optional[Dict], di, N: int, S1: int) -> Dict:
+def _acc_collapse(acc: Optional[Dict], di, N: int, S1: int, m1, m2) -> Dict:
     """Fold one revision's DeltaInfo into the accumulated delta state.
 
     ``acc`` holds the collapsed adds (payload columns keyed by primary
     identity) and tombstone identities since the base revision; identities
-    pack into one int64 (both halves < 2³¹ by the FlatMeta radix check)."""
+    pack into one int64 (both DENSE halves < 2³¹ by the radix check —
+    ``m1``/``m2`` are the base meta's slot maps; the caller bails before
+    accumulating any unmappable row)."""
 
     def pack(rel, res, subj, srel1):
-        k1 = rel.astype(np.int64) * N + res.astype(np.int64)
-        k2 = subj.astype(np.int64) * S1 + srel1.astype(np.int64)
+        k1 = m1(rel).astype(np.int64) * N + res.astype(np.int64)
+        k2 = subj.astype(np.int64) * S1 + m2(srel1).astype(np.int64)
         return (k1 << np.int64(31)) | k2
 
     if acc is None:
@@ -1224,8 +1359,33 @@ def build_delta_arrays(
 
     S1 = meta.S1
     N = meta.N
+    # dense remap through the BASE meta's maps: a delta touching a slot
+    # the base never packed (fresh relation first used mid-chain) has no
+    # dense id — bail to a full prepare, which rebuilds the maps.  The
+    # check runs BEFORE accumulation so unmappable keys never enter the
+    # chain state
+    k1d = np.asarray(meta.k1_dense, np.int32)
+    k2d = np.asarray(meta.k2_dense, np.int32)
+
+    def m1(rel):
+        return k1d[np.clip(rel, 0, max(k1d.shape[0] - 1, 0))]
+
+    def m2(srel1):
+        return np.where(
+            srel1 == 0, 0,
+            k2d[np.clip(srel1 - 1, 0, max(k2d.shape[0] - 1, 0))] + 1,
+        )
+
+    for rel_col, srel_col in (
+        (di.a_rel, di.a_srel1), (di.g_rel, di.g_srel1)
+    ):
+        if rel_col.shape[0] and (
+            (m1(rel_col) < 0).any()
+            or (m2(srel_col) <= 0)[srel_col > 0].any()
+        ):
+            return None
     prev_acc = getattr(prev_dsnap, "delta_acc", None)
-    acc = _acc_collapse(prev_acc, di, N, S1)
+    acc = _acc_collapse(prev_acc, di, N, S1, m1, m2)
     # chain-stable anchor for the shape floor below: the BASE revision's
     # edge count (a floor derived from the oscillating current count
     # would retrace on every boundary crossing)
@@ -1254,10 +1414,10 @@ def build_delta_arrays(
     def pk(a, radix, b):
         return (a.astype(np.int64) * radix + b).astype(np.int32)
 
-    a_k1 = pk(acc["a_rel"], N, acc["a_res"])
-    a_k2 = pk(acc["a_subj"], S1, acc["a_srel1"])
-    g_k1 = pk(acc["g_rel"], N, acc["g_res"])
-    g_k2 = pk(acc["g_subj"], S1, acc["g_srel1"])
+    a_k1 = pk(m1(acc["a_rel"]), N, acc["a_res"])
+    a_k2 = pk(acc["a_subj"], S1, m2(acc["a_srel1"]))
+    g_k1 = pk(m1(acc["g_rel"]), N, acc["g_res"])
+    g_k2 = pk(acc["g_subj"], S1, m2(acc["g_srel1"]))
 
     # shape floor: every dl_* table pre-sizes to F rows (2F buckets), so
     # a chain of Watch revisions reuses ONE compiled kernel — without it,
@@ -1307,7 +1467,11 @@ def build_delta_arrays(
         out["dl_usgx"] = interleave_buckets(
             usr.index, [usr.gk, usr.glo, usr.ghi], pad=F
         )
-        cols = [acc["a_subj"][am][order], (acc["a_srel1"][am] - 1)[order]]
+        cols = [
+            acc["a_subj"][am][order],
+            # dense srel, matching the base us view and the closure keys
+            (m2(acc["a_srel1"][am]) - 1)[order],
+        ]
         if meta.us_hascav:
             cols += [acc["a_cav"][am][order], acc["a_ctx"][am][order]]
         if meta.us_hasexp:
@@ -1464,13 +1628,20 @@ def make_flat_fn(
                     out.add(tname_of_tid[a.type_id])
         return frozenset(out)
 
+    K1D = meta.k1_dense  # static sites pack with DENSE slot ids
+
+    def k1c(slot: int):
+        return jnp.int32(K1D[slot] if slot < len(K1D) else -1)
+
     def fn(arrs, tid_map, now, qm, qctx):
         # packed query matrix int32[8, B] (QM_LAYOUT): one host→device
         # transfer per dispatch instead of seven — on a remote-attached
-        # chip each extra arg is a tunnel round-trip in the p99
+        # chip each extra arg is a tunnel round-trip in the p99.  Rows 3
+        # and 7 arrive DENSE-mapped (build_qm)
         q_res, q_perm, q_subj = qm[0], qm[1], qm[2]
         q_srel1, q_wc, q_ctx = qm[3], qm[4], qm[5]
         q_self = qm[6] != 0
+        q_perm_k1 = qm[7]
         if tri is not None:
             tables = {
                 "ectx_vi": arrs["ectx_vi"], "ectx_vf": arrs["ectx_vf"],
@@ -1660,7 +1831,10 @@ def make_flat_fn(
         Nc = jnp.int32(meta.N)
         S1c = jnp.int32(meta.S1)
         # packed per-query subject keys: -1 = "matches nothing"
-        q_k2 = jnp.where(q_subj >= 0, q_subj * S1c + q_srel1, -1)
+        # (q_srel1 < 0 = the subject relation has no dense id)
+        q_k2 = jnp.where(
+            (q_subj >= 0) & (q_srel1 >= 0), q_subj * S1c + q_srel1, -1
+        )
         w_k2 = jnp.where((q_wc >= 0) & (q_srel1 == 0), q_wc * S1c, -1)
         wcl_k = jnp.where(q_wcc >= 0, q_wcc * S1c, -1)
         us_fans = dict(meta.us_fanout_by_slot)
@@ -1692,7 +1866,7 @@ def make_flat_fn(
             zn = jnp.zeros(nodes.shape, bool)
             d = p = zn
             exists = nodes >= 0
-            sc = bq(q_perm, nd) if slot is None else jnp.int32(slot)
+            sc = bq(q_perm_k1, nd) if slot is None else k1c(slot)
             k1 = sc * Nc + jnp.where(exists, nodes, 0)
             if meta.pf_has_e:
                 def pe_site(k2q):
@@ -1761,7 +1935,7 @@ def make_flat_fn(
             d, p, ovf, used = zn, zn, zB, zB
             exists = nodes >= 0
             dyn = slot is None
-            sc = bq(q_perm, nd) if dyn else jnp.int32(slot)
+            sc = bq(q_perm_k1, nd) if dyn else k1c(slot)
             # packed (slot, node) key; invalid nodes use 0 and are masked
             # by `exists` wherever the (possibly aliased) probe lands
             k1 = sc * Nc + jnp.where(exists, nodes, 0)
@@ -1974,7 +2148,7 @@ def make_flat_fn(
                 idx = lo[..., None] + jnp.arange(KU_site, dtype=jnp.int32)
                 idxc = jnp.clip(idx, 0, max(meta.us_rows - 1, 0))
                 s = tk(arrs["us_subj"], idxc)
-                r = tk(arrs["us_srel"], idxc)
+                r = tk(arrs["us_srel_d"], idxc)
                 gk = s * S1c + (r + 1)  # invalid rows (-1, -1) → negative
                 nd2 = nd + 1
                 in_d, in_p = cl_probe(bq(q_k2, nd2), gk)
@@ -2157,7 +2331,7 @@ def make_flat_fn(
                     return z, z, zB, zB
                 Ks = min(K, data_fan)
                 exists = nodes >= 0
-                ak = jnp.int32(ts_slot) * Nc + jnp.where(exists, nodes, 0)
+                ak = k1c(ts_slot) * Nc + jnp.where(exists, nodes, 0)
                 if Ks:
                     lo, hi = range_of("arr", meta.arr_cap, meta.arr_gn, ak)
                 else:

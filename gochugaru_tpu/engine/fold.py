@@ -242,9 +242,10 @@ def fold_permissions(
             return None
         tid = itid[tname]
         m = (snap.e_rel == rel_slot) & (e_type == tid)
-        e_k2 = (
-            snap.e_subj[m].astype(np.int64) * S1 + snap.e_srel1[m]
-        ).astype(np.int32)
+        # RAW int64 identity key (subj·(num_slots+1)+srel1): internal to
+        # the fold, immune to the int32 packing cliff — build_flat_arrays
+        # decomposes and repacks with the dense radices
+        e_k2 = snap.e_subj[m].astype(np.int64) * S1 + snap.e_srel1[m]
         mu = (snap.us_rel == rel_slot) & (us_type == tid)
         return _Rows(
             snap.e_res[m], e_k2, snap.e_caveat[m], snap.e_ctx[m],
@@ -428,19 +429,31 @@ def t_join_core(
     )
 
 
-def fold_tindex_join(fr: FoldResult, cl, N: int, S1: int,
+def fold_tindex_join(fr: FoldResult, cl, N: int, maps,
                      factor: int) -> Optional[Tuple[np.ndarray, ...]]:
     """pf_t: folded userset rows ⋈ closure-by-target, plus the direct
-    group-identity entries — the T-index join over the FOLDED rows.
-    Returns (k1, k2, d_until, p_until) or None when over budget (the
-    caller then drops folding; the walk still answers)."""
+    group-identity entries — the T-index join over the FOLDED rows,
+    packed with the DENSE radices (``maps`` is flat.SlotMaps).  Returns
+    (k1, k2, d_until, p_until) or None when over budget (the caller then
+    drops folding; the walk still answers)."""
     if fr.u_res.shape[0] == 0:
         z = np.zeros(0, np.int32)
         return z, z, z, z
-    k1 = (fr.u_slot.astype(np.int64) * N + fr.u_res).astype(np.int32)
-    pe = (fr.u_subj.astype(np.int64) * S1 + fr.u_srel + 1).astype(np.int32)
-    cl_k1 = (cl.c_src.astype(np.int64) * S1 + cl.c_srel1).astype(np.int32)
-    cl_k2 = (cl.c_g.astype(np.int64) * S1 + cl.c_grel + 1).astype(np.int32)
+    from .flat import _m_srel1  # deferred: flat imports us lazily too
+
+    S1 = maps.S1
+    k1 = (
+        maps.k1[fr.u_slot].astype(np.int64) * N + fr.u_res
+    ).astype(np.int32)
+    pe = (
+        fr.u_subj.astype(np.int64) * S1 + maps.k2[fr.u_srel] + 1
+    ).astype(np.int32)
+    cl_k1 = (
+        cl.c_src.astype(np.int64) * S1 + _m_srel1(maps, cl.c_srel1)
+    ).astype(np.int32)
+    cl_k2 = (
+        cl.c_g.astype(np.int64) * S1 + maps.k2[cl.c_grel] + 1
+    ).astype(np.int32)
     return t_join_core(
         k1, pe, fr.u_until, cl_k1, cl_k2, cl.c_d_until, cl.c_p_until,
         factor * max(int(pe.shape[0]), 1024),

@@ -275,7 +275,7 @@ class ShardedEngine(DeviceEngine):
         # splices it on device
         dsh = NamedSharding(self.mesh, P(None, DATA_AXIS))
         rep = NamedSharding(self.mesh, P())
-        qm_dev = jax.device_put(build_qm(queries, BP), dsh)
+        qm_dev = jax.device_put(build_qm(queries, BP, dsnap.flat_meta), dsh)
         qctx_dev = {k: jax.device_put(v, rep) for k, v in qctx.items()}
         arr_keys = tuple(sorted(dsnap.arrays.keys()))
         # batches with more distinct permissions than flat_max_slots are
@@ -288,13 +288,20 @@ class ShardedEngine(DeviceEngine):
         if multi:
             row_sh = NamedSharding(self.mesh, P(DATA_AXIS))
             # one jitted splice per engine: a fresh jax.jit here would
-            # retrace on every multi-chunk dispatch
+            # retrace on every multi-chunk dispatch.  BOTH slot-bearing
+            # rows splice — leaving row 7 (dense q_perm_k1) unmasked
+            # would let masked-out queries drive the dynamic leaf in
+            # every chunk and OR in spurious overflow flags
             set_perm = self.__dict__.get("_set_perm_fn")
             if set_perm is None:
                 set_perm = jax.jit(
-                    lambda q, pc: q.at[1].set(pc), out_shardings=dsh
+                    lambda q, pc, pk: q.at[1].set(pc).at[7].set(pk),
+                    out_shardings=dsh,
                 )
                 self._set_perm_fn = set_perm
+            from ..engine.flat import _dense_np
+
+            k1d = _dense_np(dsnap.flat_meta.k1_dense)
         d = p = ovf = None
         for at in range(0, max(len(all_slots), 1), cap):
             chunk = tuple(all_slots[at : at + cap])
@@ -303,7 +310,14 @@ class ShardedEngine(DeviceEngine):
                 pc[:B] = np.where(
                     np.isin(q_perm, np.asarray(chunk, np.int32)), q_perm, -1
                 )
-                qmc = set_perm(qm_dev, jax.device_put(pc, row_sh))
+                pk = np.where(
+                    pc >= 0, k1d[np.clip(pc, 0, k1d.shape[0] - 1)], -1
+                ).astype(np.int32)
+                qmc = set_perm(
+                    qm_dev,
+                    jax.device_put(pc, row_sh),
+                    jax.device_put(pk, row_sh),
+                )
             else:
                 qmc = qm_dev
             fn = self._flat_sharded_fn(chunk, dsnap.flat_meta, arr_keys)

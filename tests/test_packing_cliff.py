@@ -1,19 +1,20 @@
-"""The int32 key-packing bound (engine/flat.py _node_radix).
+"""The int32 key-packing bound (engine/flat.py _node_radix + SlotMaps).
 
 The flat engine packs (slot, node) and (subject, srel+1) into single
-int32 columns; a graph with pow2(num_nodes) · (num_slots+1) ≥ 2³¹ can't
-pack and falls back to the legacy two-phase kernel — ~1.1k checks/s on
-the CPU proxy vs millions on the flat path (measured at 4.1M nodes ×
-511 slots, 4M edges).  These tests pin (a) where the bound trips and
-(b) that the fallback stays CORRECT, so the cliff is a measured,
-documented performance edge — never a wrong answer.  README "Status &
-known limits" carries the operator-facing numbers.
+int32 columns through a DENSE remap of the ACTIVE slots — the cliff is
+pow2(num_nodes) · max(active k1 slots, active srels+1) ≥ 2³¹, NOT the
+schema's declared slot count.  A 511-slot schema with 2 active slots
+stays on the flat path at 100M+ nodes; a world genuinely over the dense
+bound falls back to the legacy two-phase kernel (~1.1k checks/s CPU
+proxy vs millions) — these tests pin the bound, the dense engagement,
+and the fallback's correctness.  README "Status & known limits" carries
+the operator-facing numbers.
 """
 
 import numpy as np
 
 from gochugaru_tpu import rel
-from gochugaru_tpu.engine.flat import _node_radix
+from gochugaru_tpu.engine.flat import SlotMaps, _node_radix
 from gochugaru_tpu.schema import compile_schema, parse_schema
 
 from test_flat_engine import world  # noqa: E402
@@ -22,19 +23,54 @@ NOW = 1_700_000_000_000_000
 
 
 class _FakeSnap:
-    def __init__(self, num_nodes, num_slots):
+    def __init__(self, num_nodes):
         self.num_nodes = num_nodes
-        self.num_slots = num_slots
+
+
+def _maps(n_k1, n_k2):
+    z = np.zeros(1, np.int32)
+    return SlotMaps(k1=z, k2=z, k1_raw=z, k2_raw=z, n_k1=n_k1, S1=n_k2 + 1)
 
 
 def test_radix_bound_formula():
-    # pow2(nodes) · (slots+1) < 2³¹ packs; at/over it does not
-    assert _node_radix(_FakeSnap(1 << 20, 63)) is not None
-    assert _node_radix(_FakeSnap((1 << 25) + 1, 31)) is None  # 2²⁶·32 = 2³¹
-    assert _node_radix(_FakeSnap(1 << 25, 30)) is not None
+    # pow2(nodes) · max(active k1, active srels+1) < 2³¹ packs
+    assert _node_radix(_FakeSnap(1 << 20), _maps(63, 62)) is not None
+    assert _node_radix(_FakeSnap((1 << 25) + 1), _maps(31, 31)) is None
+    assert _node_radix(_FakeSnap(1 << 25), _maps(31, 29)) is not None
     # headroom doubling never pushes past the bound
-    n, s1 = _node_radix(_FakeSnap(1000, 7))
-    assert n * s1 < 2**31 and n >= 2048  # doubled for delta headroom
+    n = _node_radix(_FakeSnap(1000), _maps(7, 6))
+    assert n * 7 < 2**31 and n >= 2048  # doubled for delta headroom
+
+
+def test_many_declared_slots_few_active_stays_flat():
+    # the dense remap: hundreds of DECLARED relations but only two
+    # active ones must keep the flat engine (pre-remap this fell off at
+    # pow2(nodes)·(num_slots+1) ≥ 2³¹ and ran ~1.1k checks/s)
+    rels_txt = "\n".join(f"    relation r{i}: user" for i in range(200))
+    schema = (
+        "definition user {}\n"
+        f"definition res {{\n{rels_txt}\n    permission p = r0 + r1\n}}"
+    )
+    rows = [
+        rel.must_from_triple(f"res:d{i}", "r0", f"user:u{i % 5}")
+        for i in range(30)
+    ]
+    engine, dsnap, oracle = world(schema, rows)
+    meta = dsnap.flat_meta
+    assert meta is not None, "dense remap should keep the flat path"
+    # two active k1 slots (r0 rows only → 1) regardless of 200 declared
+    assert sum(1 for x in meta.k1_dense if x >= 0) <= 2
+    from gochugaru_tpu.engine.oracle import T
+
+    checks = [
+        rel.must_from_triple(f"res:d{i}", "p", f"user:u{u}")
+        for i in range(30)
+        for u in range(5)
+    ] + [rel.must_from_triple("res:d0", "r7", "user:u0")]  # inactive slot
+    d, p, ovf = engine.check_batch(dsnap, checks, now_us=NOW)
+    for i, q in enumerate(checks):
+        want = oracle.check_relationship(q) == T
+        assert not ovf[i] and bool(d[i]) == want, q
 
 
 def test_unpackable_world_stays_correct_on_legacy_path():
