@@ -57,8 +57,12 @@ def time_steady(fn: Callable[[], object], reps: int = 5) -> float:
     which ``repeat_harness`` amortizes away for throughput numbers)."""
     import jax
 
-    out = fn()
-    jax.block_until_ready(out)
+    # warm THREE times, not one: the first dispatches after prepare also
+    # fault in the freshly-built tables' pages (multi-GB at 10M+ edges),
+    # which read as a ~3× slower "steady state" if timed
+    for _ in range(3):
+        out = fn()
+        jax.block_until_ready(out)
     _force_sync_mode(out)
     t0 = time.perf_counter()
     for _ in range(reps):
