@@ -91,6 +91,18 @@ def main() -> None:
          edges=int(n))
     note(f"export: {dt:.1f}s for {n:,} live edges")
 
+    t0 = time.perf_counter()
+    n = sum(
+        len(ch["resource_ids"])
+        for ch in c.export_relationship_columns(ctx, c.read_schema(ctx)[1])
+    )
+    dt = time.perf_counter() - t0
+    emit(
+        "bulk_export_columnar_edges_per_sec", n / dt, "edges/sec",
+        n / dt / 1_000_000, edges=int(n),
+    )
+    note(f"columnar export: {dt:.1f}s for {n:,} live edges")
+
 
 if __name__ == "__main__":
     main()

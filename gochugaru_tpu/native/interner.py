@@ -130,20 +130,19 @@ class NativeInterner:
     def __len__(self) -> int:
         return int(self._lib.gi_size(self._h))
 
-    def keys_batch(self, nodes) -> List[Tuple[str, str]]:
-        """(type, id) pairs for an int array of nodes in ONE native call
-        (plus a retry when the id bytes outgrow the buffer guess) — the
-        batched decode path behind snapshot exports."""
+    def _keys_raw(self, nodes):
+        """The shared native fetch behind both key-decode paths:
+        (node array, raw id bytes, byte offsets list, type-id list).
+        Under the lock: concurrent interning may reallocate the C++
+        entry/arena vectors mid-copy (the Python Interner's lock-free
+        read contract does not transfer to std::vector)."""
         nn = np.ascontiguousarray(nodes, np.int64)
         n = int(nn.shape[0])
         if n == 0:
-            return []
+            return nn, b"", [0], []
         offs = np.empty(n + 1, np.int64)
         types = np.empty(n, np.int32)
         cap = max(32 * n, 4096)
-        # under the lock: concurrent interning may reallocate the C++
-        # entry/arena vectors mid-copy (the Python Interner's lock-free
-        # read contract does not transfer to std::vector)
         with self._lock:
             while True:
                 buf = ctypes.create_string_buffer(cap)
@@ -157,17 +156,41 @@ class NativeInterner:
                 if total <= cap:
                     break
                 cap = total
-        raw = buf.raw
+        return nn, buf.raw, offs.tolist(), types.tolist()
+
+    def keys_batch(self, nodes) -> List[Tuple[str, str]]:
+        """(type, id) pairs for an int array of nodes in ONE native call
+        (plus a retry when the id bytes outgrow the buffer guess) — the
+        batched decode path behind snapshot exports."""
+        nn, raw, o, tl = self._keys_raw(nodes)
         tn = self._type_names
-        o = offs.tolist()
-        tl = types.tolist()
         out = []
-        for i in range(n):
+        for i in range(len(tl)):
             t = tl[i]
             if t < 0:  # C++ invalid-node sentinel — match key_of's raise
                 raise IndexError(f"unknown node {int(nn[i])}")
             out.append((tn[t], raw[o[i] : o[i + 1]].decode("utf-8")))
         return out
+
+    def keys_columns(self, nodes) -> Tuple[List[str], List[str]]:
+        """(type_names, ids) as two parallel LISTS — the columnar decode
+        path (snapshot exports): one whole-buffer utf-8 decode plus
+        C-speed str slicing when the ids are ASCII, instead of a per-row
+        bytes slice + decode + tuple."""
+        nn, raw, o, tl = self._keys_raw(nodes)
+        n = len(tl)
+        if n == 0:
+            return [], []
+        if min(tl) < 0:
+            bad = tl.index(-1)
+            raise IndexError(f"unknown node {int(nn[bad])}")
+        text = raw[: o[n]].decode("utf-8")
+        if len(text) == o[n]:  # pure ASCII: byte offsets == char offsets
+            ids = [text[o[i] : o[i + 1]] for i in range(n)]
+        else:
+            ids = [raw[o[i] : o[i + 1]].decode("utf-8") for i in range(n)]
+        tn = self._type_names
+        return [tn[t] for t in tl], ids
 
     @property
     def num_types(self) -> int:

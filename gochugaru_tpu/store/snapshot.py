@@ -211,55 +211,89 @@ class Snapshot:
                         mask &= self.e_srel1 == s + 1
         yield from self._decode_rows(np.nonzero(mask)[0])
 
-    def _decode_rows(self, rows: np.ndarray) -> Iterator[Relationship]:
-        """Batched row decoding: columns convert to Python lists chunk-
-        wise (C-speed) and interner keys fetch in ONE batched call per
-        chunk, so the per-edge loop touches no numpy scalars and no
-        ctypes round trips — ~4x faster than per-row ``decode_edge`` on
-        10M-edge exports."""
+    def decode_columns(
+        self, rows: np.ndarray, chunk: int = 1 << 16
+    ) -> Iterator[Dict[str, list]]:
+        """Columnar row decoding: yields chunks of parallel string/value
+        lists instead of Relationship objects — the native export path
+        (the backup mirror of Store.import_columns).  Each chunk dict
+        holds resource_types/resource_ids/resource_relations/
+        subject_types/subject_ids/subject_relations (lists of str) plus
+        caveat_names, caveat_contexts, expirations_us for rows that
+        carry them.  ~4× faster than object decoding: no dataclass
+        construction, one batched interner fetch per chunk."""
         slot_names = self._slot_names()
         caveat_names = self._caveat_names()
         contexts = self.contexts
-        # progressive chunks: an early-exiting consumer (first-match
-        # reads) pays a 256-row decode, full exports amortize at 64k
+        cols_of = getattr(self.interner, "keys_columns", None)
+        at = 0
+        while at < rows.shape[0]:
+            blk = rows[at : at + chunk]
+            at += chunk
+            if cols_of is not None:
+                rtypes, rids = cols_of(self.e_res[blk])
+                stypes, sids = cols_of(self.e_subj[blk])
+            else:
+                rkeys = self.interner.keys_batch(self.e_res[blk])
+                skeys = self.interner.keys_batch(self.e_subj[blk])
+                rtypes, rids = map(list, zip(*rkeys)) if rkeys else ([], [])
+                stypes, sids = map(list, zip(*skeys)) if skeys else ([], [])
+            srel1 = self.e_srel1[blk].tolist()
+            cav = self.e_caveat[blk].tolist()
+            ctx_i = self.e_ctx[blk].tolist()
+            yield {
+                "resource_types": rtypes,
+                "resource_ids": rids,
+                "resource_relations": [
+                    slot_names[s] for s in self.e_rel[blk].tolist()
+                ],
+                "subject_types": stypes,
+                "subject_ids": sids,
+                "subject_relations": [
+                    slot_names[s - 1] if s > 0 else "" for s in srel1
+                ],
+                "caveat_names": [
+                    caveat_names[c] if c else "" for c in cav
+                ],
+                "caveat_contexts": [
+                    contexts[i] if c and i >= 0 else {}
+                    for c, i in zip(cav, ctx_i)
+                ],
+                "expirations_us": self.e_exp_us[blk].tolist(),
+            }
+
+    def _decode_rows(self, rows: np.ndarray) -> Iterator[Relationship]:
+        """Batched row decoding to Relationship objects, built ON TOP of
+        decode_columns so there is ONE definition of field decoding (the
+        columnar path).  Progressive chunks: an early-exiting consumer
+        (first-match reads) pays a 256-row decode; full exports amortize
+        at 64k."""
         ch, at = 256, 0
         while at < rows.shape[0]:
             blk = rows[at : at + ch]
             at += ch
             ch = min(ch * 4, 1 << 16)
-            rkeys = self.interner.keys_batch(self.e_res[blk])
-            skeys = self.interner.keys_batch(self.e_subj[blk])
-            c_rel = self.e_rel[blk].tolist()
-            c_srel1 = self.e_srel1[blk].tolist()
-            c_cav = self.e_caveat[blk].tolist()
-            c_ctx = self.e_ctx[blk].tolist()
-            c_expus = self.e_exp_us[blk].tolist()
-            for j in range(len(c_rel)):
-                rtype, rid = rkeys[j]
-                stype, sid = skeys[j]
-                srel1 = c_srel1[j]
-                cav = c_cav[j]
-                exp_us = c_expus[j]
-                ctx_i = c_ctx[j]
-                yield Relationship(
-                    resource_type=rtype,
-                    resource_id=rid,
-                    resource_relation=slot_names[c_rel[j]],
-                    subject_type=stype,
-                    subject_id=sid,
-                    subject_relation=slot_names[srel1 - 1] if srel1 > 0 else "",
-                    caveat_name=caveat_names[cav] if cav else "",
-                    caveat_context=(
-                        contexts[ctx_i] if cav and ctx_i >= 0 else {}
-                    ),
-                    expiration=(
-                        _dt.datetime.fromtimestamp(
-                            exp_us / 1_000_000, tz=_dt.timezone.utc
-                        )
-                        if exp_us
-                        else None
-                    ),
-                )
+            for cols in self.decode_columns(blk, chunk=int(blk.shape[0])):
+                rids = cols["resource_ids"]
+                for j in range(len(rids)):
+                    exp_us = cols["expirations_us"][j]
+                    yield Relationship(
+                        resource_type=cols["resource_types"][j],
+                        resource_id=rids[j],
+                        resource_relation=cols["resource_relations"][j],
+                        subject_type=cols["subject_types"][j],
+                        subject_id=cols["subject_ids"][j],
+                        subject_relation=cols["subject_relations"][j],
+                        caveat_name=cols["caveat_names"][j],
+                        caveat_context=cols["caveat_contexts"][j],
+                        expiration=(
+                            _dt.datetime.fromtimestamp(
+                                exp_us / 1_000_000, tz=_dt.timezone.utc
+                            )
+                            if exp_us
+                            else None
+                        ),
+                    )
 
 
 def build_snapshot(

@@ -924,6 +924,15 @@ class Store:
         snap = self.snapshot_for(Strategy(Requirement.SNAPSHOT, revision))
         return snap.iter_relationships(None, now_us=self._now_us())
 
+    def export_columns_at(self, revision: str):
+        """Columnar export at an exact snapshot: yields chunk dicts of
+        parallel lists (Snapshot.decode_columns) — the backup mirror of
+        ``import_columns``, skipping per-edge Relationship objects."""
+        snap = self.snapshot_for(Strategy(Requirement.SNAPSHOT, revision))
+        now_us = self._now_us()
+        live = (snap.e_exp_us == 0) | (snap.e_exp_us > now_us)
+        return snap.decode_columns(np.nonzero(live)[0])
+
     # -- watch -------------------------------------------------------------
     def updates_since(
         self, since_rev: int, *, stop: Optional[threading.Event] = None,
