@@ -58,14 +58,16 @@ def main() -> None:
     gc.collect()
     gc.freeze()
     ts = []
-    for _ in range(200):
+    # 1000 samples: at n=200 the p99 is the 2nd-worst sample, and a
+    # single ambient scheduler/daemon spike poisons it
+    for _ in range(1000):
         t0 = time.perf_counter()
         client.check_all(ctx, cs, *founders)
         ts.append((time.perf_counter() - t0) * 1000)
     a = np.asarray(ts)
     p50, p99 = float(np.percentile(a, 50)), float(np.percentile(a, 99))
     emit("founders_checkall_p99_latency", p99, "ms", NORTH_STAR_P99_MS / max(p99, 1e-9))
-    note(f"p50={p50:.3f}ms p99={p99:.3f}ms mean={a.mean():.3f}ms n=200")
+    note(f"p50={p50:.3f}ms p99={p99:.3f}ms mean={a.mean():.3f}ms n=1000")
 
 
 if __name__ == "__main__":
