@@ -686,6 +686,30 @@ def _rc_build(
     return out
 
 
+def _fold_packed(fr, cl, snap, maps: SlotMaps, N: int, config: EngineConfig):
+    """Dense-packed fold arrays shared by both layout builders:
+    (pf_k1, pf_k2, pf_subj, (T2_k1, T2_k2, T2_d, T2_p), flags) or None
+    when the fold's T join is over budget.  Fold rows carry RAW int64
+    (subj·(num_slots+1)+srel1) identity keys — decomposed here and
+    repacked with the dense radices."""
+    from ..store.closure import NO_EXP
+    from .fold import fold_tindex_join
+
+    tj2 = fold_tindex_join(fr, cl, N, maps, config.flat_tindex_factor)
+    if tj2 is None:
+        return None
+    S1_raw = snap.num_slots + 1
+    pf_subj = (fr.e_k2 // S1_raw).astype(np.int32)
+    pf_srel1 = (fr.e_k2 % S1_raw).astype(np.int32)
+    pf_k1 = _pack(maps.k1[fr.e_slot], N, fr.e_res)
+    pf_k2 = _pack(pf_subj, maps.S1, _m_srel1(maps, pf_srel1))
+    flags = dict(
+        pf_hascav=bool((fr.e_cav != 0).any()),
+        pf_hasuntil=bool((fr.e_until != NO_EXP).any()),
+    )
+    return pf_k1, pf_k2, pf_subj, tj2, flags
+
+
 def build_flat_arrays(
     snap, config: EngineConfig, plan: Optional[DevicePlan] = None
 ) -> Optional[Tuple[Dict[str, np.ndarray], FlatMeta]]:
@@ -694,7 +718,7 @@ def build_flat_arrays(
     FlatMeta — or None when even the DENSE keys don't pack into int32
     (pow2(num_nodes) · max(active k1 slots, active srels+1) ≥ 2³¹; such
     graphs use the legacy engine)."""
-    from ..store.closure import NEVER, NO_EXP, build_closure
+    from ..store.closure import NEVER, build_closure
 
     # cheap pre-bail for clearly-over-bound worlds, BEFORE the closure
     # and fold are paid for: distinct stored slots lower-bound the dense
@@ -881,28 +905,17 @@ def build_flat_arrays(
     # ---- permission fold (P-index): rewrites → root-level tables -------
     fold_kw: Dict = {}
     if fr is not None:
-        from .fold import fold_tindex_join
-
-        tj2 = fold_tindex_join(fr, cl, N, maps, config.flat_tindex_factor)
-        if tj2 is not None:
-            # fold rows carry RAW int64 (subj·(num_slots+1)+srel1) keys —
-            # decompose and repack dense
-            S1_raw = snap.num_slots + 1
-            pf_subj = (fr.e_k2 // S1_raw).astype(np.int32)
-            pf_srel1 = (fr.e_k2 % S1_raw).astype(np.int32)
-            pf_k1 = _pack(maps.k1[fr.e_slot], N, fr.e_res)
-            pf_k2 = _pack(pf_subj, S1, _m_srel1(maps, pf_srel1))
-            pf_hascav = bool((fr.e_cav != 0).any())
-            pf_hasuntil = bool((fr.e_until != NO_EXP).any())
+        got = _fold_packed(fr, cl, snap, maps, N, config)
+        if got is not None:
+            pf_k1, pf_k2, pf_subj, (T2_k1, T2_k2, T2_d, T2_p), pff = got
             pfh = build_hash([pf_k1, pf_k2])
             out["pfh_off"] = pfh.off
             out["pfx"] = interleave_buckets(
                 pfh,
                 [pf_k1, pf_k2]
-                + ([fr.e_cav, fr.e_ctx] if pf_hascav else [])
-                + ([fr.e_until] if pf_hasuntil else []),
+                + ([fr.e_cav, fr.e_ctx] if pff["pf_hascav"] else [])
+                + ([fr.e_until] if pff["pf_hasuntil"] else []),
             )
-            T2_k1, T2_k2, T2_d, T2_p = tj2
             pft = build_hash([T2_k1, T2_k2])
             out["pfth_off"] = pft.off
             out["pftx"] = interleave_buckets(pft, [T2_k1, T2_k2, T2_d, T2_p])
@@ -910,11 +923,10 @@ def build_flat_arrays(
                 fold_pairs=fr.pairs,
                 pf_e_cap=_round_cap(pfh.cap),
                 pf_t_cap=_round_cap(pft.cap),
-                pf_hascav=pf_hascav,
-                pf_hasuntil=pf_hasuntil,
                 pf_haswc=bool(np.isin(pf_subj, wc_nodes).any()),
                 pf_has_e=pf_k1.shape[0] > 0,
                 pf_has_t=T2_k1.shape[0] > 0,
+                **pff,
             )
 
     meta = FlatMeta(
@@ -1073,9 +1085,17 @@ def build_flat_arrays_sharded(
     M = model_size
     cl = build_closure(snap, per_source_cap=config.closure_source_cap)
 
-    # no fold under sharding (the walked kernel answers): k1 actives are
-    # the stored-row slots only
-    maps = _active_maps(snap, cl, ())
+    # the permission fold shards like every other table (stacked pf_e /
+    # pf_t; the kernel's pf probes already mask bucket ownership and
+    # OR-reduce) — folded slots join the k1 radix
+    fr = None
+    if plan is not None:
+        from .fold import fold_permissions
+
+        fr = fold_permissions(snap, config, plan, cl)
+    maps = _active_maps(
+        snap, cl, {slot for _, slot in fr.pairs} if fr is not None else ()
+    )
     N = _node_radix(snap, maps)
     if N is None:
         return None
@@ -1144,6 +1164,34 @@ def build_flat_arrays_sharded(
             t_slots=t_slots,
         )
 
+    wc_nodes = snap.wildcard_node_of_type[snap.wildcard_node_of_type >= 0]
+    fold_kw: Dict = {}
+    if fr is not None:
+        got = _fold_packed(fr, cl, snap, maps, N, config)
+        if got is not None:
+            pf_k1, pf_k2, pf_subj, (T2_k1, T2_k2, T2_d, T2_p), pff = got
+            pfh = build_hash([pf_k1, pf_k2], min_size=ms)
+            out["pfh_off"], out["pfx"] = _stack_point(
+                pfh,
+                [pf_k1, pf_k2]
+                + ([fr.e_cav, fr.e_ctx] if pff["pf_hascav"] else [])
+                + ([fr.e_until] if pff["pf_hasuntil"] else []),
+                M,
+            )
+            pft = build_hash([T2_k1, T2_k2], min_size=ms)
+            out["pfth_off"], out["pftx"] = _stack_point(
+                pft, [T2_k1, T2_k2, T2_d, T2_p], M
+            )
+            fold_kw = dict(
+                fold_pairs=fr.pairs,
+                pf_e_cap=_round_cap(pfh.cap),
+                pf_t_cap=_round_cap(pft.cap),
+                pf_haswc=bool(np.isin(pf_subj, wc_nodes).any()),
+                pf_has_e=pf_k1.shape[0] > 0,
+                pf_has_t=T2_k1.shape[0] > 0,
+                **pff,
+            )
+
     ar_dd = _arrow_data_depth(snap)
     rc_list = []
     for ts_slot, (src, anc, d_u, p_u, fan) in _rc_build(
@@ -1158,11 +1206,11 @@ def build_flat_arrays_sharded(
         ) = _stack_range(ri, [anc, d_u, p_u], M, max(64, fan))
         rc_list.append((int(ts_slot), _round_cap(gcap), fan))
 
-    wc_nodes = snap.wildcard_node_of_type[snap.wildcard_node_of_type >= 0]
     meta = FlatMeta(
         N=N, S1=S1,
         k1_dense=tuple(int(x) for x in maps.k1),
         k2_dense=tuple(int(x) for x in maps.k2),
+        **fold_kw,
         rc_slots=tuple(sorted(rc_list)),
         e_cap=_round_cap(eh.cap), e_n=_ceil_pow2(max(eh.n, 1)),
         usr_cap=_round_cap(usr_cap),

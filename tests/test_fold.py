@@ -197,3 +197,37 @@ def test_fold_delta_reverts_to_walk():
     assert dsnap.flat_meta.fold_pairs
     dmeta = _dc_replace(dsnap.flat_meta, delta=DeltaMeta(has_adds=True))
     assert dmeta.fold_pairs == dsnap.flat_meta.fold_pairs
+
+
+def test_fold_sharded_matches_single_chip():
+    # the folded docs world under the bucket-sharded layout: every plane
+    # must match the single-chip folded engine exactly (pf probes mask
+    # bucket ownership and OR-reduce over the model axis)
+    import jax
+    import pytest
+
+    from gochugaru_tpu.engine.plan import EngineConfig
+    from gochugaru_tpu.parallel import ShardedEngine, make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-virtual-device CPU mesh")
+    engine, dsnap, oracle = _docs_world()
+    assert dsnap.flat_meta.fold_pairs
+    checks = [
+        rel.must_from_triple(f"document:d{d}", "view", f"user:u{u}")
+        for d in range(40) for u in range(12)
+    ]
+    d1, p1, o1 = engine.check_batch(dsnap, checks, now_us=NOW)
+
+    mesh = make_mesh(2, 4)
+    seng = ShardedEngine(
+        engine.compiled, mesh,
+        EngineConfig.for_schema(engine.compiled, flat_recursion=3,
+                                flat_max_width=32),
+    )
+    sds = seng.prepare(dsnap.snapshot)
+    assert sds.flat_meta.sharded and sds.flat_meta.fold_pairs
+    d2, p2, o2 = seng.check_batch(sds, checks, now_us=NOW)
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
