@@ -38,6 +38,7 @@ Replaces the evaluation behind the reference's CheckBulkPermissions
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import lru_cache
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -70,9 +71,6 @@ from .plan import DevicePlan, EngineConfig, ExprIR, _eval_cyclic_pairs
 QM_LAYOUT = ("q_res", "q_perm", "q_subj", "q_srel1_dense", "q_wc",
              "q_ctx", "q_self", "q_perm_k1")
 QM_ROWS = len(QM_LAYOUT)
-
-
-from functools import lru_cache
 
 
 @lru_cache(maxsize=128)
@@ -343,8 +341,10 @@ def _active_maps(snap, cl, extra_k1) -> SlotMaps:
         snap.e_rel, snap.us_rel, snap.ar_rel,
         np.asarray(sorted(extra_k1), np.int32),
     ]).astype(np.int64))
+    # us_srel covers every stored subject-relation by construction (the
+    # userset view IS the primary rows with srel1 > 0), so the k2 actives
+    # need no O(E) pass over e_srel1
     k2_raw = np.unique(np.concatenate([
-        snap.e_srel1[snap.e_srel1 > 0] - 1,
         snap.us_srel,
         cl.c_srel1[cl.c_srel1 > 0] - 1,
         cl.c_grel,
@@ -722,18 +722,21 @@ def build_flat_arrays(
 
     # cheap pre-bail for clearly-over-bound worlds, BEFORE the closure
     # and fold are paid for: distinct stored slots lower-bound the dense
-    # width (the closure/fold can only add to it)
+    # width (the closure/fold can only add to it).  The O(E) uniques run
+    # only when the RAW worst case is over-bound — worlds that fit even
+    # without the dense remap skip straight through
     Npre = _ceil_pow2(max(snap.num_nodes, 1), 8)
-    width_lb = max(
-        np.unique(np.concatenate(
-            [snap.e_rel, snap.us_rel, snap.ar_rel]
-        )).shape[0] if snap.e_rel.shape[0] else 1,
-        (np.unique(snap.us_srel).shape[0] + 1)
-        if snap.us_srel.shape[0] else 1,
-        1,
-    )
-    if Npre * width_lb >= 2**31:
-        return None
+    if Npre * (snap.num_slots + 1) >= 2**31:
+        width_lb = max(
+            np.unique(np.concatenate(
+                [snap.e_rel, snap.us_rel, snap.ar_rel]
+            )).shape[0] if snap.e_rel.shape[0] else 1,
+            (np.unique(snap.us_srel).shape[0] + 1)
+            if snap.us_srel.shape[0] else 1,
+            1,
+        )
+        if Npre * width_lb >= 2**31:
+            return None
 
     cl = build_closure(snap, per_source_cap=config.closure_source_cap)
 
