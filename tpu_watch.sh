@@ -12,7 +12,7 @@ probe() {
     >> tpu_attempts/log.txt 2>&1
 }
 
-for attempt in 1 2 3 4; do
+for attempt in $(seq 1 11); do
   if probe; then
     log "probe OK — running TPU bench child"
     TS=$(date +%H%M%S)
@@ -22,6 +22,6 @@ for attempt in 1 2 3 4; do
     exit 0
   fi
   log "probe FAIL (attempt ${attempt})"
-  [ "$attempt" != 4 ] && sleep 240
+  [ "$attempt" != 11 ] && sleep 210
 done
 exit 1
