@@ -153,7 +153,11 @@ def main() -> None:
     # pipelined throughput over the PRE-LOWERED kernel (the bench.py
     # methodology: the per-batch query lowering is host work a loaded
     # service overlaps with device execution); p99 below stays the full
-    # end-to-end roundtrip including lowering and the device→host fetch
+    # end-to-end roundtrip including lowering and the device→host fetch.
+    # NOTE: this pre-lowered dispatch (and common.time_steady's 3×
+    # warmup) arrived in round 4 alongside the permission fold — round-3
+    # numbers used the roundtrip path, so cross-round comparisons mix
+    # the fold's algorithmic gain with this methodology change
     import jax.numpy as jnp
 
     queries, qctx = engine._columns_preamble(

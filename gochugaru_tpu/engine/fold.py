@@ -266,7 +266,7 @@ def fold_permissions(
         return src[o], dst[o], p_until[o]
 
     folded: Dict[Tuple[str, int], _Rows] = {}
-    name_of_slot = {v: k for k, v in compiled.slot_of_name.items()}
+    name_of_slot = compiled.name_of_slot
 
     for (tname, tid, slot, expr) in plan.topo_programs:
         leaves = _union_leaves(expr)

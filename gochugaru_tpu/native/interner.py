@@ -182,7 +182,9 @@ class NativeInterner:
         if n == 0:
             return [], []
         if min(tl) < 0:
-            bad = tl.index(-1)
+            # any negative type id is the invalid-node sentinel (mirror
+            # keys_batch's t < 0 tolerance, not an exact -1 match)
+            bad = next(i for i, t in enumerate(tl) if t < 0)
             raise IndexError(f"unknown node {int(nn[bad])}")
         text = raw[: o[n]].decode("utf-8")
         if len(text) == o[n]:  # pure ASCII: byte offsets == char offsets

@@ -96,6 +96,17 @@ class CompiledSchema:
     item_depths: Dict[Tuple[str, str], int] = field(default_factory=dict)
 
     # -- name helpers ------------------------------------------------------
+    @property
+    def name_of_slot(self) -> Dict[int, str]:
+        """slot → name inverse of ``slot_of_name`` (well-defined: slots
+        are per-name), cached — the single shared inversion for decode
+        paths and the fold."""
+        cache = getattr(self, "_name_of_slot", None)
+        if cache is None:
+            cache = {v: k for k, v in self.slot_of_name.items()}
+            self._name_of_slot = cache
+        return cache
+
     def slot(self, name: str) -> int:
         s = self.slot_of_name.get(name)
         if s is None:

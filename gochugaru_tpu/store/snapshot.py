@@ -149,9 +149,7 @@ class Snapshot:
         return next(self._decode_rows(np.asarray([i], np.int64)))
 
     def _slot_names(self) -> Dict[int, str]:
-        if not hasattr(self, "_slot_name_cache"):
-            self._slot_name_cache = {v: k for k, v in self.compiled.slot_of_name.items()}
-        return self._slot_name_cache
+        return self.compiled.name_of_slot
 
     def _caveat_names(self) -> Dict[int, str]:
         if not hasattr(self, "_caveat_name_cache"):

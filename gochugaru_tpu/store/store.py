@@ -124,7 +124,7 @@ class _ColumnUpdates(Sequence):
 
     def __iter__(self) -> Iterator[Update]:
         compiled = self._store._compiled
-        slot_names = {v: k for k, v in compiled.slot_of_name.items()}
+        slot_names = compiled.name_of_slot
         caveat_names = {v: k for k, v in compiled.caveat_ids.items()}
         for r in self._rows:
             yield Update(
@@ -689,10 +689,45 @@ class Store:
                 raise AlreadyExistsError(
                     f"relationship already exists: {describe(int(order[1:][eq][0]))}"
                 )
-        # existence vs the live dict: probe the (small) dict against the
-        # sorted batch keys — O(live · log B), no per-batch-row Python
+        # existence vs the live dict: probe in whichever direction is
+        # cheaper at runtime — the dict against the sorted batch keys
+        # (O(live · log B)) when the dict is the smaller side, else the
+        # batch rows against the dict (O(B) un-intern + dict gets), so a
+        # 2M-row import flush never pays O(live) Python per flush after
+        # many object-path write()s
         dict_hits: List[_Key] = []
-        if self._live:
+        if self._live and len(self._live) > B:
+            name_of_slot = self._require_schema().name_of_slot
+            cols_of = getattr(self.interner, "keys_columns", None)
+            if cols_of is not None:
+                rtypes, rids = cols_of(cols["res"])
+                stypes, sids = cols_of(cols["subj"])
+            else:
+                rk = self.interner.keys_batch(cols["res"])
+                sk = self.interner.keys_batch(cols["subj"])
+                rtypes, rids = map(list, zip(*rk)) if rk else ([], [])
+                stypes, sids = map(list, zip(*sk)) if sk else ([], [])
+            rel_l = cols["rel"].tolist()
+            srel1_l = cols["srel1"].tolist()
+            live_get = self._live.get
+            for i in range(B):
+                if dup[i]:
+                    continue  # a later occurrence carries the same key
+                s1 = srel1_l[i]
+                key = (
+                    rtypes[i], rids[i], name_of_slot[rel_l[i]],
+                    stypes[i], sids[i],
+                    name_of_slot[s1 - 1] if s1 > 0 else "",
+                )
+                existing = live_get(key)
+                if existing is None or not self._is_live(existing, now_us):
+                    continue
+                if not touch:
+                    raise AlreadyExistsError(
+                        f"relationship already exists: {describe(i)}"
+                    )
+                dict_hits.append(key)
+        elif self._live:
             compiled = self._require_schema()
             slot_of = compiled.slot_of_name
             probe = np.empty(1, KEY_DT)

@@ -80,6 +80,36 @@ def test_columnar_import_duplicate_vs_live_dict_touches_via_client():
     assert len(got) == 1  # upsert, not a duplicate row
 
 
+def test_columnar_import_vs_larger_live_dict_probes_batchwise():
+    """len(_live) > B flips _commit_columns_locked to the per-batch-row
+    probe direction; semantics must be identical — including an in-batch
+    TOUCH dup that also collides with a live row (one dict delete, not
+    two)."""
+    c = _client()
+    ctx = background()
+    txn = rel.Txn()
+    for i in range(8):
+        txn.create(rel.must_from_triple(f"doc:a{i}", "reader", "user:u"))
+    c.write(ctx, txn)
+    with pytest.raises(AlreadyExistsError):
+        c._store.import_columns(
+            resource_type="doc", resource_ids=["a3", "zz"],
+            resource_relation="reader",
+            subject_type="user", subject_ids=["u", "u"],
+        )
+    # TOUCH: in-batch dup of a colliding key upserts once
+    c._store.import_columns(
+        resource_type="doc", resource_ids=["a3", "a3", "zz"],
+        resource_relation="reader",
+        subject_type="user", subject_ids=["u", "u", "u"],
+        touch=True,
+    )
+    cs = consistency.full()
+    assert c.check_one(ctx, cs, rel.must_from_triple("doc:zz", "read", "user:u"))
+    got = list(c.read_relationships(ctx, cs, rel.Filter("doc", "a3")))
+    assert len(got) == 1
+
+
 def test_columnar_import_duplicate_vs_segment_raises_then_touch():
     c = _client()
     ctx = background()
