@@ -620,6 +620,10 @@ class DeviceSnapshot:
     #: accumulated host-side delta state since the last FULL prepare (set
     #: on delta-prepared snapshots; engine/flat.py _acc_collapse)
     delta_acc: Optional[Dict[str, np.ndarray]] = None
+    #: host-side fold maintenance state (engine/fold.py FoldState), set
+    #: at FULL prepare on folded worlds and carried along a delta chain
+    #: so each revision's dl_pf* overlay recomputes from (base, acc)
+    fold_state: Optional[Any] = None
 
 
 class DeviceEngine:
@@ -750,12 +754,13 @@ class DeviceEngine:
         ectx, strings = self._ectx_tables(snap)
         arrays.update(ectx)
         flat_meta = None
+        fold_state = None
         if self.config.use_flat:
             from .flat import build_flat_arrays
 
             built = build_flat_arrays(snap, self.config, plan=self.plan)
             if built is not None:  # unpackable graphs use the legacy path
-                flat_arrays, flat_meta = built
+                flat_arrays, flat_meta, fold_state = built
                 arrays.update(flat_arrays)
         arrays = {k: jnp.asarray(v) for k, v in arrays.items()}
         tid_map = np.full(max(self.plan.num_schema_types, 1), -1, dtype=np.int32)
@@ -787,6 +792,7 @@ class DeviceEngine:
             snapshot=snap,
             strings=strings,
             flat_meta=flat_meta,
+            fold_state=fold_state,
         )
 
     def _delta_prev_ok(self, prev: DeviceSnapshot) -> bool:
@@ -865,6 +871,7 @@ class DeviceEngine:
             strings=strings,
             flat_meta=meta,
             delta_acc=acc,
+            fold_state=prev.fold_state,
         )
 
     # -- query lowering --------------------------------------------------

@@ -142,6 +142,25 @@ class DeltaMeta:
     # so these can only be true when the base flags are)
     e_hascav: bool = False
     e_hasexp: bool = False
+    # permission-fold maintenance overlay (engine/fold.py
+    # fold_delta_update): folded slots stay on the pf probe pair under a
+    # delta — base hits at DIRTY resources are voided and replacement
+    # rows probed from small replicated overlay tables
+    #: fold maintenance downgraded for the rest of this chain: folded
+    #: pairs compile their WALKED programs (which see the dl_* overlays)
+    #: instead of the pf probe pair — set when fold_delta_update
+    #: declines (eligibility flip / hot-ancestor dirty set / overlay
+    #: past its row cap); sticky until compaction re-folds the base
+    pf_off: bool = False
+    pf_dirty: bool = False  # any dirty (slot, res) keys
+    pfd_cap: int = 4
+    pf_ovl_e: bool = False  # overlay pf_e rows
+    pfo_e_cap: int = 4
+    pf_ovl_hascav: bool = False  # overlay layout flags (independent of base)
+    pf_ovl_hasuntil: bool = False
+    pf_ovl_haswc: bool = False
+    pf_ovl_t: bool = False  # overlay pf_t rows
+    pfo_t_cap: int = 4
 
 
 @dataclass(frozen=True)
@@ -712,7 +731,7 @@ def _fold_packed(fr, cl, snap, maps: SlotMaps, N: int, config: EngineConfig):
 
 def build_flat_arrays(
     snap, config: EngineConfig, plan: Optional[DevicePlan] = None
-) -> Optional[Tuple[Dict[str, np.ndarray], FlatMeta]]:
+) -> Optional[Tuple[Dict[str, np.ndarray], FlatMeta, Optional[object]]]:
     """Hash-index the snapshot + flatten its membership closure.  Returns
     padded host arrays (merged into DeviceSnapshot.arrays) and the static
     FlatMeta — or None when even the DENSE keys don't pack into int32
@@ -744,11 +763,13 @@ def build_flat_arrays(
     # slots join the k1 radix (engine/fold.py packs its internal keys in
     # int64 with raw radices, so it is cliff-immune itself)
     BS = config.flat_blockslice
-    fr = None
+    fr = fstate = None
     if BS and plan is not None:
         from .fold import fold_permissions
 
-        fr = fold_permissions(snap, config, plan, cl)
+        got_fold = fold_permissions(snap, config, plan, cl)
+        if got_fold is not None:
+            fr, fstate = got_fold
 
     maps = _active_maps(
         snap, cl, {slot for _, slot in fr.pairs} if fr is not None else ()
@@ -931,6 +952,13 @@ def build_flat_arrays(
                 pf_has_t=T2_k1.shape[0] > 0,
                 **pff,
             )
+            # arm the maintenance state with the packing context it
+            # needs at delta time (fold_delta_update)
+            fstate.maps, fstate.N, fstate.cl = maps, N, cl
+        else:
+            fstate = None
+    else:
+        fstate = None
 
     meta = FlatMeta(
         N=N, S1=S1,
@@ -970,7 +998,7 @@ def build_flat_arrays(
             or np.isin(cl.ovf_src[cl.ovf_srel1 == 0], wc_nodes).any()
         ),
     )
-    return out, meta
+    return out, meta, fstate
 
 
 # ---------------------------------------------------------------------------
@@ -1075,7 +1103,7 @@ def _stack_range(ri, row_cols: Sequence[np.ndarray], M: int, fan_pad: int):
 def build_flat_arrays_sharded(
     snap, config: EngineConfig, model_size: int,
     plan: Optional[DevicePlan] = None,
-) -> Optional[Tuple[Dict[str, np.ndarray], FlatMeta]]:
+) -> Optional[Tuple[Dict[str, np.ndarray], FlatMeta, Optional[object]]]:
     """The bucket-sharded counterpart of build_flat_arrays: every hash /
     range / closure / T table stacked per model shard (leading axis splits
     M ways under shard_map; probes mask bucket ownership and OR-reduce).
@@ -1091,11 +1119,13 @@ def build_flat_arrays_sharded(
     # the permission fold shards like every other table (stacked pf_e /
     # pf_t; the kernel's pf probes already mask bucket ownership and
     # OR-reduce) — folded slots join the k1 radix
-    fr = None
+    fr = fstate = None
     if plan is not None:
         from .fold import fold_permissions
 
-        fr = fold_permissions(snap, config, plan, cl)
+        got_fold = fold_permissions(snap, config, plan, cl)
+        if got_fold is not None:
+            fr, fstate = got_fold
     maps = _active_maps(
         snap, cl, {slot for _, slot in fr.pairs} if fr is not None else ()
     )
@@ -1194,6 +1224,13 @@ def build_flat_arrays_sharded(
                 pf_has_t=T2_k1.shape[0] > 0,
                 **pff,
             )
+            # arm the maintenance state with the packing context it
+            # needs at delta time (fold_delta_update)
+            fstate.maps, fstate.N, fstate.cl = maps, N, cl
+        else:
+            fstate = None
+    else:
+        fstate = None
 
     ar_dd = _arrow_data_depth(snap)
     rc_list = []
@@ -1242,7 +1279,7 @@ def build_flat_arrays_sharded(
             or np.isin(cl.ovf_src[cl.ovf_srel1 == 0], wc_nodes).any()
         ),
     )
-    return out, meta
+    return out, meta, fstate
 
 
 # ---------------------------------------------------------------------------
@@ -1443,6 +1480,8 @@ def build_delta_arrays(
     acc["base_edges"] = (
         prev_acc["base_edges"] if prev_acc else int(prev_snap.num_edges)
     )
+    if prev_acc and prev_acc.get("pf_off"):
+        acc["pf_off"] = True  # sticky downgrade for the chain remainder
     if meta.rc_slots:
         # rows of a FLATTENED tupleset shift its ancestor closure: bail
         # EARLY (before any table builds) to a full rebuild.  Incremental
@@ -1480,8 +1519,28 @@ def build_delta_arrays(
         _ceil_pow2(max(64, acc["base_edges"] // 4)),
     )
 
+    def _q4(n: int) -> int:
+        # pow2 with the exponent rounded up to EVEN — shapes step in 4×
+        # bands, so a chain whose accumulated rows outgrow the F floor
+        # retraces half as often on its way to the compaction bound
+        p = _ceil_pow2(max(n, 1))
+        return p if (p.bit_length() - 1) % 2 == 0 else p << 1
+
+    def dlpad(n: int) -> int:
+        """Interleave pad target for a dl_* table of ``n`` rows: the F
+        floor, then 4×-quantized bands past it — the SAME band function
+        the hash sizing uses, so a table's off and row shapes step at
+        the same revision (one retrace, not two)."""
+        return max(F, _q4(4 * n))
+
     def floored_hash(cols):
-        return build_hash(cols, min_size=2 * F)
+        # deterministic sizing (max_factor=1): the adaptive cap-chasing
+        # growth in build_hash would re-step the off shape at pow2
+        # boundaries of its own; a fixed ≤0.25 load factor in 4× bands
+        # keeps shapes put, and the declared probe caps below carry a
+        # floor of 16 to absorb the occupancy wobble that load allows
+        n = int(cols[0].shape[0]) if cols else 0
+        return build_hash(cols, min_size=max(2 * F, _q4(4 * n)), max_factor=1)
 
     kw = {}
     if n_adds:
@@ -1492,11 +1551,11 @@ def build_delta_arrays(
             [a_k1, a_k2]
             + ([acc["a_cav"], acc["a_ctx"]] if meta.e_hascav else [])
             + ([acc["a_exp"]] if meta.e_hasexp else []),
-            pad=F,
+            pad=dlpad(n_adds),
         )
         kw.update(
             has_adds=True,
-            e_cap=_round_cap(max(8, eh.cap)),
+            e_cap=_round_cap(max(16, eh.cap)),
             e_slots=tuple(int(s) for s in np.unique(acc["a_rel"])),
             e_hascav=meta.e_hascav,
             e_hasexp=meta.e_hasexp,
@@ -1504,8 +1563,8 @@ def build_delta_arrays(
     if n_tombs:
         tb = floored_hash([g_k1, g_k2])
         out["dl_tb_off"] = tb.off
-        out["dl_tbx"] = interleave_buckets(tb, [g_k1, g_k2], pad=F)
-        kw.update(has_tombs=True, tb_cap=_round_cap(max(8, tb.cap)))
+        out["dl_tbx"] = interleave_buckets(tb, [g_k1, g_k2], pad=dlpad(n_tombs))
+        kw.update(has_tombs=True, tb_cap=_round_cap(max(16, tb.cap)))
 
     # delta userset view (adds with a subject relation)
     am = acc["a_srel1"] > 0
@@ -1513,10 +1572,13 @@ def build_delta_arrays(
         gk_all = a_k1[am]
         order = np.argsort(gk_all, kind="stable")
         u_gk = gk_all[order]
-        usr = build_range_hash(u_gk, min_size=2 * F)
+        usr = build_range_hash(
+            u_gk, min_size=max(2 * F, _q4(4 * int(u_gk.shape[0]))),
+            max_factor=1,
+        )
         out["dl_usr_off"] = usr.index.off
         out["dl_usgx"] = interleave_buckets(
-            usr.index, [usr.gk, usr.glo, usr.ghi], pad=F
+            usr.index, [usr.gk, usr.glo, usr.ghi], pad=dlpad(int(am.sum()))
         )
         cols = [
             acc["a_subj"][am][order],
@@ -1533,10 +1595,10 @@ def build_delta_arrays(
         # fan floor 8: per-group occupancy creeps up as a chain
         # accumulates, and each pow2 step would retrace
         fan = _round_fan(max(8, min(usr.max_run, 32)))
-        out["dl_usx"] = interleave_rows(cols, pad=max(F, fan))
+        out["dl_usx"] = interleave_rows(cols, pad=max(dlpad(int(am.sum())), fan))
         kw.update(
             has_us=True,
-            us_cap=_round_cap(max(8, usr.index.cap)),
+            us_cap=_round_cap(max(16, usr.index.cap)),
             us_fan=fan,
             us_slots=tuple(int(s) for s in np.unique(acc["a_rel"][am])),
         )
@@ -1544,8 +1606,10 @@ def build_delta_arrays(
     if gm.any():
         utb = floored_hash([g_k1[gm], g_k2[gm]])
         out["dl_utb_off"] = utb.off
-        out["dl_utbx"] = interleave_buckets(utb, [g_k1[gm], g_k2[gm]], pad=F)
-        kw.update(has_ustomb=True, utb_cap=_round_cap(max(8, utb.cap)))
+        out["dl_utbx"] = interleave_buckets(
+            utb, [g_k1[gm], g_k2[gm]], pad=dlpad(int(gm.sum()))
+        )
+        kw.update(has_ustomb=True, utb_cap=_round_cap(max(16, utb.cap)))
         if meta.has_tindex:
             dirty = np.unique(
                 g_k1[gm][
@@ -1555,8 +1619,10 @@ def build_delta_arrays(
             if dirty.size:
                 td = floored_hash([dirty])
                 out["dl_td_off"] = td.off
-                out["dl_tdx"] = interleave_buckets(td, [dirty], pad=F)
-                kw.update(t_dirty=True, td_cap=_round_cap(max(8, td.cap)))
+                out["dl_tdx"] = interleave_buckets(
+                    td, [dirty], pad=dlpad(int(dirty.size))
+                )
+                kw.update(t_dirty=True, td_cap=_round_cap(max(16, td.cap)))
 
     # delta arrow view (tupleset relations, direct subjects)
     ts = np.asarray(sorted(compiled.tupleset_slots), np.int64)
@@ -1564,10 +1630,14 @@ def build_delta_arrays(
     if aam.any():
         gk_all = a_k1[aam]
         order = np.argsort(gk_all, kind="stable")
-        arr = build_range_hash(gk_all[order], min_size=2 * F)
+        arr = build_range_hash(
+            gk_all[order],
+            min_size=max(2 * F, _q4(4 * int(gk_all.shape[0]))),
+            max_factor=1,
+        )
         out["dl_arr_off"] = arr.index.off
         out["dl_argx"] = interleave_buckets(
-            arr.index, [arr.gk, arr.glo, arr.ghi], pad=F
+            arr.index, [arr.gk, arr.glo, arr.ghi], pad=dlpad(int(aam.sum()))
         )
         cols = [acc["a_subj"][aam][order]]
         if meta.ar_hascav:
@@ -1575,10 +1645,10 @@ def build_delta_arrays(
         if meta.ar_hasexp:
             cols += [acc["a_exp"][aam][order]]
         fan = _round_fan(max(8, min(arr.max_run, 32)))
-        out["dl_arx"] = interleave_rows(cols, pad=max(F, fan))
+        out["dl_arx"] = interleave_rows(cols, pad=max(dlpad(int(aam.sum())), fan))
         kw.update(
             has_ar=True,
-            ar_cap=_round_cap(max(8, arr.index.cap)),
+            ar_cap=_round_cap(max(16, arr.index.cap)),
             ar_fan=fan,
             ar_slots=tuple(int(s) for s in np.unique(acc["a_rel"][aam])),
         )
@@ -1589,9 +1659,68 @@ def build_delta_arrays(
         atb = floored_hash([g_k1[gam], acc["g_subj"][gam]])
         out["dl_atb_off"] = atb.off
         out["dl_atbx"] = interleave_buckets(
-            atb, [g_k1[gam], acc["g_subj"][gam]], pad=F
+            atb, [g_k1[gam], acc["g_subj"][gam]], pad=dlpad(int(gam.sum()))
         )
-        kw.update(has_artomb=True, atb_cap=_round_cap(max(8, atb.cap)))
+        kw.update(has_artomb=True, atb_cap=_round_cap(max(16, atb.cap)))
+
+    # permission-fold maintenance: folded slots KEEP answering from the
+    # pf probe pair across the chain — base hits at dirty resources are
+    # voided and replacement rows (recomputed for exactly those
+    # resources against current data) ride small replicated overlays.
+    # When the subset recompute can't stay sound/cheap it DOWNGRADES
+    # (sticky pf_off: folded pairs walk, with the overlays, until
+    # compaction re-folds) rather than forcing an O(E) rebuild
+    if meta.fold_pairs:
+        fstate = getattr(prev_dsnap, "fold_state", None)
+        from .fold import fold_delta_update
+
+        got = None
+        if fstate is not None and not acc.get("pf_off"):
+            got = fold_delta_update(fstate, acc, snap.node_type, config)
+        if got is None:
+            acc["pf_off"] = True
+            kw.update(pf_off=True)
+            return out, DeltaMeta(**kw), acc
+        dirty_k1, ovl = got
+        if dirty_k1.shape[0]:
+            pdh = floored_hash([dirty_k1])
+            out["dl_pfd_off"] = pdh.off
+            out["dl_pfdx"] = interleave_buckets(
+                pdh, [dirty_k1], pad=dlpad(int(dirty_k1.shape[0]))
+            )
+            kw.update(pf_dirty=True, pfd_cap=_round_cap(max(16, pdh.cap)))
+        if ovl is not None:
+            packed = _fold_packed(ovl, fstate.cl, snap, fstate.maps, N, config)
+            if packed is None:
+                return None  # overlay T join over budget: rebuild
+            pf_k1, pf_k2, pf_subj, (T2_k1, T2_k2, T2_d, T2_p), pff = packed
+            if pf_k1.shape[0]:
+                peh = floored_hash([pf_k1, pf_k2])
+                out["dl_pfe_off"] = peh.off
+                out["dl_pfex"] = interleave_buckets(
+                    peh,
+                    [pf_k1, pf_k2]
+                    + ([ovl.e_cav, ovl.e_ctx] if pff["pf_hascav"] else [])
+                    + ([ovl.e_until] if pff["pf_hasuntil"] else []),
+                    pad=dlpad(int(pf_k1.shape[0])),
+                )
+                kw.update(
+                    pf_ovl_e=True,
+                    pfo_e_cap=_round_cap(max(16, peh.cap)),
+                    pf_ovl_hascav=pff["pf_hascav"],
+                    pf_ovl_hasuntil=pff["pf_hasuntil"],
+                    pf_ovl_haswc=bool(
+                        np.isin(pf_subj, fstate.wc_nodes).any()
+                    ),
+                )
+            if T2_k1.shape[0]:
+                pth = floored_hash([T2_k1, T2_k2])
+                out["dl_pft_off"] = pth.off
+                out["dl_pftx"] = interleave_buckets(
+                    pth, [T2_k1, T2_k2, T2_d, T2_p],
+                    pad=dlpad(int(T2_k1.shape[0])),
+                )
+                kw.update(pf_ovl_t=True, pfo_t_cap=_round_cap(max(16, pth.cap)))
 
     return out, DeltaMeta(**kw), acc
 
@@ -1653,9 +1782,14 @@ def make_flat_fn(
     }
     rel_slots = frozenset(plan.rel_leaf_slots)
     # permission fold: BASE answers come from the pf_e/pf_t probe pair;
-    # folded programs compile to nothing.  Any delta level reverts to the
-    # walked program (fold tables don't see overlay adds/tombstones)
-    fold_on = bool(meta.fold_pairs) and meta.delta is None
+    # folded programs compile to nothing.  A delta level rides along via
+    # incremental maintenance (engine/fold.py fold_delta_update): base pf
+    # hits at dirty resources are voided and replacement rows probed from
+    # the replicated dl_pf* overlays — folded worlds keep fold-speed
+    # answers across a Watch chain
+    fold_on = bool(meta.fold_pairs) and not (
+        meta.delta is not None and meta.delta.pf_off
+    )
     folded_pairs = frozenset(meta.fold_pairs) if fold_on else frozenset()
     pf_slots = frozenset(s for _, s in folded_pairs)
     cyclic = _eval_cyclic_pairs(compiled)
@@ -1968,6 +2102,70 @@ def make_flat_fn(
                 if meta.has_wc_closure:
                     wtd, wtp = pt_site(bq(wcl_k, nd))
                     d, p = d | wtd, p | wtp
+            # incremental maintenance: void base hits at DIRTY resources,
+            # then OR in the recomputed replacement rows.  The overlay
+            # tables are replicated (plain probes, identical on every
+            # shard) and sit after the base sites' OR-reductions
+            if dm is not None and dm.pf_dirty:
+                pdb = probe_block(
+                    arrs["dl_pfd_off"], arrs["dl_pfdx"], dm.pfd_cap, (k1,)
+                )
+                dirty = jnp.any(blk_hit(pdb, (k1,)), axis=-1)
+                d, p = d & ~dirty, p & ~dirty
+            if dm is not None and dm.pf_ovl_e:
+                oL = _lay(
+                    ["k1", "k2"]
+                    + (["cav", "ctx"] if dm.pf_ovl_hascav else [])
+                    + (["until"] if dm.pf_ovl_hasuntil else [])
+                )
+
+                def po_site(k2q):
+                    blk = probe_block(
+                        arrs["dl_pfe_off"], arrs["dl_pfex"], dm.pfo_e_cap,
+                        (k1, k2q),
+                    )
+                    hit = blk_hit(blk, (k1, k2q)) & exists[..., None]
+                    live = hit
+                    if dm.pf_ovl_hasuntil:
+                        u = jnp.where(hit, blk[..., oL["until"]], 0)
+                        live = hit & (u > now)
+                    if not dm.pf_ovl_hascav:
+                        hd = hp = live
+                    else:
+                        cav = jnp.where(live, blk[..., oL["cav"]], 0)
+                        if tri is None:
+                            hd, hp = live & (cav == 0), live
+                        else:
+                            ctxc = jnp.where(live, blk[..., oL["ctx"]], -1)
+                            qb = jnp.broadcast_to(
+                                bq(q_ctx, cav.ndim), cav.shape
+                            )
+                            t = tri(cav, ctxc, qb, tables)
+                            hd, hp = live & (t == 2), live & (t >= 1)
+                    return jnp.any(hd, axis=-1), jnp.any(hp, axis=-1)
+
+                od, op_ = po_site(bq(q_k2, nd))
+                d, p = d | od, p | op_
+                if dm.pf_ovl_haswc:
+                    owd, owp = po_site(bq(w_k2, nd))
+                    d, p = d | owd, p | owp
+            if dm is not None and dm.pf_ovl_t:
+                def pot_site(k2q):
+                    blk = probe_block(
+                        arrs["dl_pft_off"], arrs["dl_pftx"], dm.pfo_t_cap,
+                        (k1, k2q),
+                    )
+                    hit = blk_hit(blk, (k1, k2q)) & exists[..., None]
+                    return (
+                        jnp.any(hit & (blk[..., 2] > now), axis=-1),
+                        jnp.any(hit & (blk[..., 3] > now), axis=-1),
+                    )
+
+                otd, otp = pot_site(bq(q_k2, nd))
+                d, p = d | otd, p | otp
+                if meta.has_wc_closure:
+                    owtd, owtp = pot_site(bq(wcl_k, nd))
+                    d, p = d | owtd, p | owtp
             return d, p
 
         # Every eval function returns (definite, possible, ovf, used):

@@ -22,10 +22,14 @@ the walked path).  Direct rows keep their caveat/ctx columns (the CEL VM
 gates them at the probe site); userset rows under the fold must be
 caveat-free and not permission-valued — the same bar the T-index sets.
 
-Folded tables serve BASE data only.  A Watch-delta level rides on the
-unfolded walk (engine/flat.py compiles the full program when a delta is
-present), which keeps add/tombstone semantics exact without Leopard's
-incremental-maintenance machinery; compaction re-folds.
+Watch-delta levels ride the fold INCREMENTALLY (fold_delta_update,
+round 5): the base pf tables stay resident; each revision recomputes
+folded rows for exactly the delta-affected resources and ships them as
+small replicated overlays, with a dirty-key set voiding the stale base
+hits — Leopard's incremental index maintenance as subset-recompute, so
+deletions need no derivation counting.  Conditions the subset recompute
+can't keep sound or cheap downgrade the chain to the walked program
+(sticky pf_off) until compaction re-folds.
 
 Replaces the server-side evaluation behind the reference's
 CheckBulkPermissions (/root/reference/client/client.go:238-266) for the
@@ -157,6 +161,70 @@ def _sorted_by_res(r: _Rows) -> _Rows:
 
 
 @dataclass
+class _Recipe:
+    """The structural recipe of one folded (type, permission) — enough to
+    recompute its rows for a subset of resources during incremental
+    maintenance (fold_delta_update)."""
+
+    tname: str
+    tid_i: int  # interner type id
+    slot: int
+    #: direct leaf contributions: (type_name, relation_slot) — same type
+    leaves: List[Tuple[str, int]]
+    #: same-type folded-permission refs
+    fold_refs: List[Tuple[str, int]]
+    #: arrow contributions: (ts_slot, [("leaf"|"fold", child_type, slot)])
+    arrows: List[Tuple[int, List[Tuple[str, str, int]]]]
+    self_ts: Optional[int] = None
+
+
+@dataclass
+class FoldState:
+    """Host-side base-revision inputs for O(delta) fold maintenance
+    across a Watch chain (engine/flat.py build_delta_arrays →
+    fold_delta_update).  Everything here is immutable along the chain:
+    overlays are recomputed from (this state, accumulated delta) each
+    revision.  The Leopard-style incremental-maintenance answer to the
+    reference's Watch-driven re-index contract
+    (/root/reference/client/client.go:364-413)."""
+
+    order: List[Tuple[str, int]]  # folded pairs, topo (build) order
+    recipes: Dict[Tuple[str, int], _Recipe]
+    #: base leaf rows per (type_name, rel_slot), sorted by res both sides
+    leaf_cache: Dict[Tuple[str, int], _Rows]
+    #: base arrow rows per (type_name, ts_slot): two sorted copies
+    #: (src, dst, p_until) — by dst (lift joins) and by src (subsetting)
+    arrow_by_dst: Dict[Tuple[str, int], Tuple[np.ndarray, ...]]
+    arrow_by_src: Dict[Tuple[str, int], Tuple[np.ndarray, ...]]
+    #: base POST rows (after self-closure lift) per pair, sorted by res
+    post_rows: Dict[Tuple[str, int], _Rows]
+    #: base PRE rows (before self-closure lift; == post for non-self
+    #: pairs) per pair, sorted by res
+    pre_rows: Dict[Tuple[str, int], _Rows]
+    #: self-recursive ancestor closure per pair: (src, anc, d_until)
+    #: sorted by anc
+    self_closure: Dict[Tuple[str, int], Tuple[np.ndarray, ...]]
+    #: tupleset slots whose arrow rows any fold traverses (incl. self):
+    #: deltas touching these with a caveat — or self ones at all — bail
+    fold_ts_slots: frozenset
+    self_ts_slots: frozenset
+    #: relation slots folded as direct leaves (delta us adds with a
+    #: caveat landing on one of these flip eligibility → bail)
+    folded_leaf_slots: frozenset
+    #: sorted permission-userset subject keys (subj·S1_raw + srel1):
+    #: a delta us add whose subject key is here extends groups through a
+    #: permission chain — the fold's T side can't represent it → bail
+    pus_keys: np.ndarray
+    itid: Dict[str, int]
+    S1_raw: int
+    wc_nodes: np.ndarray
+    # attached by build_flat_arrays* after packing succeeds:
+    maps: object = None  # flat.SlotMaps
+    N: int = 0
+    cl: object = None  # store.closure.ClosureIndex
+
+
+@dataclass
 class FoldResult:
     """Folded rows keyed ready for table build: pf_e identity rows and
     pf_u userset rows, both carrying the owning permission slot."""
@@ -196,10 +264,11 @@ def _union_leaves(expr: ExprIR) -> Optional[List[ExprIR]]:
 
 def fold_permissions(
     snap, config: EngineConfig, plan: DevicePlan, cl
-) -> Optional[FoldResult]:
+) -> Optional[Tuple[FoldResult, FoldState]]:
     """Fold every eligible (type, permission) of the snapshot's schema.
-    Returns None when folding is disabled, inapplicable, or over budget
-    (the walked kernel answers those worlds exactly as before)."""
+    Returns (rows, maintenance state) or None when folding is disabled,
+    inapplicable, or over budget (the walked kernel answers those worlds
+    exactly as before)."""
     if not config.flat_fold or not plan.topo_programs:
         return None
     if cl.ovf_src.shape[0]:
@@ -237,8 +306,16 @@ def fold_permissions(
     )
     spent = 0
 
+    leaf_memo: Dict[Tuple[str, int], Optional[_Rows]] = {}
+
     def leaf_rows(tname: str, rel_slot: int) -> Optional[_Rows]:
+        """Base leaf rows of (type, relation), sorted by res (memoized —
+        the sorted copies double as the maintenance state's leaf cache)."""
+        key = (tname, rel_slot)
+        if key in leaf_memo:
+            return leaf_memo[key]
         if rel_slot in bad_rel_slots:
+            leaf_memo[key] = None
             return None
         tid = itid[tname]
         m = (snap.e_rel == rel_slot) & (e_type == tid)
@@ -247,25 +324,42 @@ def fold_permissions(
         # decomposes and repacks with the dense radices
         e_k2 = snap.e_subj[m].astype(np.int64) * S1 + snap.e_srel1[m]
         mu = (snap.us_rel == rel_slot) & (us_type == tid)
-        return _Rows(
+        got = _sorted_by_res(_Rows(
             snap.e_res[m], e_k2, snap.e_caveat[m], snap.e_ctx[m],
             _until_of(snap.e_exp[m]),
             snap.us_res[mu], snap.us_subj[mu], snap.us_srel[mu],
             _until_of(snap.us_exp[mu]),
-        )
+        ))
+        leaf_memo[key] = got
+        return got
+
+    arrow_by_dst: Dict[Tuple[str, int], Tuple[np.ndarray, ...]] = {}
+    arrow_by_src: Dict[Tuple[str, int], Tuple[np.ndarray, ...]] = {}
 
     def arrow_pairs(tname: str, ts_slot: int):
         """(src, dst, p_until) arrow rows of ``tname`` under ``ts_slot``,
-        sorted by dst for _lift."""
+        sorted by dst for _lift (memoized; a by-src copy is kept for the
+        maintenance state)."""
+        key = (tname, ts_slot)
+        if key in arrow_by_dst:
+            return arrow_by_dst[key]
         m = (snap.ar_rel == ts_slot) & (ar_type == itid[tname]) & (
             snap.ar_child >= 0
         )
         src, dst = snap.ar_res[m], snap.ar_child[m]
         p_until = _until_of(snap.ar_exp[m])
         o = np.argsort(dst, kind="stable")
-        return src[o], dst[o], p_until[o]
+        arrow_by_dst[key] = (src[o], dst[o], p_until[o])
+        o2 = np.argsort(src, kind="stable")
+        arrow_by_src[key] = (src[o2], dst[o2], p_until[o2])
+        return arrow_by_dst[key]
 
     folded: Dict[Tuple[str, int], _Rows] = {}
+    folded_sorted: Dict[Tuple[str, int], _Rows] = {}
+    pre_sorted: Dict[Tuple[str, int], _Rows] = {}
+    recipes: Dict[Tuple[str, int], _Recipe] = {}
+    order: List[Tuple[str, int]] = []
+    self_closures: Dict[Tuple[str, int], Tuple[np.ndarray, ...]] = {}
     name_of_slot = compiled.name_of_slot
 
     for (tname, tid, slot, expr) in plan.topo_programs:
@@ -276,6 +370,10 @@ def fold_permissions(
         tid_i = itid[tname]
         parts: List[_Rows] = []
         self_ts: Optional[int] = None
+        rec = _Recipe(
+            tname=tname, tid_i=tid_i, slot=slot,
+            leaves=[], fold_refs=[], arrows=[],
+        )
         ok = True
         for child in leaves:
             tag = child[0]
@@ -289,8 +387,10 @@ def fold_permissions(
                 sname = name_of_slot.get(s, "")
                 if sname in compiled.schema.definitions[tname].relations:
                     got = leaf_rows(tname, s)
+                    rec.leaves.append((tname, s))
                 elif (tname, s) in folded:
                     got = folded[(tname, s)]
+                    rec.fold_refs.append((tname, s))
                 else:
                     got = None
                 if got is None:
@@ -321,6 +421,7 @@ def fold_permissions(
                 self_ts = ts_slot
                 continue
             src, dst, p_until = arrow_pairs(tname, ts_slot)
+            childs: List[Tuple[str, str, int]] = []
             for c_t in sorted(child_types):
                 c_has_rel = (
                     right in rel_leaf
@@ -329,8 +430,10 @@ def fold_permissions(
                 )
                 if c_has_rel:
                     got = leaf_rows(c_t, right)
+                    childs.append(("leaf", c_t, right))
                 elif (c_t, right) in folded:
-                    got = folded[(c_t, right)]
+                    got = folded_sorted[(c_t, right)]
+                    childs.append(("fold", c_t, right))
                 elif compiled.schema.definitions[c_t].item(
                     name_of_slot.get(right, "")
                 ) is None:
@@ -340,12 +443,14 @@ def fold_permissions(
                 if got is None:
                     ok = False
                     break
-                parts.append(_lift(_sorted_by_res(got), src, dst, p_until))
+                parts.append(_lift(got, src, dst, p_until))
             if not ok:
                 break
+            rec.arrows.append((ts_slot, childs))
         if not ok:
             continue
         rows = _dedup_rows(_concat_rows(parts))
+        pre = rows
         if self_ts is not None:
             from .flat import _arrow_closure  # deferred: flat imports us
 
@@ -360,18 +465,59 @@ def fold_permissions(
             tm = ntype[np.clip(c_src, 0, max(snap.num_nodes - 1, 0))] == tid_i
             c_src, c_anc, c_d = c_src[tm], c_anc[tm], c_d[tm]
             o = np.argsort(c_anc, kind="stable")
+            c_src, c_anc, c_d = c_src[o], c_anc[o], c_d[o]
             rows = _dedup_rows(_concat_rows([
-                rows, _lift(_sorted_by_res(rows), c_src[o], c_anc[o], c_d[o]),
+                rows, _lift(_sorted_by_res(rows), c_src, c_anc, c_d),
             ]))
         if spent + rows.total > budget:
             continue  # over budget: this pair stays on the walked path
         spent += rows.total
-        folded[(tname, slot)] = rows
+        rec.self_ts = self_ts
+        pair = (tname, slot)
+        folded[pair] = rows
+        folded_sorted[pair] = _sorted_by_res(rows)
+        pre_sorted[pair] = (
+            _sorted_by_res(pre) if self_ts is not None else folded_sorted[pair]
+        )
+        if self_ts is not None:
+            self_closures[pair] = (c_src, c_anc, c_d)
+        recipes[pair] = rec
+        order.append(pair)
 
     if not folded:
         return None
+    if snap.pus_n.shape[0]:
+        pus_keys = np.sort(snap.pus_n.astype(np.int64) * S1 + snap.pus_r + 1)
+    else:
+        pus_keys = np.zeros(0, np.int64)
+    state = FoldState(
+        order=order,
+        recipes=recipes,
+        leaf_cache={k: v for k, v in leaf_memo.items() if v is not None},
+        arrow_by_dst=arrow_by_dst,
+        arrow_by_src=arrow_by_src,
+        post_rows=folded_sorted,
+        pre_rows=pre_sorted,
+        self_closure=self_closures,
+        fold_ts_slots=frozenset(
+            {ts for r in recipes.values() for ts, _ in r.arrows}
+            | {r.self_ts for r in recipes.values() if r.self_ts is not None}
+        ),
+        self_ts_slots=frozenset(
+            r.self_ts for r in recipes.values() if r.self_ts is not None
+        ),
+        folded_leaf_slots=frozenset(
+            s for (_t, s), v in leaf_memo.items() if v is not None
+        ),
+        pus_keys=pus_keys,
+        itid=itid,
+        S1_raw=S1,
+        wc_nodes=snap.wildcard_node_of_type[
+            snap.wildcard_node_of_type >= 0
+        ].astype(np.int32),
+    )
     pairs = tuple(sorted(folded))
-    return FoldResult(
+    result = FoldResult(
         e_slot=np.concatenate([
             np.full(folded[p].e_res.shape[0], p[1], np.int32) for p in pairs
         ]),
@@ -389,6 +535,346 @@ def fold_permissions(
         u_until=np.concatenate([folded[p].u_until for p in pairs]),
         pairs=pairs,
     )
+    return result, state
+
+
+# ---------------------------------------------------------------------------
+# incremental maintenance: Watch-delta overlays over a folded base
+# ---------------------------------------------------------------------------
+
+
+def _rows_at(rows: _Rows, S: np.ndarray) -> _Rows:
+    """``rows`` (res-sorted on both planes) restricted to res ∈ S
+    (sorted unique).  Output stays res-sorted."""
+    _, ie = _expand_join(rows.e_res, S)
+    _, iu = _expand_join(rows.u_res, S)
+    return _Rows(
+        rows.e_res[ie], rows.e_k2[ie], rows.e_cav[ie], rows.e_ctx[ie],
+        rows.e_until[ie],
+        rows.u_res[iu], rows.u_subj[iu], rows.u_srel[iu], rows.u_until[iu],
+    )
+
+
+def _in_sorted(sorted_keys: np.ndarray, keys: np.ndarray) -> np.ndarray:
+    if sorted_keys.shape[0] == 0 or keys.shape[0] == 0:
+        return np.zeros(keys.shape[0], bool)
+    pos = np.clip(
+        np.searchsorted(sorted_keys, keys), 0, sorted_keys.shape[0] - 1
+    )
+    return sorted_keys[pos] == keys
+
+
+def _ident(state: FoldState, rel_slot: int, res, subj, srel1) -> np.ndarray:
+    """Primary-row identity packed EXACTLY like the accumulated delta's
+    tombstone keys (flat._acc_collapse.pack): dense (k1 << 31) | k2."""
+    from .flat import _m_srel1  # deferred: flat imports us
+
+    maps = state.maps
+    k1 = np.int64(maps.k1[rel_slot]) * state.N + res.astype(np.int64)
+    k2 = subj.astype(np.int64) * maps.S1 + _m_srel1(
+        maps, np.asarray(srel1, np.int64).astype(np.int32)
+    ).astype(np.int64)
+    return (k1 << np.int64(31)) | k2
+
+
+def _cur_leaf(
+    state: FoldState, acc, node_type: np.ndarray, tname: str, rel_slot: int,
+    S: np.ndarray,
+) -> _Rows:
+    """CURRENT (base − tombstones ∪ adds) leaf rows of (type, relation)
+    at res ∈ S, res-sorted.  Upserted identities are sound because every
+    touched identity is in the tombstone set (flat._acc_collapse)."""
+    S1r = state.S1_raw
+    g_sorted = acc["a_g_key_sorted"]
+    parts: List[_Rows] = []
+    base = state.leaf_cache.get((tname, rel_slot))
+    if base is not None and base.total:
+        sub = _rows_at(base, S)
+        me = np.ones(sub.e_res.shape[0], bool)
+        mu = np.ones(sub.u_res.shape[0], bool)
+        if g_sorted.shape[0]:
+            if sub.e_res.shape[0]:
+                me = ~_in_sorted(g_sorted, _ident(
+                    state, rel_slot, sub.e_res,
+                    sub.e_k2 // S1r, sub.e_k2 % S1r,
+                ))
+            if sub.u_res.shape[0]:
+                mu = ~_in_sorted(g_sorted, _ident(
+                    state, rel_slot, sub.u_res, sub.u_subj, sub.u_srel + 1,
+                ))
+        parts.append(_Rows(
+            sub.e_res[me], sub.e_k2[me], sub.e_cav[me], sub.e_ctx[me],
+            sub.e_until[me],
+            sub.u_res[mu], sub.u_subj[mu], sub.u_srel[mu], sub.u_until[mu],
+        ))
+    tid = state.itid[tname]
+    rtypes = node_type[np.clip(acc["a_res"], 0, node_type.shape[0] - 1)]
+    m = (
+        (acc["a_rel"] == rel_slot) & (rtypes == tid)
+        & np.isin(acc["a_res"], S)
+    )
+    if m.any():
+        res = acc["a_res"][m]
+        subj = acc["a_subj"][m]
+        srel1 = acc["a_srel1"][m]
+        until = _until_of(acc["a_exp"][m])
+        mu = srel1 > 0
+        parts.append(_Rows(
+            res, subj.astype(np.int64) * S1r + srel1,
+            acc["a_cav"][m], acc["a_ctx"][m], until,
+            res[mu], subj[mu], (srel1[mu] - 1).astype(np.int32), until[mu],
+        ))
+    return _sorted_by_res(_concat_rows(parts))
+
+
+def _cur_arrows(
+    state: FoldState, acc, node_type: np.ndarray, tname: str, ts_slot: int,
+    S: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """CURRENT arrow rows (src, dst, p_until) of (type, ts) with
+    src ∈ S, sorted by dst (the _lift join order)."""
+    g_sorted = acc["a_g_key_sorted"]
+    base = state.arrow_by_src.get((tname, ts_slot))
+    srcs: List[np.ndarray] = []
+    dsts: List[np.ndarray] = []
+    pus: List[np.ndarray] = []
+    if base is not None and base[0].shape[0]:
+        _, ii = _expand_join(base[0], S)
+        src, dst, pu = base[0][ii], base[1][ii], base[2][ii]
+        if g_sorted.shape[0] and src.shape[0]:
+            keep = ~_in_sorted(g_sorted, _ident(
+                state, ts_slot, src, dst, np.zeros(src.shape[0], np.int32)
+            ))
+            src, dst, pu = src[keep], dst[keep], pu[keep]
+        srcs.append(src); dsts.append(dst); pus.append(pu)
+    tid = state.itid[tname]
+    rtypes = node_type[np.clip(acc["a_res"], 0, node_type.shape[0] - 1)]
+    m = (
+        (acc["a_rel"] == ts_slot) & (acc["a_srel1"] == 0) & (rtypes == tid)
+        & np.isin(acc["a_res"], S) & (acc["a_subj"] >= 0)
+    )
+    if m.any():
+        srcs.append(acc["a_res"][m])
+        dsts.append(acc["a_subj"][m])
+        pus.append(_until_of(acc["a_exp"][m]))
+    if not srcs:
+        z = np.zeros(0, np.int32)
+        return z, z, z
+    src = np.concatenate(srcs)
+    dst = np.concatenate(dsts)
+    pu = np.concatenate(pus)
+    o = np.argsort(dst, kind="stable")
+    return src[o], dst[o], pu[o]
+
+
+def _cur_pair_rows(
+    state: FoldState, pair: Tuple[str, int], new_rows: Dict, D: Dict,
+    S: np.ndarray, *, pre: bool,
+) -> _Rows:
+    """CURRENT pre- or post-rows of an already-maintained folded pair at
+    res ∈ S: base rows where unaffected, recomputed rows where dirty."""
+    base = (state.pre_rows if pre else state.post_rows)[pair]
+    Dp = D[pair]
+    inD = np.isin(S, Dp)
+    return _sorted_by_res(_concat_rows([
+        _rows_at(base, S[~inD]),
+        _rows_at(new_rows[pair], S[inD]),
+    ]))
+
+
+def fold_delta_update(
+    state: FoldState, acc, node_type: np.ndarray, config: EngineConfig
+) -> Optional[Tuple[np.ndarray, Optional[FoldResult]]]:
+    """O(delta) incremental fold maintenance: from the base-revision
+    FoldState and the chain's accumulated delta, compute (a) the DIRTY
+    key set — packed (slot·N + res) whose base pf answers must be
+    voided — and (b) replacement rows for exactly those resources,
+    recomputed against current (base − tombstones ∪ adds) data in the
+    same recipe/topo order the base fold ran.  Deletions are exact by
+    construction (affected resources are recomputed wholesale, so no
+    derivation counting is needed — the subset-recompute answer to
+    Leopard's incremental index maintenance).
+
+    Returns None on any condition the subset recompute cannot keep
+    sound/cheap: structural edits to a self-recursive tupleset (the
+    ancestor closure would shift), eligibility flips (caveated
+    arrow/userset delta rows, pus-extending subjects), or a dirty set
+    past the cap.  The caller (flat.build_delta_arrays) then DOWNGRADES
+    the chain — sticky pf_off, folded pairs walk with the dl_* overlays
+    until compaction re-folds the base — it does not force a rebuild."""
+    a_rel, a_res = acc["a_rel"], acc["a_res"]
+    a_subj, a_srel1 = acc["a_subj"], acc["a_srel1"]
+    g_rel, g_res, g_srel1 = acc["g_rel"], acc["g_res"], acc["g_srel1"]
+    all_rel = np.concatenate([a_rel, g_rel])
+    all_res = np.concatenate([a_res, g_res])
+    all_srel1 = np.concatenate([a_srel1, g_srel1])
+    if all_rel.shape[0] == 0:
+        return np.zeros(0, np.int32), None
+
+    # -- eligibility bails -------------------------------------------------
+    if state.self_ts_slots:
+        st = np.asarray(sorted(state.self_ts_slots), np.int64)
+        if np.isin(all_rel, st).any():
+            return None  # ancestor closure would shift: rebuild
+    if state.fold_ts_slots:
+        ft = np.asarray(sorted(state.fold_ts_slots), np.int64)
+        m = np.isin(a_rel, ft) & (a_srel1 == 0)
+        if m.any() and acc["a_cav"][m].any():
+            return None  # fold arrows must stay caveat-free
+    if state.folded_leaf_slots:
+        fl = np.asarray(sorted(state.folded_leaf_slots), np.int64)
+        m = np.isin(a_rel, fl) & (a_srel1 > 0)
+        if m.any():
+            if acc["a_cav"][m].any():
+                return None  # caveated userset row flips leaf eligibility
+            if state.pus_keys.shape[0]:
+                sk = (
+                    a_subj[m].astype(np.int64) * state.S1_raw + a_srel1[m]
+                )
+                if _in_sorted(state.pus_keys, sk).any():
+                    return None  # group extends through a permission chain
+
+    # sorted tombstone keys for the current-row extractors
+    acc = dict(acc)
+    acc["a_g_key_sorted"] = acc["g_key"]  # maintained sorted by collapse
+
+    rtypes = node_type[np.clip(all_res, 0, node_type.shape[0] - 1)]
+
+    # -- affected resource sets, pair by pair in base fold order ----------
+    D_pre: Dict[Tuple[str, int], np.ndarray] = {}
+    D_post: Dict[Tuple[str, int], np.ndarray] = {}
+    total_dirty = 0
+    for pair in state.order:
+        rec = state.recipes[pair]
+        ds: List[np.ndarray] = []
+        for (lt, lslot) in rec.leaves:
+            ds.append(all_res[(all_rel == lslot) & (rtypes == rec.tid_i)])
+        for ref_pair in rec.fold_refs:
+            ds.append(D_post[ref_pair])
+        for (ts_slot, childs) in rec.arrows:
+            ds.append(all_res[
+                (all_rel == ts_slot) & (all_srel1 == 0)
+                & (rtypes == rec.tid_i)
+            ])
+            bd = state.arrow_by_dst.get((rec.tname, ts_slot))
+            if bd is None or bd[0].shape[0] == 0:
+                continue
+            for (kind, c_t, c_slot) in childs:
+                if kind == "leaf":
+                    c_tid = state.itid[c_t]
+                    touched = np.unique(all_res[
+                        (all_rel == c_slot) & (rtypes == c_tid)
+                    ])
+                else:
+                    touched = D_post[(c_t, c_slot)]
+                if touched.shape[0]:
+                    _, ii = _expand_join(bd[1], touched)
+                    ds.append(bd[0][ii])
+        Dp = (
+            np.unique(np.concatenate(ds).astype(np.int32))
+            if ds else np.zeros(0, np.int32)
+        )
+        D_pre[pair] = Dp
+        if rec.self_ts is not None and Dp.shape[0]:
+            c_src, c_anc, _c_d = state.self_closure[pair]
+            _, ii = _expand_join(c_anc, Dp)
+            Dp2 = np.unique(np.concatenate([Dp, c_src[ii]]))
+        else:
+            Dp2 = Dp
+        D_post[pair] = Dp2
+        total_dirty += int(Dp2.shape[0])
+        if total_dirty > config.flat_fold_delta_dirty_cap:
+            return None  # hot-ancestor touch: downgrade to the walk
+
+    if total_dirty == 0:
+        return np.zeros(0, np.int32), None
+
+    # -- subset refold against current data -------------------------------
+    new_pre: Dict[Tuple[str, int], _Rows] = {}
+    new_post: Dict[Tuple[str, int], _Rows] = {}
+    total_rows = 0
+    row_cap = max(config.flat_delta_min_compact, 4 * total_dirty)
+    for pair in state.order:
+        rec = state.recipes[pair]
+        S = D_post[pair]
+        if S.shape[0] == 0:
+            new_pre[pair] = new_post[pair] = _empty_rows()
+            continue
+        parts: List[_Rows] = []
+        for (lt, lslot) in rec.leaves:
+            parts.append(_cur_leaf(state, acc, node_type, lt, lslot, S))
+        for ref_pair in rec.fold_refs:
+            parts.append(_cur_pair_rows(
+                state, ref_pair, new_post, D_post, S, pre=False
+            ))
+        for (ts_slot, childs) in rec.arrows:
+            src, dst, pu = _cur_arrows(
+                state, acc, node_type, rec.tname, ts_slot, S
+            )
+            if src.shape[0] == 0:
+                continue
+            dsts = np.unique(dst)
+            for (kind, c_t, c_slot) in childs:
+                if kind == "leaf":
+                    got = _cur_leaf(state, acc, node_type, c_t, c_slot, dsts)
+                else:
+                    got = _cur_pair_rows(
+                        state, (c_t, c_slot), new_post, D_post, dsts,
+                        pre=False,
+                    )
+                parts.append(_lift(got, src, dst, pu))
+        pre = _sorted_by_res(_dedup_rows(_concat_rows(parts)))
+        new_pre[pair] = pre
+        if rec.self_ts is not None:
+            c_src, c_anc, c_d = state.self_closure[pair]
+            keep = np.isin(c_src, S)
+            cs, ca, cd = c_src[keep], c_anc[keep], c_d[keep]
+            ancs = np.unique(ca)
+            pre_at_anc = _cur_pair_rows(
+                state, pair, new_pre, D_pre, ancs, pre=True
+            )
+            post = _sorted_by_res(_dedup_rows(_concat_rows([
+                pre, _lift(pre_at_anc, cs, ca, cd),
+            ])))
+        else:
+            post = pre
+        new_post[pair] = post
+        total_rows += post.total
+        if total_rows > row_cap:
+            return None  # overlay would rival the base: downgrade
+
+    # -- outputs: dirty keys + replacement rows ---------------------------
+    maps, N = state.maps, state.N
+    dirty_k1 = np.concatenate([
+        (np.int64(maps.k1[p[1]]) * N + D_post[p].astype(np.int64)).astype(
+            np.int32
+        )
+        for p in state.order
+    ])
+    pairs = tuple(sorted(p for p in state.order if new_post[p].total))
+    if not pairs:
+        return dirty_k1, None
+    ovl = FoldResult(
+        e_slot=np.concatenate([
+            np.full(new_post[p].e_res.shape[0], p[1], np.int32)
+            for p in pairs
+        ]),
+        e_res=np.concatenate([new_post[p].e_res for p in pairs]),
+        e_k2=np.concatenate([new_post[p].e_k2 for p in pairs]),
+        e_cav=np.concatenate([new_post[p].e_cav for p in pairs]),
+        e_ctx=np.concatenate([new_post[p].e_ctx for p in pairs]),
+        e_until=np.concatenate([new_post[p].e_until for p in pairs]),
+        u_slot=np.concatenate([
+            np.full(new_post[p].u_res.shape[0], p[1], np.int32)
+            for p in pairs
+        ]),
+        u_res=np.concatenate([new_post[p].u_res for p in pairs]),
+        u_subj=np.concatenate([new_post[p].u_subj for p in pairs]),
+        u_srel=np.concatenate([new_post[p].u_srel for p in pairs]),
+        u_until=np.concatenate([new_post[p].u_until for p in pairs]),
+        pairs=pairs,
+    )
+    return dirty_k1, ovl
 
 
 def t_join_core(

@@ -98,6 +98,12 @@ class EngineConfig:
     #: folded row budget as a multiple of (E + US) row counts; pairs
     #: beyond it stay on the walked path
     flat_fold_factor: int = 16
+    #: incremental fold maintenance (engine/fold.py fold_delta_update):
+    #: max total dirty resources per delta chain before the prepare
+    #: falls back to a full rebuild (a delta touching a hot ancestor can
+    #: dirty a whole subtree — recomputing it incrementally would cost
+    #: more than re-folding the base)
+    flat_fold_delta_dirty_cap: int = 16_384
 
     @staticmethod
     def for_schema(compiled: CompiledSchema, **overrides) -> "EngineConfig":

@@ -169,7 +169,7 @@ class ShardedEngine(DeviceEngine):
                 snap, self.config, self.model_size, plan=self.plan
             )
             if built is not None:
-                flat_arrays, flat_meta = built
+                flat_arrays, flat_meta, fold_state = built
                 host = dict(flat_arrays)
                 host["node_type"] = _pad_payload(
                     snap.node_type, _ceil_pow2(2 * snap.num_nodes), -1
@@ -194,6 +194,7 @@ class ShardedEngine(DeviceEngine):
                     snapshot=snap,
                     strings=strings,
                     flat_meta=flat_meta,
+                    fold_state=fold_state,
                 )
         return self._prepare_legacy(snap)
 

@@ -183,18 +183,19 @@ def test_fold_budget_zero_disables_but_stays_correct():
     _assert_differential(engine, dsnap, oracle, checks)
 
 
-def test_fold_delta_reverts_to_walk():
+def test_fold_survives_delta_meta():
     # a delta level rides the folded base: the FlatMeta keeps the fold
-    # pairs (same compiled-kernel cache key family) but the kernel must
-    # take the walked path (fold_on requires delta is None) — the
-    # delta-semantics themselves (adds grant / tombstones revoke through
-    # the bypass) are covered by the client-level delta tests
+    # pairs and the kernel stays on the pf probe pair, with dirty-key
+    # voiding + dl_pf* overlays carrying the delta (round 5 incremental
+    # maintenance — the chain-level differential coverage lives in
+    # tests/test_fold_delta.py)
     from dataclasses import replace as _dc_replace
 
     from gochugaru_tpu.engine.flat import DeltaMeta
 
     engine, dsnap, oracle = _docs_world()
     assert dsnap.flat_meta.fold_pairs
+    assert dsnap.fold_state is not None  # maintenance state armed
     dmeta = _dc_replace(dsnap.flat_meta, delta=DeltaMeta(has_adds=True))
     assert dmeta.fold_pairs == dsnap.flat_meta.fold_pairs
 

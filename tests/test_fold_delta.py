@@ -1,0 +1,337 @@
+"""Incremental fold maintenance (engine/fold.py fold_delta_update).
+
+Contract: on a folded world, a Watch-delta chain KEEPS answering folded
+permissions from the pf probe pair — base hits at dirty resources are
+voided and replacement rows ride the dl_pf* overlays — and every check
+stays EXACTLY equal to a full prepare of the same revision.  Conditions
+the subset recompute can't keep sound (self-recursive tupleset edits,
+eligibility flips, hot-ancestor dirty sets) must fall back to a full
+prepare, never to wrong answers.  Reference behavior being reproduced:
+Watch-driven incremental re-index over CheckBulkPermissions semantics
+(/root/reference/client/client.go:364-413, :238-266).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from gochugaru_tpu import rel
+from gochugaru_tpu.engine.device import DeviceEngine
+from gochugaru_tpu.engine.plan import EngineConfig
+from gochugaru_tpu.schema import compile_schema, parse_schema
+from gochugaru_tpu.store.delta import apply_delta
+from gochugaru_tpu.store.interner import Interner
+from gochugaru_tpu.store.snapshot import build_snapshot
+
+NOW = 1_700_000_000_000_000
+
+DOCS = """
+definition user {}
+definition group { relation member: user | group#member }
+definition folder {
+    relation parent: folder
+    relation viewer: user | group#member
+    permission view = viewer + parent->view
+}
+definition document {
+    relation folder: folder
+    relation viewer: user | group#member
+    permission view = viewer + folder->view
+}
+"""
+
+
+def _docs_rels(rng: random.Random):
+    rels = []
+    for i in range(6):
+        if i % 3 != 2:
+            rels.append(rel.must_from_tuple(
+                f"group:g{i}#member", f"group:g{i+1}#member"
+            ))
+        for u in rng.sample(range(20), 2):
+            rels.append(rel.must_from_tuple(f"group:g{i}#member", f"user:u{u}"))
+    for i in range(1, 12):
+        rels.append(rel.must_from_tuple(
+            f"folder:f{i}#parent", f"folder:f{(i-1)//3}"
+        ))
+    for i in range(12):
+        rels.append(rel.must_from_tuple(
+            f"folder:f{i}#viewer",
+            f"user:u{rng.randrange(20)}" if i % 2
+            else f"group:g{rng.randrange(6)}#member",
+        ))
+    # a couple of expiring rows so the base layouts carry exp columns
+    # (delta rows with gates a base view lacks bail by design)
+    import datetime as _dt
+
+    exp = _dt.datetime.fromtimestamp(
+        (NOW + 7_200_000_000) / 1e6, _dt.timezone.utc
+    )
+    rels.append(rel.must_from_triple(
+        "document:d0", "viewer", "user:u0"
+    ).with_expiration(exp))
+    rels.append(rel.must_from_triple(
+        "folder:f0", "viewer", "user:u1"
+    ).with_expiration(exp))
+    for d in range(30):
+        rels.append(rel.must_from_tuple(
+            f"document:d{d}#folder", f"folder:f{rng.randrange(12)}"
+        ))
+        if d % 3 == 0:
+            rels.append(rel.must_from_tuple(
+                f"document:d{d}#viewer", f"group:g{rng.randrange(6)}#member"
+            ))
+        if d % 4 == 0:
+            rels.append(rel.must_from_tuple(
+                f"document:d{d}#viewer", f"user:u{rng.randrange(20)}"
+            ))
+    return rels
+
+
+def _prep(seed=5, **cfg):
+    rng = random.Random(seed)
+    rels = _docs_rels(rng)
+    cs = compile_schema(parse_schema(DOCS))
+    interner = Interner()
+    snap = build_snapshot(1, cs, interner, rels, epoch_us=NOW)
+    cfg.setdefault("flat_recursion", 3)
+    cfg.setdefault("flat_max_width", 32)
+    engine = DeviceEngine(cs, EngineConfig.for_schema(cs, **cfg))
+    dsnap = engine.prepare(snap)
+    assert dsnap.flat_meta is not None and dsnap.flat_meta.fold_pairs
+    assert dsnap.fold_state is not None
+    return rng, rels, cs, interner, snap, engine, dsnap
+
+
+def _checks(rng: random.Random, n=60):
+    out = [
+        rel.must_from_triple(
+            f"document:d{rng.randrange(30)}", "view", f"user:u{rng.randrange(20)}"
+        )
+        for _ in range(n)
+    ]
+    out += [
+        rel.must_from_triple(
+            f"folder:f{rng.randrange(12)}", "view", f"user:u{rng.randrange(20)}"
+        )
+        for _ in range(n // 2)
+    ]
+    return out
+
+
+def _assert_parity(engine, ds_inc, ds_full, checks):
+    di, pi, oi = engine.check_batch(ds_inc, checks, now_us=NOW)
+    df, pf, of = engine.check_batch(ds_full, checks, now_us=NOW)
+    for i, q in enumerate(checks):
+        assert bool(di[i]) == bool(df[i]), (
+            f"definite differs for {q}: inc={di[i]} full={df[i]}"
+        )
+        assert bool(pi[i]) == bool(pf[i]), (
+            f"possible differs for {q}: inc={pi[i]} full={pf[i]}"
+        )
+        assert bool(oi[i]) == bool(of[i]), f"overflow differs for {q}"
+
+
+def _assert_sound_vs_full(engine, ds_inc, ds_full, checks):
+    """Downgraded (pf_off / walked) snapshots may leave more queries in
+    the possible/host-fallback band than the folded full prepare — but
+    they must never DECIDE differently: definite never over-claims,
+    possible never under-claims, and queries both sides decide agree."""
+    di, pi, oi = engine.check_batch(ds_inc, checks, now_us=NOW)
+    df, pf, of = engine.check_batch(ds_full, checks, now_us=NOW)
+    for i, q in enumerate(checks):
+        assert not (bool(di[i]) and not bool(pf[i])), f"inc over-claims {q}"
+        assert not (bool(df[i]) and not bool(pi[i])), f"inc under-claims {q}"
+        inc_decided = bool(di[i]) == bool(pi[i]) and not bool(oi[i])
+        full_decided = bool(df[i]) == bool(pf[i]) and not bool(of[i])
+        if inc_decided and full_decided:
+            assert bool(di[i]) == bool(df[i]), f"decided answers differ {q}"
+
+
+def test_fold_maintained_across_40_revision_chain():
+    """40 revisions of adds/tombstones on folded leaves and (non-self)
+    arrows: every revision stays on the incremental path (meta.delta
+    present ⇒ folded slots answered by pf + dl_pf* overlay, since
+    fold_pairs stays set and the kernel no longer reverts to the walk)
+    and matches a full prepare exactly."""
+    rng, rels, cs, interner, snap, engine, dsnap = _prep(seed=5)
+    py = random.Random(17)
+    live_viewers = [
+        r for r in rels
+        if r.resource_relation == "viewer" and r.subject_type == "user"
+    ]
+    live_arrows = [r for r in rels if r.resource_relation == "folder"]
+    saw_dirty = saw_ovl = 0
+    for revision in range(2, 42):
+        adds, deletes = [], []
+        kind = revision % 5
+        if kind == 0:  # direct viewer add (new node too)
+            adds.append(rel.must_from_triple(
+                f"document:d{py.randrange(30)}", "viewer",
+                f"user:nu{revision}",
+            ))
+        elif kind == 1:  # userset viewer add on folder (lifts to docs)
+            adds.append(rel.must_from_tuple(
+                f"folder:f{py.randrange(12)}#viewer",
+                f"group:g{py.randrange(6)}#member",
+            ))
+        elif kind == 2 and live_viewers:  # tombstone a base viewer row
+            deletes.append(live_viewers.pop(py.randrange(len(live_viewers))))
+        elif kind == 3 and live_arrows:  # retarget a doc→folder arrow
+            old = live_arrows.pop(py.randrange(len(live_arrows)))
+            deletes.append(old)
+            repl = rel.must_from_tuple(
+                f"document:{old.resource_id}#folder",
+                f"folder:f{py.randrange(12)}",
+            )
+            adds.append(repl)
+            live_arrows.append(repl)
+        else:  # expiring direct viewer add
+            import datetime as _dt
+
+            exp = _dt.datetime.fromtimestamp(
+                (NOW + 3_600_000_000) / 1e6, _dt.timezone.utc
+            )
+            adds.append(rel.must_from_triple(
+                f"document:d{py.randrange(30)}", "viewer",
+                f"user:u{py.randrange(20)}",
+            ).with_expiration(exp))
+        snap = apply_delta(snap, revision, adds, deletes, interner=interner)
+        ds_inc = engine.prepare(snap, prev=dsnap)
+        assert ds_inc.flat_meta.delta is not None, f"rev {revision} fell back"
+        assert ds_inc.flat_meta.fold_pairs, "fold must stay armed"
+        dm = ds_inc.flat_meta.delta
+        saw_dirty += bool(dm.pf_dirty)
+        saw_ovl += bool(dm.pf_ovl_e or dm.pf_ovl_t)
+        ds_full = engine.prepare(snap)
+        checks = _checks(py) + [
+            rel.must_from_triple(
+                f"document:{a.resource_id}" if a.resource_type == "document"
+                else f"folder:{a.resource_id}",
+                "view", f"user:nu{revision}",
+            )
+            for a in adds
+        ]
+        _assert_parity(engine, ds_inc, ds_full, checks)
+        dsnap = ds_inc  # chain
+    assert saw_dirty >= 30, "fold maintenance should have run"
+    assert saw_ovl >= 20, "overlay rows should have shipped"
+
+
+def test_fold_delta_deletion_and_restore_exact():
+    """Deleting a folder's viewer revokes folded access at the documents
+    under it; re-adding restores it — both through the overlay, chained."""
+    rng, rels, cs, interner, snap, engine, dsnap = _prep(seed=7)
+    target = next(
+        r for r in rels
+        if r.resource_type == "folder" and r.resource_relation == "viewer"
+        and r.subject_type == "user"
+    )
+    probe = [
+        rel.must_from_triple(
+            f"document:d{d}", "view", f"{target.subject_type}:{target.subject_id}"
+        )
+        for d in range(30)
+    ] + [rel.must_from_triple(
+        f"folder:{target.resource_id}", "view",
+        f"{target.subject_type}:{target.subject_id}",
+    )]
+    snap2 = apply_delta(snap, 2, [], [target], interner=interner)
+    ds2 = engine.prepare(snap2, prev=dsnap)
+    assert ds2.flat_meta.delta is not None and ds2.flat_meta.delta.pf_dirty
+    _assert_parity(engine, ds2, engine.prepare(snap2), probe)
+    snap3 = apply_delta(snap2, 3, [target], [], interner=interner)
+    ds3 = engine.prepare(snap3, prev=ds2)
+    assert ds3.flat_meta.delta is not None
+    ds3_full = engine.prepare(snap3)
+    _assert_parity(engine, ds3, ds3_full, probe)
+    # restored world answers like the original base
+    d0, p0, _ = engine.check_batch(dsnap, probe, now_us=NOW)
+    d3, p3, _ = engine.check_batch(ds3, probe, now_us=NOW)
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d3))
+    np.testing.assert_array_equal(np.asarray(p0), np.asarray(p3))
+
+
+def test_fold_delta_self_ts_edit_declines_fold():
+    """Edits to a self-recursive tupleset (folder.parent) shift the
+    ancestor closure: fold maintenance must decline — either the rc bail
+    forces a full prepare (flattened hierarchies) or the chain stays
+    incremental with folded pairs DOWNGRADED to their walked programs
+    (sticky pf_off).  Never answers from stale fold tables."""
+    rng, rels, cs, interner, snap, engine, dsnap = _prep(seed=9)
+    adds = [rel.must_from_tuple("folder:f11#parent", "folder:f2")]
+    snap2 = apply_delta(snap, 2, adds, [], interner=interner)
+    ds2 = engine.prepare(snap2, prev=dsnap)
+    if ds2.flat_meta.delta is not None:
+        assert ds2.flat_meta.delta.pf_off  # fold declined, walk answers
+        _assert_sound_vs_full(
+            engine, ds2, engine.prepare(snap2), _checks(random.Random(1))
+        )
+    else:
+        assert ds2.fold_state is not None  # full prepare re-armed the fold
+        _assert_parity(
+            engine, ds2, engine.prepare(snap2), _checks(random.Random(1))
+        )
+
+
+def test_fold_delta_caveated_userset_row_falls_back():
+    """A caveated userset viewer row flips the leaf's fold eligibility —
+    the maintenance path must decline rather than fold an ungateable row."""
+    rng, rels, cs, interner, snap, engine, dsnap = _prep(seed=11)
+    caveated = parse_schema("""
+    caveat tier(min int) { min > 3 }
+    """ + DOCS.replace(
+        "relation viewer: user | group#member",
+        "relation viewer: user | group#member | user with tier",
+        2,
+    ))
+    cs2 = compile_schema(caveated)
+    interner2 = Interner()
+    base = _docs_rels(random.Random(5))
+    snap = build_snapshot(1, cs2, interner2, base, epoch_us=NOW)
+    engine2 = DeviceEngine(cs2, EngineConfig.for_schema(
+        cs2, flat_recursion=3, flat_max_width=32
+    ))
+    ds = engine2.prepare(snap)
+    if not (ds.flat_meta and ds.flat_meta.fold_pairs):
+        pytest.skip("caveated schema variant did not fold")
+    adds = [rel.must_from_tuple(
+        "document:d3#viewer", "group:g1#member"
+    ).with_caveat("tier", {"min": 5})]
+    snap2 = apply_delta(snap, 2, adds, [], interner=interner2)
+    ds2 = engine2.prepare(snap2, prev=ds)
+    checks = [
+        rel.must_from_triple(f"document:d3", "view", f"user:u{u}")
+        for u in range(20)
+    ]
+    _assert_parity(engine2, ds2, engine2.prepare(snap2), checks)
+
+
+def test_fold_delta_dirty_cap_downgrades_to_walk():
+    """A dirty-cap of zero declines every fold-touching delta: the chain
+    stays INCREMENTAL but downgrades folded pairs to their walked
+    programs (sticky pf_off) — never a full O(E) rebuild, never wrong
+    answers.  The downgrade must persist across later revisions."""
+    rng, rels, cs, interner, snap, engine, dsnap = _prep(
+        seed=13, flat_fold_delta_dirty_cap=0
+    )
+    adds = [rel.must_from_triple("document:d1", "viewer", "user:u1")]
+    snap2 = apply_delta(snap, 2, adds, [], interner=interner)
+    ds2 = engine.prepare(snap2, prev=dsnap)
+    assert ds2.flat_meta.delta is not None  # still incremental
+    assert ds2.flat_meta.delta.pf_off  # ... but folded pairs walk
+    _assert_sound_vs_full(
+        engine, ds2, engine.prepare(snap2), _checks(random.Random(2))
+    )
+    # sticky: the next revision stays downgraded without re-attempting
+    snap3 = apply_delta(
+        snap2, 3,
+        [rel.must_from_triple("document:d2", "viewer", "user:u2")], [],
+        interner=interner,
+    )
+    ds3 = engine.prepare(snap3, prev=ds2)
+    assert ds3.flat_meta.delta is not None and ds3.flat_meta.delta.pf_off
+    _assert_sound_vs_full(
+        engine, ds3, engine.prepare(snap3), _checks(random.Random(3))
+    )
