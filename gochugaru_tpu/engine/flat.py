@@ -714,7 +714,7 @@ def _fold_packed(fr, cl, snap, maps: SlotMaps, N: int, config: EngineConfig):
     from ..store.closure import NO_EXP
     from .fold import fold_tindex_join
 
-    tj2 = fold_tindex_join(fr, cl, N, maps, config.flat_tindex_factor)
+    tj2 = fold_tindex_join(fr, cl, N, maps, config.flat_fold_tindex_factor)
     if tj2 is None:
         return None
     S1_raw = snap.num_slots + 1
@@ -1526,12 +1526,15 @@ def build_delta_arrays(
         p = _ceil_pow2(max(n, 1))
         return p if (p.bit_length() - 1) % 2 == 0 else p << 1
 
-    def dlpad(n: int) -> int:
-        """Interleave pad target for a dl_* table of ``n`` rows: the F
-        floor, then 4×-quantized bands past it — the SAME band function
-        the hash sizing uses, so a table's off and row shapes step at
-        the same revision (one retrace, not two)."""
-        return max(F, _q4(4 * n))
+    def dlband(n: int) -> int:
+        """THE shared shape band of a dl_* table of ``n`` rows: the 2F
+        floor, then 4×-quantized steps.  Both the hash size and the
+        interleave pad derive from this one value, so a table's off and
+        row shapes step at the same revision (one retrace, not two) —
+        including when F itself is an odd power of two."""
+        return max(2 * F, _q4(4 * n))
+
+    dlpad = dlband  # interleave pad target — same band by construction
 
     def floored_hash(cols):
         # deterministic sizing (max_factor=1): the adaptive cap-chasing
@@ -1540,7 +1543,7 @@ def build_delta_arrays(
         # keeps shapes put, and the declared probe caps below carry a
         # floor of 16 to absorb the occupancy wobble that load allows
         n = int(cols[0].shape[0]) if cols else 0
-        return build_hash(cols, min_size=max(2 * F, _q4(4 * n)), max_factor=1)
+        return build_hash(cols, min_size=dlband(n), max_factor=1)
 
     kw = {}
     if n_adds:

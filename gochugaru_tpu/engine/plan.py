@@ -98,11 +98,21 @@ class EngineConfig:
     #: folded row budget as a multiple of (E + US) row counts; pairs
     #: beyond it stay on the walked path
     flat_fold_factor: int = 16
+    #: fold T-side join budget as a multiple of the FOLDED userset row
+    #: count (engine/fold.py fold_tindex_join).  Separate from (and much
+    #: larger than) flat_tindex_factor: the fold's u rows are already
+    #: lifted to root resources, so their closure join is denser — at
+    #: BASELINE config 2 scale it runs ~100 members/team over ~40k rows
+    #: (~4M join rows, ~130MB of tables), which the shared factor's cap
+    #: silently rejected, throwing away the whole fold and the ~2x
+    #: kernel collapse that comes with it
+    flat_fold_tindex_factor: int = 256
     #: incremental fold maintenance (engine/fold.py fold_delta_update):
-    #: max total dirty resources per delta chain before the prepare
-    #: falls back to a full rebuild (a delta touching a hot ancestor can
-    #: dirty a whole subtree — recomputing it incrementally would cost
-    #: more than re-folding the base)
+    #: max total dirty resources per delta chain.  Past it the chain
+    #: DOWNGRADES folded pairs to their walked programs (sticky pf_off
+    #: until compaction re-folds the base) — a delta touching a hot
+    #: ancestor can dirty a whole subtree, and recomputing that each
+    #: revision would cost more than walking
     flat_fold_delta_dirty_cap: int = 16_384
 
     @staticmethod
