@@ -46,11 +46,13 @@ import numpy as np
 from ..schema.compiler import CompiledSchema
 from .hash import (
     _ceil_pow2,
+    build_aligned,
     build_hash,
     build_range_hash,
     interleave_buckets,
     interleave_rows,
     mix32,
+    probe_aligned,
     probe_block,
     probe_range,
     probe_rows,
@@ -234,6 +236,11 @@ class FlatMeta:
     #: block-slice layout active (bucket-ordered interleaved tables probed
     #: with one contiguous [cap, w] slice per query — see engine/hash.py)
     blockslice: bool = False
+    #: bucket-ALIGNED tables (engine/hash.py build_aligned): per aligned
+    #: table, (tbl_key, cap, w, spill_cap) — arrays ``{tbl_key}_al`` (and
+    #: ``{tbl_key}_als`` when spill_cap > 0) replace the off+interleave
+    #: pair, and a probe is ONE row gather (+ one salted spill gather)
+    aligned: Tuple[Tuple[str, int, int, int], ...] = ()
     #: LSM delta level riding on this snapshot's base tables (None = the
     #: snapshot was fully prepared)
     delta: Optional[DeltaMeta] = None
@@ -788,12 +795,11 @@ def build_flat_arrays(
     pus_k = _pack(snap.pus_n, S1, maps.k2[snap.pus_r] + 1)
     ovf_k = _pack(cl.ovf_src, S1, _m_srel1(maps, cl.ovf_srel1))
 
-    eh = build_hash([e_k1, e_k2])
     usr = build_range_hash(us_gk)
     arr = build_range_hash(ar_gk)
-    clh = build_hash([cl_k1, cl_k2])
     push = build_hash([pus_k])
     ovfh = build_hash([ovf_k])
+    eh = clh = None  # big indexes: built lazily (skipped when aligned)
 
     out: Dict[str, np.ndarray] = {}
     # view flags, computed up front: they pick the interleaved layouts
@@ -817,20 +823,57 @@ def build_flat_arrays(
         out[prefix + "_ghi"] = _pad(r.ghi, G, 0)
         put_hash(prefix, r.index)
 
+    # bucket-ALIGNED layout (engine/hash.py build_aligned): on by
+    # default on TPU — each point probe is ONE row gather instead of an
+    # offsets gather + a serialized block slice (~48M vs 0.75M probes/s
+    # measured on silicon, tpu_attempts/micro_blocks.py)
+    if config.flat_aligned is not None:
+        AL = bool(config.flat_aligned)
+    else:
+        import jax
+
+        AL = jax.default_backend() == "tpu"
+    al_meta: List[Tuple[str, int, int, int]] = []
+
+    def put_block(tbl_key: str, off_key: str, h, key_cols, cols):
+        """One point-probe table: bucket-aligned when enabled and it
+        fits the byte budget, else bucket offsets + interleaved rows.
+        ``h`` is a HashIndex or a zero-arg thunk building one (the
+        legacy index is skipped entirely — including its size-doubling
+        scan — when the aligned layout lands); returns the HashIndex
+        when the legacy layout was emitted, else None."""
+        if AL:
+            ai = build_aligned(
+                key_cols, cols, max_bytes=config.flat_aligned_max_bytes
+            )
+            if ai is not None:
+                out[tbl_key + "_al"] = ai.tbl
+                if ai.spill is not None:
+                    out[tbl_key + "_als"] = ai.spill
+                al_meta.append((tbl_key, ai.cap, ai.w, ai.spill_cap))
+                return None
+        if callable(h):
+            h = h()
+        out[off_key] = h.off
+        out[tbl_key] = interleave_buckets(h, cols)
+        return h
+
     if BS:
         # block-slice layout: per point-probe table, the bucket offsets +
-        # ONE bucket-ordered interleaved matrix (keys ++ payloads); per
-        # range view, the group table interleaved by bucket and the row
-        # view interleaved in its existing key-sorted order
-        out["eh_off"] = eh.off
-        out["ehx"] = interleave_buckets(
-            eh,
+        # ONE bucket-ordered interleaved matrix (keys ++ payloads) — or
+        # its aligned form; per range view, the group table interleaved
+        # by bucket and the row view interleaved in its existing
+        # key-sorted order
+        eh = put_block(
+            "ehx", "eh_off", lambda: build_hash([e_k1, e_k2]), [e_k1, e_k2],
             [e_k1, e_k2]
             + ([snap.e_caveat, snap.e_ctx] if e_hascav else [])
             + ([snap.e_exp] if e_hasexp else []),
         )
-        out["usr_off"] = usr.index.off
-        out["usgx"] = interleave_buckets(usr.index, [usr.gk, usr.glo, usr.ghi])
+        put_block(
+            "usgx", "usr_off", usr.index, [usr.gk],
+            [usr.gk, usr.glo, usr.ghi],
+        )
         out["usx"] = interleave_rows(
             # srel rides DENSE (maps.k2): gk packing in the kernel must
             # match the dense closure/T keys
@@ -840,23 +883,26 @@ def build_flat_arrays(
             + ([snap.us_perm] if us_hasperm else []),
             pad=max(64, config.us_leaf_cap),
         )
-        out["arr_off"] = arr.index.off
-        out["argx"] = interleave_buckets(arr.index, [arr.gk, arr.glo, arr.ghi])
+        put_block(
+            "argx", "arr_off", arr.index, [arr.gk],
+            [arr.gk, arr.glo, arr.ghi],
+        )
         out["arx"] = interleave_rows(
             [snap.ar_child]
             + ([snap.ar_caveat, snap.ar_ctx] if ar_hascav else [])
             + ([snap.ar_exp] if ar_hasexp else []),
             pad=max(64, config.arrow_fanout),
         )
-        out["clh_off"] = clh.off
-        out["clx"] = interleave_buckets(
-            clh, [cl_k1, cl_k2, cl.c_d_until, cl.c_p_until]
+        clh = put_block(
+            "clx", "clh_off", lambda: build_hash([cl_k1, cl_k2]),
+            [cl_k1, cl_k2],
+            [cl_k1, cl_k2, cl.c_d_until, cl.c_p_until],
         )
-        out["push_off"] = push.off
-        out["pusx"] = interleave_buckets(push, [pus_k])
-        out["ovfh_off"] = ovfh.off
-        out["ovfx"] = interleave_buckets(ovfh, [ovf_k])
+        put_block("pusx", "push_off", push, [pus_k], [pus_k])
+        put_block("ovfx", "ovfh_off", ovfh, [ovf_k], [ovf_k])
     else:
+        eh = build_hash([e_k1, e_k2])
+        clh = build_hash([cl_k1, cl_k2])
         put_hash("eh", eh)
         put_range("usr", usr)
         put_range("arr", arr)
@@ -886,11 +932,14 @@ def build_flat_arrays(
     tj = _tindex_join(snap, config, cl, us_gk, cl_k1, cl_k2, pus_k, maps)
     if tj is not None:
         T_k1, T_k2, T_d, T_p, t_slots = tj
-        th = build_hash([T_k1, T_k2])
+        th = None
         if BS:
-            out["th_off"] = th.off
-            out["tx"] = interleave_buckets(th, [T_k1, T_k2, T_d, T_p])
+            th = put_block(
+                "tx", "th_off", lambda: build_hash([T_k1, T_k2]),
+                [T_k1, T_k2], [T_k1, T_k2, T_d, T_p],
+            )
         else:
+            th = build_hash([T_k1, T_k2])
             put_hash("th", th)
             TP = _ceil_pow2(max(T_k1.shape[0], 1))
             out["t_k1"] = _pad(T_k1, TP, -1)
@@ -899,8 +948,8 @@ def build_flat_arrays(
             out["t_p"] = _pad(T_p, TP, NEVER)
         t_kw = dict(
             has_tindex=True,
-            t_cap=_round_cap(th.cap),
-            t_n=_ceil_pow2(max(th.n, 1)),
+            t_cap=_round_cap(th.cap) if th is not None else 4,
+            t_n=_ceil_pow2(max(th.n, 1)) if th is not None else 8,
             t_slots=t_slots,
         )
 
@@ -914,9 +963,9 @@ def build_flat_arrays(
             snap, config, plan, ar_dd
         ).items():
             ri = build_range_hash(src)
-            out[f"rc{ts_slot}_off"] = ri.index.off
-            out[f"rc{ts_slot}gx"] = interleave_buckets(
-                ri.index, [ri.gk, ri.glo, ri.ghi]
+            put_block(
+                f"rc{ts_slot}gx", f"rc{ts_slot}_off", ri.index,
+                [ri.gk], [ri.gk, ri.glo, ri.ghi],
             )
             out[f"rc{ts_slot}x"] = interleave_rows(
                 [anc, d_u, p_u], pad=max(64, fan)
@@ -932,21 +981,22 @@ def build_flat_arrays(
         got = _fold_packed(fr, cl, snap, maps, N, config)
         if got is not None:
             pf_k1, pf_k2, pf_subj, (T2_k1, T2_k2, T2_d, T2_p), pff = got
-            pfh = build_hash([pf_k1, pf_k2])
-            out["pfh_off"] = pfh.off
-            out["pfx"] = interleave_buckets(
-                pfh,
+            pfh = put_block(
+                "pfx", "pfh_off", lambda: build_hash([pf_k1, pf_k2]),
+                [pf_k1, pf_k2],
                 [pf_k1, pf_k2]
                 + ([fr.e_cav, fr.e_ctx] if pff["pf_hascav"] else [])
                 + ([fr.e_until] if pff["pf_hasuntil"] else []),
             )
-            pft = build_hash([T2_k1, T2_k2])
-            out["pfth_off"] = pft.off
-            out["pftx"] = interleave_buckets(pft, [T2_k1, T2_k2, T2_d, T2_p])
+            pft = put_block(
+                "pftx", "pfth_off", lambda: build_hash([T2_k1, T2_k2]),
+                [T2_k1, T2_k2],
+                [T2_k1, T2_k2, T2_d, T2_p],
+            )
             fold_kw = dict(
                 fold_pairs=fr.pairs,
-                pf_e_cap=_round_cap(pfh.cap),
-                pf_t_cap=_round_cap(pft.cap),
+                pf_e_cap=_round_cap(pfh.cap) if pfh is not None else 4,
+                pf_t_cap=_round_cap(pft.cap) if pft is not None else 4,
                 pf_haswc=bool(np.isin(pf_subj, wc_nodes).any()),
                 pf_has_e=pf_k1.shape[0] > 0,
                 pf_has_t=T2_k1.shape[0] > 0,
@@ -966,15 +1016,17 @@ def build_flat_arrays(
         k2_dense=tuple(int(x) for x in maps.k2),
         **rc_kw,
         **fold_kw,
-        e_cap=_round_cap(eh.cap), e_n=_ceil_pow2(max(eh.n, 1)),
+        e_cap=_round_cap(eh.cap) if eh is not None else 4,
+        e_n=_ceil_pow2(max(eh.n, 1)) if eh is not None else 8,
         usr_cap=_round_cap(usr.index.cap),
         usr_gn=_ceil_pow2(max(usr.index.n, 1)),
         us_rows=_ceil_pow2(max(int(snap.us_rel.shape[0]), 1)),
         arr_cap=_round_cap(arr.index.cap),
         arr_gn=_ceil_pow2(max(arr.index.n, 1)),
         ar_rows=_ceil_pow2(max(int(snap.ar_rel.shape[0]), 1)),
-        cl_cap=_round_cap(clh.cap), cl_n=_ceil_pow2(max(clh.n, 1)),
-        has_closure=clh.n > 0,
+        cl_cap=_round_cap(clh.cap) if clh is not None else 4,
+        cl_n=_ceil_pow2(max(clh.n, 1)) if clh is not None else 8,
+        has_closure=int(cl_k1.shape[0]) > 0,
         pus_cap=_round_cap(push.cap), pus_n=_ceil_pow2(max(push.n, 1)),
         ovf_cap=_round_cap(ovfh.cap), ovf_n=_ceil_pow2(max(ovfh.n, 1)),
         has_ovf=ovfh.n > 0,
@@ -989,6 +1041,7 @@ def build_flat_arrays(
         ar_hascav=ar_hascav,
         ar_hasexp=ar_hasexp,
         blockslice=BS,
+        aligned=tuple(al_meta),
         ar_data_depth=ar_dd,
         e_slots=tuple(int(s) for s in np.unique(snap.e_rel)),
         us_slots=tuple(int(s) for s in np.unique(snap.us_rel)),
@@ -1945,12 +1998,28 @@ def make_flat_fn(
                 h = h & mine[..., None]
             return h
 
-        def pblock(off, tbl, cap: int, q_cols):
-            """probe_block with bucket-ownership: (blk, mine).  Sharded
-            tables derive bpd from the LOCAL off length (shapes inside
-            shard_map are per-shard)."""
+        ALD = {k: (c, w, s) for (k, c, w, s) in meta.aligned}
+
+        def pblock(off_key: str, tbl_key: str, cap: int, q_cols):
+            """Layout-dispatched bucket probe: (blk, mine).
+
+            Bucket-ALIGNED tables (``{tbl_key}_al`` present, unsharded
+            base layout) probe with ONE row gather (+ salted spill);
+            otherwise the off+interleave block slice.  Sharded tables
+            derive bpd from the LOCAL off length (shapes inside shard_map
+            are per-shard)."""
             if not SH:
-                return probe_block(off, tbl, cap, q_cols), None
+                al = ALD.get(tbl_key)
+                if al is not None and tbl_key + "_al" in arrs:
+                    c, w_, sc = al
+                    return probe_aligned(
+                        arrs[tbl_key + "_al"], arrs.get(tbl_key + "_als"),
+                        c, w_, sc, q_cols,
+                    ), None
+                return probe_block(
+                    arrs[off_key], arrs[tbl_key], cap, q_cols
+                ), None
+            off, tbl = arrs[off_key], arrs[tbl_key]
             bpd = off.shape[0] - 1
             h = (
                 mix32(q_cols, jnp) & jnp.uint32(bpd * model_size - 1)
@@ -1959,15 +2028,18 @@ def make_flat_fn(
             start = take_in_bounds(off, h & jnp.int32(bpd - 1))
             return slice_blocks(tbl, start, cap), mine
 
-        def range_probe(off, tbl, cap: int, q, rep: bool = False):
+        def range_probe(off_key: str, tbl_key: str, cap: int, q,
+                        rep: bool = False):
             """(lo, hi) LOCAL row range of group key ``q``; (0, 0) on a
             miss or on non-owning shards.  ``rep`` marks a REPLICATED
             table (delta overlays): the bucket-ownership math would use
             the wrong hash mask there, so it probes plainly."""
             if rep:
-                blk, mine = probe_block(off, tbl, cap, (q,)), None
+                blk, mine = probe_block(
+                    arrs[off_key], arrs[tbl_key], cap, (q,)
+                ), None
             else:
-                blk, mine = pblock(off, tbl, cap, (q,))
+                blk, mine = pblock(off_key, tbl_key, cap, (q,))
             hit = blk_hit(blk, (q,), mine)
             lo = jnp.max(jnp.where(hit, blk[..., 1], 0), axis=-1)
             hi = jnp.max(jnp.where(hit, blk[..., 2], 0), axis=-1)
@@ -1976,8 +2048,8 @@ def make_flat_fn(
         def range_of(prefix: str, cap: int, n: int, q):
             if BS:
                 return range_probe(
-                    arrs[prefix + "_off"],
-                    arrs[{"usr": "usgx", "arr": "argx"}[prefix]],
+                    prefix + "_off",
+                    {"usr": "usgx", "arr": "argx"}[prefix],
                     cap, q,
                 )
             ri = {
@@ -1996,7 +2068,7 @@ def make_flat_fn(
                 return z, z
             if BS:
                 blk, mine = pblock(
-                    arrs["clh_off"], arrs["clx"], meta.cl_cap, (srck, gk)
+                    "clh_off", "clx", meta.cl_cap, (srck, gk)
                 )
                 hit = blk_hit(blk, (srck, gk), mine)
                 return (
@@ -2059,7 +2131,7 @@ def make_flat_fn(
             if meta.pf_has_e:
                 def pe_site(k2q):
                     blk, mine = pblock(
-                        arrs["pfh_off"], arrs["pfx"], meta.pf_e_cap, (k1, k2q)
+                        "pfh_off", "pfx", meta.pf_e_cap, (k1, k2q)
                     )
                     hit = blk_hit(blk, (k1, k2q), mine) & exists[..., None]
                     live = hit
@@ -2091,7 +2163,7 @@ def make_flat_fn(
             if meta.pf_has_t:
                 def pt_site(k2q):
                     blk, mine = pblock(
-                        arrs["pfth_off"], arrs["pftx"], meta.pf_t_cap,
+                        "pfth_off", "pftx", meta.pf_t_cap,
                         (k1, k2q),
                     )
                     hit = blk_hit(blk, (k1, k2q), mine) & exists[..., None]
@@ -2204,7 +2276,7 @@ def make_flat_fn(
                     hd = hp = jnp.zeros(nodes.shape, bool)
                     if run_e:
                         blk, mine = pblock(
-                            arrs["eh_off"], arrs["ehx"], meta.e_cap,
+                            "eh_off", "ehx", meta.e_cap,
                             (k1, k2q),
                         )
                         hit = blk_hit(blk, (k1, k2q), mine) & exists[..., None]
@@ -2257,7 +2329,7 @@ def make_flat_fn(
                 def t_site(k2q):
                     if BS:
                         blk, mine = pblock(
-                            arrs["th_off"], arrs["tx"], meta.t_cap, (k1, k2q)
+                            "th_off", "tx", meta.t_cap, (k1, k2q)
                         )
                         hit = blk_hit(blk, (k1, k2q), mine) & exists[..., None]
                         return (
@@ -2308,7 +2380,7 @@ def make_flat_fn(
                     range_of("usr", cap, meta.usr_gn, k1)
                     if not rep
                     else range_probe(
-                        arrs["dl_usr_off"], arrs["dl_usgx"], cap, k1, rep=True
+                        "dl_usr_off", "dl_usgx", cap, k1, rep=True
                     )
                 )
                 over = reduceB(exists & ((hi - lo) > fan))
@@ -2356,7 +2428,7 @@ def make_flat_fn(
                         else jnp.zeros(valid.shape, bool)
                     )
                     pblk, pmine = pblock(
-                        arrs["push_off"], arrs["pusx"], meta.pus_cap, (gk,)
+                        "push_off", "pusx", meta.pus_cap, (gk,)
                     )
                     in_pus = por(jnp.any(blk_hit(pblk, (gk,), pmine), axis=-1))
                     in_d = (in_d | refl) & ~permf
@@ -2510,7 +2582,7 @@ def make_flat_fn(
             # rc tables follow the base layout: bucket-sharded under SH
             # (owner-local ranges, broadcast below), plain otherwise
             lo, hi = range_probe(
-                arrs[f"rc{ts_slot}_off"], arrs[f"rc{ts_slot}gx"], cap, nq
+                f"rc{ts_slot}_off", f"rc{ts_slot}gx", cap, nq
             )
             valid = (
                 jnp.arange(fan, dtype=jnp.int32) < (hi - lo)[..., None]
@@ -2590,7 +2662,7 @@ def make_flat_fn(
                     lo = hi = jnp.zeros(nodes.shape, jnp.int32)
                 if Ksd:
                     lod, hid = range_probe(
-                        arrs["dl_arr_off"], arrs["dl_argx"], dm.ar_cap, ak,
+                        "dl_arr_off", "dl_argx", dm.ar_cap, ak,
                         rep=True,
                     )
                 else:
@@ -2692,7 +2764,7 @@ def make_flat_fn(
             def ovf_probe(k):
                 if BS:
                     oblk, omine = pblock(
-                        arrs["ovfh_off"], arrs["ovfx"], meta.ovf_cap, (k,)
+                        "ovfh_off", "ovfx", meta.ovf_cap, (k,)
                     )
                     return por(jnp.any(blk_hit(oblk, (k,), omine), axis=-1))
                 return probe_rows(

@@ -30,7 +30,7 @@ replacement.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -308,6 +308,147 @@ def slice_blocks(tbl, start, cap: int):
     ]
     blk = jnp.stack(cols, axis=-1)
     return blk.reshape(tuple(jnp.shape(start)) + (cap, w))
+
+
+# ---------------------------------------------------------------------------
+# bucket-ALIGNED layout: the whole bucket is one table row
+# ---------------------------------------------------------------------------
+#
+# The off+interleave layout above still pays 2 sequential gathers per
+# probe (bucket offset, then block) and — worse — lets build_hash balloon
+# the offsets array to 8x entries chasing cap<=4 (a 2.6M-entry fold table
+# grew a 256MB off array).  On TPU the winning shape (measured:
+# tpu_attempts/micro_blocks.py, ~48M probes/s vs 0.75M for vmapped
+# dynamic_slice and 7M for flat gathers) is ONE row gather: store bucket
+# b's entries IN row b of an int32[size, cap*w] matrix, padded with -1.
+# Probe = hash -> tbl[h] -> compare, a single contiguous 64-128B fetch
+# per query.
+#
+# The Poisson tail would force cap (and the whole matrix width) up to the
+# fullest bucket, so entries beyond ``cap`` per bucket SPILL to a second,
+# much smaller aligned table under a salted hash; the probe fetches both
+# rows (2 gathers, still 24M+/s) and the kernel sees one concatenated
+# candidate block.  Worlds whose duplicate-key multiplicity exceeds the
+# spill cap fall back to the off+interleave layout (build returns None).
+
+_SPILL_SALT = np.int32(np.uint32(0x9E3779B9).astype(np.int32))
+
+
+@dataclass
+class AlignedIndex:
+    """Bucket-aligned probe table (+ optional spill level)."""
+
+    tbl: np.ndarray  # int32[size, cap*w]
+    cap: int
+    w: int
+    spill: Optional[np.ndarray]  # int32[size2, spill_cap*w] or None
+    spill_cap: int  # 0 when spill is None
+    n: int
+
+    @property
+    def nbytes(self) -> int:
+        return self.tbl.nbytes + (0 if self.spill is None else self.spill.nbytes)
+
+
+def _aligned_fill(
+    h: np.ndarray, cols: Sequence[np.ndarray], size: int, cap: int
+):
+    """Place entries into an int32[size, cap*w] matrix; returns
+    (tbl, leftover_row_indices) where leftover rows did not fit their
+    bucket's ``cap`` slots."""
+    w = len(cols)
+    n = int(h.shape[0])
+    order = np.argsort(h, kind="stable")
+    hs = h[order]
+    counts = np.bincount(hs, minlength=size)
+    off = np.zeros(size, np.int64)
+    np.cumsum(counts[:-1], out=off[1:])
+    rank = np.arange(n, dtype=np.int64) - off[hs]
+    fits = rank < cap
+    tbl = np.full((size, cap * w), -1, np.int32)
+    rows_in = order[fits]
+    slot = (rank[fits] * w).astype(np.int64)
+    for j, c in enumerate(cols):
+        tbl[hs[fits], slot + j] = np.ascontiguousarray(c, np.int32)[rows_in]
+    return tbl, order[~fits]
+
+
+def build_aligned(
+    key_cols: Sequence[np.ndarray],
+    cols: Sequence[np.ndarray],
+    *,
+    target_cap: int = 4,
+    spill_max_cap: int = 16,
+    min_size: int = 8,
+    max_bytes: Optional[int] = None,
+) -> Optional[AlignedIndex]:
+    """Bucket-aligned index over lock-step int32 columns (``key_cols``
+    must be a prefix of ``cols`` — the probe compares them in order).
+    Returns None when the layout doesn't fit (spill tail too deep for
+    ``spill_max_cap`` — e.g. one full key duplicated >cap+spill_cap
+    times — or ``max_bytes`` exceeded): callers fall back to the
+    off+interleave layout."""
+    w = max(len(cols), 1)
+    n = int(cols[0].shape[0]) if cols else 0
+    if n == 0:
+        return AlignedIndex(
+            tbl=np.full((min_size, target_cap * w), -1, np.int32),
+            cap=target_cap, w=w, spill=None, spill_cap=0, n=0,
+        )
+    ckey = [np.ascontiguousarray(c, np.int32) for c in key_cols]
+    h_full = mix32(ckey, np)
+    size = _ceil_pow2(max(min_size, (2 * n) // max(target_cap, 1)))
+    if max_bytes is not None and size * target_cap * w * 4 > max_bytes:
+        return None
+    h = (h_full & np.uint32(size - 1)).astype(np.int64)
+    tbl, left = _aligned_fill(h, cols, size, target_cap)
+    spill = None
+    spill_cap = 0
+    if left.shape[0]:
+        ckey2 = [ckey[0][left] ^ _SPILL_SALT] + [c[left] for c in ckey[1:]]
+        h2_full = mix32(ckey2, np)
+        n2 = int(left.shape[0])
+        size2 = _ceil_pow2(max(min_size, n2))
+        cols2 = [np.ascontiguousarray(c, np.int32)[left] for c in cols]
+        while True:
+            h2 = (h2_full & np.uint32(size2 - 1)).astype(np.int64)
+            cap2 = int(np.bincount(h2, minlength=size2).max())
+            if cap2 <= spill_max_cap:
+                break
+            if size2 >= _ceil_pow2(8 * n2):
+                return None  # duplicate-heavy tail: aligned layout unfit
+            size2 <<= 1
+        spill, left2 = _aligned_fill(h2, cols2, size2, cap2)
+        if left2.shape[0]:
+            return None
+        spill_cap = cap2
+    out = AlignedIndex(
+        tbl=tbl, cap=target_cap, w=w, spill=spill, spill_cap=spill_cap, n=n
+    )
+    if max_bytes is not None and out.nbytes > max_bytes:
+        return None
+    return out
+
+
+def probe_aligned(tbl, spill, cap: int, w: int, spill_cap: int, q_cols):
+    """Candidate block int32[..., cap (+ spill_cap), w] for the bucket of
+    ``q_cols`` — ONE row gather (+ one salted spill gather).  Padded slots
+    hold -1 and match nothing; same-key entries land in the same bucket
+    (or its spill row), so callers just compare key columns exactly."""
+    import jax.numpy as jnp
+
+    h = (mix32(q_cols, jnp) & jnp.uint32(tbl.shape[0] - 1)).astype(jnp.int32)
+    blk = take_in_bounds(tbl, h).reshape(jnp.shape(h) + (cap, w))
+    if spill is not None:
+        q2 = (q_cols[0] ^ _SPILL_SALT,) + tuple(q_cols[1:])
+        h2 = (
+            mix32(q2, jnp) & jnp.uint32(spill.shape[0] - 1)
+        ).astype(jnp.int32)
+        b2 = take_in_bounds(spill, h2).reshape(
+            jnp.shape(h2) + (spill_cap, w)
+        )
+        blk = jnp.concatenate([blk, b2], axis=-2)
+    return blk
 
 
 def probe_block(off, tbl, cap: int, q_cols: Sequence):

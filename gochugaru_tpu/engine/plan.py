@@ -114,6 +114,15 @@ class EngineConfig:
     #: ancestor can dirty a whole subtree, and recomputing that each
     #: revision would cost more than walking
     flat_fold_delta_dirty_cap: int = 16_384
+    #: bucket-ALIGNED probe tables (engine/hash.py build_aligned): each
+    #: bucket is ONE table row fetched with a single row gather — on TPU
+    #: ~48M probes/s vs 0.75M for the off+block layout (measured,
+    #: tpu_attempts/micro_blocks.py).  None = auto (on when the default
+    #: backend is tpu); tests force True to exercise the layout on CPU
+    flat_aligned: Optional[bool] = None
+    #: per-table byte budget for the aligned layout; tables whose aligned
+    #: form exceeds it keep the off+interleave layout
+    flat_aligned_max_bytes: int = 3 << 30
 
     @staticmethod
     def for_schema(compiled: CompiledSchema, **overrides) -> "EngineConfig":

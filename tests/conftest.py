@@ -20,3 +20,20 @@ import jax
 
 jax.config.update("jax_compilation_cache_dir", "/tmp/gochugaru_xla_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+# GOCHUGARU_FLAT_ALIGNED=1 runs the whole suite under the bucket-ALIGNED
+# table layout (engine/hash.py build_aligned — the TPU-default layout,
+# otherwise off on the CPU suite).  Scoped to the test harness on
+# purpose: production code paths must not read layout toggles from the
+# environment.
+_env_aligned = os.environ.get("GOCHUGARU_FLAT_ALIGNED")
+if _env_aligned is not None:
+    from gochugaru_tpu.engine.plan import EngineConfig
+
+    _orig_for_schema = EngineConfig.for_schema
+
+    def _for_schema_aligned(compiled, **overrides):
+        overrides.setdefault("flat_aligned", _env_aligned == "1")
+        return _orig_for_schema(compiled, **overrides)
+
+    EngineConfig.for_schema = staticmethod(_for_schema_aligned)
