@@ -289,8 +289,11 @@ def child_main(mode: str, note: str | None) -> None:
             note=note or "degraded: cpu fallback",
         )
     else:
+        # ramp past 131k: with the aligned-table kernel the dispatch is
+        # ~6 row gathers, so bigger batches keep amortizing the tunnel
+        # round trip (budget gating skips the tail on a short window)
         run_bench(
-            batches=(8_192, 32_768, 131_072),
+            batches=(8_192, 32_768, 131_072, 262_144),
             world_kw={},
             budget_s=TPU_CHILD_TIMEOUT_S,
             note=note,
