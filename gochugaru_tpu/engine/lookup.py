@@ -142,6 +142,17 @@ def lookup_index(snap: Snapshot, mark_used: bool = True) -> LookupIndex:
         idx = getattr(snap, "_lookup_index", None)
         if idx is not None:
             return idx
+        # chain-advance fast path: materializing a chained LSM snapshot
+        # whose BASE carries an index advances that index as part of the
+        # merge (store/delta.py _materialize_locked) in O(E + D log E)
+        # identity merges — force the (lazy) materialization and pick
+        # the advanced index up, instead of paying the O(E log E)
+        # rebuild.  This is the warm path of the Watch re-index loop
+        if getattr(snap, "_lsm_base", None) is not None:
+            snap._materialize()
+        idx = getattr(snap, "_lookup_index", None)
+        if idx is not None:  # the materialization advanced it
+            return idx
         return _build_lookup_index(snap)
 
 
@@ -534,29 +545,64 @@ def lookup_subjects_device(
 # ---------------------------------------------------------------------------
 
 
+def _view_keys(idx: "LookupIndex", prev: Snapshot):
+    """Packed (k1, k2) int64 key arrays per transposed view, cached on
+    the index — advancing then never re-packs or re-casts the O(E)
+    columns, only merges them forward (the cache rides to the advanced
+    index, so a Watch chain packs once per full build, not per
+    revision)."""
+    d = idx.__dict__
+    if "_rs_k2" not in d:
+        d["_rs_k2"] = (
+            idx.rs_rel.astype(np.int64) * _B32 + idx.rs_res
+        )
+    if "_er_k1" not in d:
+        d["_er_k1"] = idx.er_res.astype(np.int64)
+    if "_er_k2" not in d:
+        d["_er_k2"] = (
+            (idx.er_rel.astype(np.int64) << np.int64(47))
+            | (idx.er_subj.astype(np.int64) << np.int64(16))
+            | idx.er_srel1.astype(np.int64)
+        )
+    if "_ra_k1" not in d:
+        d["_ra_k1"] = idx.ra_child.astype(np.int64)
+    if "_ra_k2" not in d:
+        ra_rel = _ra_rel_of(prev, idx)
+        d["_ra_k2"] = ra_rel.astype(np.int64) * _B32 + idx.ra_res
+    return d
+
+
 def advance_lookup_index(
     prev: Snapshot,
     nxt: Snapshot,
     *,
-    gone_rows: np.ndarray,
+    g_rel: np.ndarray,
+    g_res: np.ndarray,
+    g_subj: np.ndarray,
+    g_srel1: np.ndarray,
     a_rel: np.ndarray,
     a_res: np.ndarray,
     a_subj: np.ndarray,
     a_srel1: np.ndarray,
 ) -> None:
     """Produce ``nxt._lookup_index`` from ``prev``'s by removing the
-    tombstoned identities and merging the sorted additions into each
-    transposed view — O(E + D log E) per revision instead of the full
-    O(E log E) rebuild (store/delta.py calls this from apply_delta when
-    the previous revision's index exists)."""
+    ``g_*`` identities and merging the sorted ``a_*`` additions into each
+    transposed view — O(E + D log E) instead of the full O(E log E)
+    rebuild.  Removal is by IDENTITY (not row position), so the delta may
+    span a whole LSM chain: apply_delta calls this per eager revision,
+    and _materialize_locked calls it when a chained snapshot merges, with
+    the base's accumulated tombstones + overlay (store/delta.py).  The
+    packed per-view key arrays are cached on the index and merged
+    forward (_view_keys), so repeated advances pay only array copies."""
     from ..store.delta import find_in_view, merge_positions
 
     idx: LookupIndex = prev._lookup_index
+    keys = _view_keys(idx, prev)
     NS1 = np.int64(prev.num_slots + 1)
-    g_rel = prev.e_rel[gone_rows].astype(np.int64)
-    g_res = prev.e_res[gone_rows].astype(np.int64)
-    g_subj = prev.e_subj[gone_rows].astype(np.int64)
-    g_srel1 = prev.e_srel1[gone_rows].astype(np.int64)
+    g_rel = g_rel.astype(np.int64)
+    g_res = g_res.astype(np.int64)
+    g_subj = g_subj.astype(np.int64)
+    g_srel1 = g_srel1.astype(np.int64)
     a_rel = a_rel.astype(np.int64)
     a_res = a_res.astype(np.int64)
     a_subj = a_subj.astype(np.int64)
@@ -565,9 +611,12 @@ def advance_lookup_index(
     def pack_rr(rel, res):
         return rel * _B32 + res
 
+    def pack_rss(rel, subj, srel1):
+        return (rel << np.int64(47)) | (subj << np.int64(16)) | srel1
+
     def advance_view(old_k1, old_k2, cols_old, rem_k1, rem_k2,
                      new_k1, new_k2, cols_new):
-        """Merged (k1, cols...) of one lexsorted view after the delta."""
+        """Merged (k1, k2, cols...) of one lexsorted view post-delta."""
         pos = find_in_view(old_k1, old_k2, rem_k1, rem_k2)
         keep = np.ones(old_k1.shape[0], dtype=bool)
         keep[pos[pos >= 0]] = False
@@ -576,20 +625,21 @@ def advance_lookup_index(
             old_k1[keep], old_k2[keep], new_k1[n_ord], new_k2[n_ord]
         )
         total = po.shape[0] + pn.shape[0]
-        mk1 = np.empty(total, old_k1.dtype)
-        mk1[po] = old_k1[keep]
-        mk1[pn] = new_k1[n_ord]
-        out = []
-        for co, cn in zip(cols_old, cols_new):
-            m = np.empty(total, co.dtype)
-            m[po] = co[keep]
-            m[pn] = cn[n_ord].astype(co.dtype)
-            out.append(m)
-        return mk1, out
+
+        def m(co, cn):
+            out = np.empty(total, co.dtype)
+            out[po] = co[keep]
+            out[pn] = cn[n_ord].astype(co.dtype)
+            return out
+
+        return (
+            m(old_k1, new_k1), m(old_k2, new_k2),
+            [m(co, cn) for co, cn in zip(cols_old, cols_new)],
+        )
 
     # rs view: keyed (subj, srel1); residual order (rel, res)
-    rs_key, (rs_res, rs_rel) = advance_view(
-        idx.rs_key, pack_rr(idx.rs_rel.astype(np.int64), idx.rs_res),
+    rs_key, rs_k2, (rs_res, rs_rel) = advance_view(
+        idx.rs_key, keys["_rs_k2"],
         (idx.rs_res, idx.rs_rel),
         g_subj * NS1 + g_srel1, pack_rr(g_rel, g_res),
         a_subj * NS1 + a_srel1, pack_rr(a_rel, a_res),
@@ -597,16 +647,8 @@ def advance_lookup_index(
     )
 
     # er view: keyed res; residual order (rel, subj, srel1)
-    def pack_rss(rel, subj, srel1):
-        return (rel << np.int64(47)) | (subj << np.int64(16)) | srel1
-
-    er_res, (er_rel, er_subj, er_srel1) = advance_view(
-        idx.er_res.astype(np.int64),
-        pack_rss(
-            idx.er_rel.astype(np.int64),
-            idx.er_subj.astype(np.int64),
-            idx.er_srel1.astype(np.int64),
-        ),
+    er_k1, er_k2, (er_rel, er_subj, er_srel1) = advance_view(
+        keys["_er_k1"], keys["_er_k2"],
         (idx.er_rel, idx.er_subj, idx.er_srel1),
         g_res, pack_rss(g_rel, g_subj, g_srel1),
         a_res, pack_rss(a_rel, a_subj, a_srel1),
@@ -619,9 +661,8 @@ def advance_lookup_index(
     g_ar = np.isin(g_rel, ts) & (g_srel1 == 0)
     a_ar = np.isin(a_rel, ts) & (a_srel1 == 0)
     prev_ra_rel = _ra_rel_of(prev, idx)
-    ra_child, (ra_res, ra_rel) = advance_view(
-        idx.ra_child.astype(np.int64),
-        pack_rr(prev_ra_rel, idx.ra_res.astype(np.int64)),
+    ra_k1, ra_k2, (ra_res, ra_rel) = advance_view(
+        keys["_ra_k1"], keys["_ra_k2"],
         (idx.ra_res, prev_ra_rel),
         g_subj[g_ar], pack_rr(g_rel[g_ar], g_res[g_ar]),
         a_subj[a_ar], pack_rr(a_rel[a_ar], a_res[a_ar]),
@@ -638,14 +679,20 @@ def advance_lookup_index(
     new_idx = LookupIndex(
         rs_key=rs_key,
         rs_res=rs_res, rs_rel=rs_rel,
-        ra_child=ra_child.astype(np.int32), ra_res=ra_res,
-        er_res=er_res.astype(np.int32), er_rel=er_rel,
+        ra_child=ra_k1.astype(np.int32), ra_res=ra_res,
+        er_res=er_k1.astype(np.int32), er_rel=er_rel,
         er_subj=er_subj, er_srel1=er_srel1,
         e_relres=nxt.e_rel.astype(np.int64) * _B32 + nxt.e_res.astype(np.int64),
         ar_relres=nxt.ar_rel.astype(np.int64) * _B32 + nxt.ar_res.astype(np.int64),
         perm_table=perm_table,
         perm_slots_of_tid=perm_slots,
     )
+    # carry the packed key caches: chained advances stay copy-only
+    new_idx.__dict__["_rs_k2"] = rs_k2
+    new_idx.__dict__["_er_k1"] = er_k1
+    new_idx.__dict__["_er_k2"] = er_k2
+    new_idx.__dict__["_ra_k1"] = ra_k1
+    new_idx.__dict__["_ra_k2"] = ra_k2
     new_idx._ra_rel = ra_rel  # keep chained advances O(E + D log E)
     nxt._lookup_index = new_idx
 

@@ -393,6 +393,25 @@ class LsmSnapshot(Snapshot):
         # rows — replace the conservative carry-forward with the truth
         self.__dict__["us_used_keys"] = nxt.us_used_keys
         self.__dict__["_lsm_done"] = True
+        # carry the lookup index across the chain BEFORE the state that
+        # feeds the advance is dropped: identity-based advance from the
+        # base's index with the accumulated tombstones + overlay — the
+        # O(E + D log E) path that keeps warm lookup_resources warm
+        # across a Watch chain (engine/lookup.py advance_lookup_index)
+        if (
+            getattr(self, "_lookup_index", None) is None
+            and getattr(base, "_lookup_index", None) is not None
+        ):
+            from ..engine.lookup import advance_lookup_index
+
+            g = ~keep  # the accumulated base-row tombstone mask
+            advance_lookup_index(
+                base, self,
+                g_rel=base.e_rel[g], g_res=base.e_res[g],
+                g_subj=base.e_subj[g], g_srel1=base.e_srel1[g],
+                a_rel=ov["rel"], a_res=ov["res"],
+                a_subj=ov["subj"], a_srel1=ov["srel1"],
+            )
         # drop the chain state: a materialized snapshot otherwise pins
         # the whole previous base's columns (~2× E-row memory) forever
         self._lsm_base = self._lsm_ov = self._lsm_gone = None
@@ -569,23 +588,24 @@ def apply_delta(
         g_rel=g_rel, g_res=g_res, g_subj=g_subj, g_srel1=g_srel1,
         contexts_renumbered=renumbered,
     )
-    if not defer and not chained:
-        # carry the lookup index forward: when the previous snapshot has
-        # one, advance it by the delta (O(E + D log E) merges) instead of
-        # letting the next lookup pay a full O(E log E) rebuild.  Only on
-        # the unchained eager path: gone_rows must index PREV's merged
-        # rows, and a chain's base_hit indexes the base instead (and
-        # misses overlay-only deletions) — a chained prev simply lets the
-        # next lookup rebuild
-        if getattr(prev, "_lookup_index", None) is not None:
-            from ..engine.lookup import advance_lookup_index
+    if (
+        not defer
+        and getattr(nxt, "_lookup_index", None) is None
+        and getattr(prev, "_lookup_index", None) is not None
+    ):
+        # carry the lookup index forward: advance prev's by this
+        # revision's removal identities + additions (O(E + D log E)
+        # merges) instead of letting the next lookup pay a full
+        # O(E log E) rebuild.  Removal is identity-based, so the chained
+        # path works too: g_* is exactly the set of identities live at
+        # prev that this revision removes or replaces (base rows not
+        # already tombstoned, plus overlay rows).  A chained prev WITHOUT
+        # an index leaves the work to lookup_index()'s chain-advance
+        from ..engine.lookup import advance_lookup_index
 
-            prev_rows = (
-                np.unique(base_hit[base_hit >= 0])
-                if base_hit.size else np.zeros(0, np.int64)
-            )
-            advance_lookup_index(
-                prev, nxt, gone_rows=prev_rows,
-                a_rel=a_rel, a_res=a_res, a_subj=a_subj, a_srel1=a_srel1,
-            )
+        advance_lookup_index(
+            prev, nxt,
+            g_rel=g_rel, g_res=g_res, g_subj=g_subj, g_srel1=g_srel1,
+            a_rel=a_rel, a_res=a_res, a_subj=a_subj, a_srel1=a_srel1,
+        )
     return nxt

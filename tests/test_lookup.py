@@ -386,3 +386,59 @@ def test_client_lookup_uses_device_path():
     rid = repos[0].split(":")[1]
     got = sorted(c.lookup_subjects(ctx, cs, repos[0], "read", "user"))
     assert got == sorted(oracle.lookup_subjects("repo", rid, "read", "user", ""))
+
+
+def test_lookup_index_advances_through_lsm_chain(monkeypatch):
+    """A chained (deferred) LSM snapshot whose BASE carries a lookup
+    index must answer lookups by ADVANCING that index with the chain's
+    accumulated overlay/tombstones — never by a full rebuild
+    (engine/lookup.py lookup_index chain-advance; VERDICT r04 item 4)."""
+    from gochugaru_tpu.engine import lookup as lookup_mod
+    from gochugaru_tpu.store.delta import apply_delta
+
+    rels, users, teams, orgs, repos = rbac_world()
+    cs, engine, dsnap, oracle = world(RBAC, rels)
+    snap = dsnap.snapshot
+    # plant the base index the way the prepare-time prewarm does
+    lookup_mod.lookup_index(snap, mark_used=False)
+    assert getattr(snap, "_lookup_index", None) is not None
+    assert not getattr(snap, "_lookup_used", False)
+
+    # chain several deferred revisions: adds, an upsert-replace, deletes
+    # (incl. deleting a row added earlier in the chain)
+    cur, cur_rels = snap, list(rels)
+    deltas = [
+        ([rel.must_from_tuple("repo:r0#reader", "user:u19")], []),
+        ([rel.must_from_tuple("repo:r1#reader", "user:u18")],
+         [rel.must_from_tuple("repo:r0#reader", "user:u19")]),
+        ([rel.must_from_tuple("repo:r2#reader", "user:u17")],
+         [cur_rels[-1]]),
+    ]
+    revision = 2
+    for adds, dels in deltas:
+        cur = apply_delta(cur, revision, adds, dels,
+                          interner=snap.interner, defer=True)
+        for d in dels:
+            cur_rels = [r for r in cur_rels if str(r) != str(d)]
+        cur_rels += adds
+        revision += 1
+    assert getattr(cur, "_lookup_index", None) is None
+
+    # any full rebuild now is the bug this test pins
+    def _no_rebuild(s):
+        raise AssertionError("full lookup-index rebuild on a chained snap")
+
+    monkeypatch.setattr(lookup_mod, "_build_lookup_index", _no_rebuild)
+
+    ds2 = engine.prepare(cur, prev=dsnap)
+    oracle2 = Oracle(cs, cur_rels, {}, now_us=NOW)
+    for u in ("user:u19", "user:u18", "user:u17", "user:u0"):
+        got = lookup_resources_device(
+            engine, ds2, "repo", "read", "user", u.split(":")[1], "",
+            now_us=NOW, oracle_factory=lambda: oracle2,
+        )
+        want = sorted(oracle2.lookup_resources("repo", "read", "user",
+                                               u.split(":")[1], ""))
+        assert got == want, f"{u}: {got} != {want}"
+    # the advanced index landed on the tip snapshot
+    assert getattr(cur, "_lookup_index", None) is not None
