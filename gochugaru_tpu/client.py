@@ -575,6 +575,53 @@ class Client:
                 raise err
             yield chunk
 
+    def import_relationship_id_columns(
+        self,
+        ctx: Context,
+        *,
+        resource_ids,
+        resource_relation: str,
+        subject_ids,
+        subject_relation: str = "",
+    ) -> None:
+        """Pre-interned columnar bulk restore: int node-id columns from
+        THIS store's interner (``export_relationship_id_columns``
+        chunks, or ``Interner.node_batch`` results) — no string work at
+        all, the fastest restore path (~5x the string-columnar rate).
+        Rows may mix resource/subject types.  Falls back to a retried
+        TOUCH import on AlreadyExists, like the reference's recovery
+        (client/client.go:448-463)."""
+        self._check_overlap(ctx)
+        kw = dict(
+            resource_ids=resource_ids, resource_relation=resource_relation,
+            subject_ids=subject_ids, subject_relation=subject_relation,
+        )
+        try:
+            self._store.import_interned_columns(**kw)
+        except AlreadyExistsError:
+            retry_retriable_errors(
+                ctx,
+                lambda: self._store.import_interned_columns(
+                    **kw, touch=True
+                ),
+            )
+
+    def export_relationship_id_columns(
+        self, ctx: Context, revision: str
+    ) -> Iterator[Dict[str, Any]]:
+        """Interned columnar export at an exact snapshot revision: yields
+        chunks of int32 node-id columns (one (relation, subject-relation)
+        shape per chunk) — the zero-string mirror of
+        ``import_relationship_id_columns`` for restore pipelines staying
+        within this store's interner.  Cancellation is honored between
+        chunks."""
+        self._check_overlap(ctx)
+        for chunk in self._store.export_interned_columns_at(revision):
+            err = ctx.err()
+            if err is not None:
+                raise err
+            yield chunk
+
     # ------------------------------------------------------------------
     # Lookups (client/client.go:501-599)
     # ------------------------------------------------------------------

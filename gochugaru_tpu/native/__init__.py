@@ -119,6 +119,11 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.gi_argsort1.argtypes = [
             c.POINTER(c.c_int32), c.c_int64, c.POINTER(c.c_int64),
         ]
+        lib.gi_join_sorted2.argtypes = [
+            c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.c_int64,
+            c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.c_int64,
+            c.POINTER(c.c_int64),
+        ]
         _lib = lib
         return _lib
 

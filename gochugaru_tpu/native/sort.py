@@ -68,3 +68,28 @@ def argsort1(a: np.ndarray) -> np.ndarray:
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
     )
     return out
+
+
+def join_sorted2(
+    th: np.ndarray, tl: np.ndarray, qh: np.ndarray, ql: np.ndarray
+) -> np.ndarray:
+    """Exact join of (h, l)-lexsorted int64 pair sets: first table
+    position per query, -1 on miss.  One native linear merge; the numpy
+    fallback is the two-level grouped search (store/delta.py)."""
+    L = lib()
+    nq = qh.shape[0]
+    if L is None or nq < (1 << 12):
+        from ..store.delta import find_in_view
+
+        return find_in_view(th, tl, qh, ql)
+    th = np.ascontiguousarray(th, np.int64)
+    tl = np.ascontiguousarray(tl, np.int64)
+    qh = np.ascontiguousarray(qh, np.int64)
+    ql = np.ascontiguousarray(ql, np.int64)
+    out = np.empty(nq, np.int64)
+    p64 = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    L.gi_join_sorted2(
+        p64(th), p64(tl), ctypes.c_int64(th.shape[0]),
+        p64(qh), p64(ql), ctypes.c_int64(nq), p64(out),
+    )
+    return out

@@ -21,6 +21,7 @@ from typing import Any, Dict, Iterator, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..native.sort import lexsort4
 from ..rel.filter import Filter
 from ..rel.relationship import Relationship, expiration_micros
 from ..schema.compiler import CompiledSchema
@@ -45,10 +46,11 @@ class ColumnSegment:
 
     __slots__ = (
         "res", "rel", "subj", "srel1", "caveat", "ctx", "exp_us",
-        "live", "skey", "sorder",
+        "live", "sorder", "_skey_h", "_skey_l",
     )
 
-    def __init__(self, res, rel, subj, srel1, caveat, ctx, exp_us) -> None:
+    def __init__(self, res, rel, subj, srel1, caveat, ctx, exp_us,
+                 presorted=None) -> None:
         self.res = res
         self.rel = rel
         self.subj = subj
@@ -57,9 +59,23 @@ class ColumnSegment:
         self.ctx = ctx
         self.exp_us = exp_us
         self.live = np.ones(res.shape[0], bool)
-        keys = pack_keys(res, rel, subj, srel1)
-        self.sorder = np.argsort(keys, kind="stable")
-        self.skey = keys[self.sorder]
+        if presorted is not None:
+            # the commit path already key-sorted the batch: reuse its
+            # (sorder, h-keys, l-keys) instead of re-sorting 10M rows
+            self.sorder, self._skey_h, self._skey_l = presorted
+        else:
+            # native stable radix lexsort: np.argsort on the structured
+            # key dtype is ~10s at 10M rows on this host, lexsort4 ~1.5s
+            # (all key components are non-negative, so signed order ==
+            # key order).  Only the two contiguous int64 halves are kept
+            # — a structured copy would double per-segment key memory
+            self.sorder = lexsort4(rel, res, subj, srel1)
+            self._skey_h = (
+                (rel.astype(np.int64) << 32) | res.astype(np.int64)
+            )[self.sorder]
+            self._skey_l = (
+                (subj.astype(np.int64) << 32) | srel1.astype(np.int64)
+            )[self.sorder]
 
     def __len__(self) -> int:
         return int(self.res.shape[0])
@@ -69,16 +85,46 @@ class ColumnSegment:
         return int(np.count_nonzero(self.live))
 
     # -- key probes ------------------------------------------------------
+    def rows_of_sorted_halves(
+        self, qh: np.ndarray, ql: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """(hit_mask, row_index) per query for queries ALREADY lexsorted
+        by (h, l): one native linear merge against the segment's sorted
+        keys (native/sort.py join_sorted2) — the bulk-import dup-probe
+        path, O(E + B) with no per-key bisection."""
+        from ..native.sort import join_sorted2
+
+        n = int(self._skey_h.shape[0])
+        hit = np.zeros(qh.shape[0], bool)
+        rows = np.zeros(qh.shape[0], np.int64)
+        if n:
+            pos = join_sorted2(self._skey_h, self._skey_l, qh, ql)
+            found = pos >= 0
+            rows = self.sorder[np.clip(pos, 0, n - 1)]
+            hit = found & self.live[rows]
+        return hit, rows
+
     def rows_of_keys(self, keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """(hit_mask, row_index) per query key; only LIVE rows hit.  Keys
-        are unique within a segment, so at most one row matches."""
-        lo = np.searchsorted(self.skey, keys, "left")
-        loc = np.clip(lo, 0, max(len(self.skey) - 1, 0))
+        are unique within a segment, so at most one row matches.
+
+        The probe is a two-level int64 search over the (h, l) halves —
+        np.searchsorted on the structured KEY_DT dtype falls off numpy's
+        fast path (~4us per lookup, 37s for a 10M-row batch); the split
+        search is plain int64 bisection (~100x faster)."""
+        from .delta import find_in_view
+
+        n = int(self._skey_h.shape[0])
         hit = np.zeros(keys.shape[0], bool)
         rows = np.zeros(keys.shape[0], np.int64)
-        if len(self.skey):
-            found = self.skey[loc] == keys
-            rows = self.sorder[loc]
+        if n:
+            pos = find_in_view(
+                self._skey_h, self._skey_l,
+                np.ascontiguousarray(keys["h"]),
+                np.ascontiguousarray(keys["l"]),
+            )
+            found = pos >= 0
+            rows = self.sorder[np.clip(pos, 0, n - 1)]
             hit = found & self.live[rows]
         return hit, rows
 
@@ -186,9 +232,13 @@ class ColumnSegment:
         remapped = np.where(srel >= 0, slot_map[np.clip(srel, 0, None)], -1)
         self.srel1 = (remapped + 1).astype(np.int32)
         self.caveat = caveat_map[self.caveat]
-        keys = pack_keys(self.res, self.rel, self.subj, self.srel1)
-        self.sorder = np.argsort(keys, kind="stable")
-        self.skey = keys[self.sorder]
+        self.sorder = lexsort4(self.rel, self.res, self.subj, self.srel1)
+        self._skey_h = (
+            (self.rel.astype(np.int64) << 32) | self.res.astype(np.int64)
+        )[self.sorder]
+        self._skey_l = (
+            (self.subj.astype(np.int64) << 32) | self.srel1.astype(np.int64)
+        )[self.sorder]
 
 
 def relationships_to_columns(

@@ -45,6 +45,7 @@ from ..rel.relationship import Relationship
 from ..rel.txn import Txn
 from ..rel.update import Update, UpdateType
 from ..schema import CompiledSchema, compile_schema, parse_schema
+from ..native.sort import lexsort2, lexsort4
 from ..schema.compiler import SchemaValidationError
 from ..utils.errors import (
     AlreadyExistsError,
@@ -647,6 +648,154 @@ class Store:
                 cols, now_us, touch, describe=describe
             )
 
+    def import_interned_columns(
+        self,
+        *,
+        resource_ids,
+        resource_relation: str,
+        subject_ids,
+        subject_relation: str = "",
+        touch: bool = False,
+    ) -> str:
+        """Pre-interned columnar bulk import: node-id columns from THIS
+        store's interner (``export_interned_columns_at`` output, or
+        ``Interner.node_batch`` results), skipping ALL string work — no
+        hashing, no packing, no per-id Python.  Rows may mix resource
+        and subject types freely; validation runs once per distinct
+        (resource type, subject type, wildcardness) combination through
+        the same validator as the object path.  This is the 1B-edge
+        restore fast path (the reference's BulkImportRelationships
+        surface, client/client.go:438-465, at ~5x the string-columnar
+        rate).  Returns the minted revision; raises AlreadyExistsError
+        (nothing applied) on any live duplicate unless ``touch``."""
+        res = np.ascontiguousarray(resource_ids, dtype=np.int32)
+        subj = np.ascontiguousarray(subject_ids, dtype=np.int32)
+        B = int(res.shape[0])
+        if int(subj.shape[0]) != B:
+            raise ValueError("resource_ids and subject_ids lengths differ")
+        with self._lock:
+            compiled = self._require_schema()
+            now_us = self._now_us()
+            itn = self.interner
+            NN = len(itn)
+            if B:
+                if (
+                    int(res.min()) < 0 or int(res.max()) >= NN
+                    or int(subj.min()) < 0 or int(subj.max()) >= NN
+                ):
+                    raise ValueError(
+                        "node id out of range for this store's interner"
+                    )
+            slot_of = compiled.slot_of_name
+            if resource_relation not in slot_of:
+                raise SchemaValidationError(
+                    f"relation `{resource_relation}` not found in schema"
+                )
+            if subject_relation and subject_relation not in slot_of:
+                raise SchemaValidationError(
+                    f"relation `{subject_relation}` not found in schema"
+                )
+            if B:
+                nt = itn.node_type_array()
+                rt = nt[res].astype(np.int64)
+                st = nt[subj].astype(np.int64)
+                # wildcard subjects change the validation shape: detect
+                # them via the (few) interned wildcard node ids
+                from ..rel.relationship import WILDCARD_ID
+
+                wc_ids = np.asarray(
+                    [
+                        w for w in (
+                            itn.lookup(t, WILDCARD_ID)
+                            for t in compiled.type_ids
+                        ) if w >= 0
+                    ],
+                    np.int32,
+                )
+                wc = (
+                    np.isin(subj, wc_ids)
+                    if wc_ids.size else np.zeros(B, bool)
+                )
+                combos = np.unique(
+                    (rt << 21) | (st << 1) | wc, return_index=True
+                )[1]
+                for i in combos:
+                    rtype, rid = itn.key_of(int(res[i]))
+                    stype, sid = itn.key_of(int(subj[i]))
+                    compiled.validate_relationship(Relationship(
+                        resource_type=rtype, resource_id=rid,
+                        resource_relation=resource_relation,
+                        subject_type=stype, subject_id=sid,
+                        subject_relation=subject_relation,
+                    ))
+            if B == 0:
+                return RevisionToken(self._head_rev)
+            cols = {
+                "res": res,
+                "rel": np.full(B, slot_of[resource_relation], np.int32),
+                "subj": subj,
+                "srel1": np.full(
+                    B,
+                    slot_of[subject_relation] + 1 if subject_relation else 0,
+                    np.int32,
+                ),
+                "caveat": np.zeros(B, np.int32),
+                "ctx": np.full(B, -1, np.int32),
+                "exp_us": np.zeros(B, np.int64),
+            }
+
+            def describe(i: int) -> str:
+                rtype, rid = itn.key_of(int(res[i]))
+                stype, sid = itn.key_of(int(subj[i]))
+                srel = f"#{subject_relation}" if subject_relation else ""
+                return (
+                    f"{rtype}:{rid}#{resource_relation}"
+                    f"@{stype}:{sid}{srel}"
+                )
+
+            return self._commit_columns_locked(
+                cols, now_us, touch, describe=describe
+            )
+
+    def export_interned_columns_at(self, revision: str):
+        """Interned columnar export at an exact snapshot: yields chunk
+        dicts with int32 ``res``/``subj`` node-id columns plus decoded
+        ``resource_relation``/``subject_relation`` names — the zero-
+        string mirror of ``import_interned_columns`` for restore
+        pipelines that stay within this store's interner (the ids remain
+        valid across revisions: the interner is append-only)."""
+        snap = self.snapshot_for(Strategy(Requirement.SNAPSHOT, revision))
+        now_us = self._now_us()
+        live = (snap.e_exp_us == 0) | (snap.e_exp_us > now_us)
+        rows = np.nonzero(live)[0]
+        if rows.shape[0] == 0:
+            return
+        compiled = snap.compiled
+        name_of_slot = {s: n for n, s in compiled.slot_of_name.items()}
+        # one chunk per (relation, srel1) run keeps each chunk a single
+        # import_interned_columns call
+        rel_c = snap.e_rel[rows]
+        srel_c = snap.e_srel1[rows]
+        key = rel_c.astype(np.int64) * (snap.num_slots + 2) + srel_c
+        order = lexsort2(rel_c.astype(np.int32), srel_c.astype(np.int32))
+        rows = rows[order]
+        key = key[order]
+        starts = np.nonzero(
+            np.concatenate([[True], key[1:] != key[:-1]])
+        )[0]
+        ends = np.concatenate([starts[1:], [rows.shape[0]]])
+        for lo, hi in zip(starts, ends):
+            r0 = rows[lo]
+            yield {
+                "res": snap.e_res[rows[lo:hi]].astype(np.int32),
+                "subj": snap.e_subj[rows[lo:hi]].astype(np.int32),
+                "resource_relation": name_of_slot[int(snap.e_rel[r0])],
+                "subject_relation": (
+                    name_of_slot[int(snap.e_srel1[r0]) - 1]
+                    if int(snap.e_srel1[r0]) > 0 else ""
+                ),
+            }
+
     def _import_columnar_locked(
         self,
         batch: List[Relationship],
@@ -676,19 +825,36 @@ class Store:
         messages — the columnar API derives it from the columns, the
         object path from the batch."""
         B = int(cols["res"].shape[0])
-        keys = pack_keys(cols["res"], cols["rel"], cols["subj"], cols["srel1"])
-        order = np.argsort(keys, kind="stable")
-        skeys = keys[order]
-        dup = np.zeros(B, bool)
+        # stable native lexsort == argsort of the packed keys (both sort
+        # by (rel, res, subj, srel1); components are non-negative), ~10x
+        # faster at 10M rows on one core.  All masks below live in the
+        # SORTED domain (suffix _s) — batch-domain scatters at 10M rows
+        # cost ~0.7s per segment and are needed only once, for `keep`
+        order = lexsort4(
+            cols["rel"], cols["res"], cols["subj"], cols["srel1"]
+        )
+        sh = (
+            (cols["rel"].astype(np.int64) << 32)
+            | cols["res"].astype(np.int64)
+        )[order]
+        sl = (
+            (cols["subj"].astype(np.int64) << 32)
+            | cols["srel1"].astype(np.int64)
+        )[order]
+        dup_s = np.zeros(B, bool)
         if B > 1:
-            eq = skeys[1:] == skeys[:-1]
+            eq = (sh[1:] == sh[:-1]) & (sl[1:] == sl[:-1])
             if touch:
-                # TOUCH upsert: the LAST occurrence of a key wins
-                dup[order[:-1][eq]] = True
+                # TOUCH upsert: the LAST occurrence of a key wins (the
+                # sort is stable, so batch order == run order)
+                dup_s[:-1] = eq
             elif eq.any():
                 raise AlreadyExistsError(
-                    f"relationship already exists: {describe(int(order[1:][eq][0]))}"
+                    "relationship already exists: "
+                    f"{describe(int(order[1:][eq][0]))}"
                 )
+        dup = np.zeros(B, bool)
+        dup[order] = dup_s
         # existence vs the live dict: probe in whichever direction is
         # cheaper at runtime — the dict against the sorted batch keys
         # (O(live · log B)) when the dict is the smaller side, else the
@@ -752,10 +918,11 @@ class Store:
                     srel1 = 0
                 if rel_s is None:
                     continue
-                probe["h"] = (rel_s << 32) | res
-                probe["l"] = (int(subj) << 32) | srel1
-                pos = int(np.searchsorted(skeys, probe[0]))
-                if pos < B and skeys[pos] == probe[0]:
+                ph = (rel_s << 32) | res
+                pl = (int(subj) << 32) | srel1
+                pos = int(np.searchsorted(sh, ph, "left"))
+                pos += int(np.searchsorted(sl[pos:np.searchsorted(sh, ph, "right")], pl, "left"))
+                if pos < B and sh[pos] == ph and sl[pos] == pl:
                     if not touch:
                         raise AlreadyExistsError(
                             "relationship already exists: "
@@ -764,15 +931,19 @@ class Store:
                     dict_hits.append(key)
         seg_hits: List[Tuple[ColumnSegment, np.ndarray]] = []
         for seg in self._segments:
-            hit, rows = seg.rows_of_keys(keys)
-            hit &= ~dup
-            if hit.any():
-                live_rows = rows[hit]
+            # probe in SORTED batch order: one linear merge per segment,
+            # no batch-domain scatter (hits stay sorted-side)
+            hit_s, rows_s = seg.rows_of_sorted_halves(sh, sl)
+            hit_s &= ~dup_s
+            if hit_s.any():
+                live_rows = rows_s[hit_s]
                 exp = seg.exp_us[live_rows]
                 alive = (exp == 0) | (exp > now_us)
                 if alive.any():
                     if not touch:
-                        first = int(np.nonzero(hit)[0][int(np.argmax(alive))])
+                        first = int(
+                            order[np.nonzero(hit_s)[0][int(np.argmax(alive))]]
+                        )
                         raise AlreadyExistsError(
                             f"relationship already exists: {describe(first)}"
                         )
@@ -786,11 +957,20 @@ class Store:
         for seg, rows in seg_hits:
             seg.live[rows] = False
         keep = ~dup
+        # reuse the batch's sorted order for the segment sidecar: kept
+        # rows keep their relative order, so filtering the sorted view
+        # and remapping positions avoids a second 10M-row sort
+        kept_sorted = ~dup_s
+        remap = np.cumsum(keep) - 1
         seg = ColumnSegment(
             res=cols["res"][keep], rel=cols["rel"][keep],
             subj=cols["subj"][keep], srel1=cols["srel1"][keep],
             caveat=cols["caveat"][keep], ctx=cols["ctx"][keep],
             exp_us=cols["exp_us"][keep],
+            presorted=(
+                remap[order[kept_sorted]],
+                sh[kept_sorted], sl[kept_sorted],
+            ),
         )
         self._segments.append(seg)
         utype = UpdateType.TOUCH if touch else UpdateType.CREATE

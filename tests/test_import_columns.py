@@ -223,3 +223,134 @@ def test_columnar_export_round_trips_with_import():
     import gochugaru_tpu.consistency as cons
     assert c2.check_one(background(), cons.full(),
                         rel.must_from_triple("doc:d5", "read", "user:u5"))
+
+
+MIXED = """
+definition user {}
+definition team { relation member: user }
+definition doc {
+    relation reader: user | user:* | team#member
+    permission read = reader
+}
+definition folder {
+    relation owner: user
+}
+"""
+
+
+def test_interned_import_roundtrip_and_check():
+    import numpy as np
+
+    c = Client()
+    ctx = background()
+    c.write_schema(ctx, MIXED)
+    st = c._store
+    itn = st.interner
+    docs = itn.node_batch("doc", [f"d{i}" for i in range(50)])
+    users = itn.node_batch("user", [f"u{i}" for i in range(10)])
+    c.import_relationship_id_columns(
+        ctx,
+        resource_ids=np.repeat(docs, 2),
+        resource_relation="reader",
+        subject_ids=np.tile(users[:2], 50),
+    )
+    cs = consistency.full()
+    assert c.check_one(ctx, cs, rel.must_from_triple("doc:d7", "read", "user:u0"))
+    assert not c.check_one(ctx, cs, rel.must_from_triple("doc:d7", "read", "user:u5"))
+    rev = c.read_schema(ctx)[1]
+    chunks = list(c.export_relationship_id_columns(ctx, rev))
+    total = sum(ch["res"].shape[0] for ch in chunks)
+    assert total == 100
+    assert all(ch["resource_relation"] == "reader" for ch in chunks)
+
+    # restore into the same store via TOUCH fallback: no-op but succeeds
+    for ch in chunks:
+        c.import_relationship_id_columns(
+            ctx,
+            resource_ids=ch["res"], resource_relation=ch["resource_relation"],
+            subject_ids=ch["subj"], subject_relation=ch["subject_relation"],
+        )
+    assert c.check_one(ctx, cs, rel.must_from_triple("doc:d7", "read", "user:u0"))
+
+
+def test_interned_import_mixed_types_and_usersets():
+    import numpy as np
+
+    c = Client()
+    ctx = background()
+    c.write_schema(ctx, MIXED)
+    itn = c._store.interner
+    d = itn.node_batch("doc", ["a", "b"])
+    t = itn.node_batch("team", ["eng"])
+    u = itn.node_batch("user", ["x", "y"])
+    # team membership, then userset + wildcard subjects in ONE call
+    c.import_relationship_id_columns(
+        ctx, resource_ids=t, resource_relation="member", subject_ids=u[:1],
+    )
+    wc = itn.node("user", "*")
+    # userset subjects (team#member) and a wildcard row, one call each
+    c.import_relationship_id_columns(
+        ctx, resource_ids=d[:1], resource_relation="reader",
+        subject_ids=t, subject_relation="member",
+    )
+    c.import_relationship_id_columns(
+        ctx, resource_ids=d[1:], resource_relation="reader",
+        subject_ids=np.array([wc], np.int32),
+    )
+    cs = consistency.full()
+    # x reads doc:a via team#member; everyone reads doc:b via wildcard
+    assert c.check_one(ctx, cs, rel.must_from_triple("doc:a", "read", "user:x"))
+    assert not c.check_one(ctx, cs, rel.must_from_triple("doc:a", "read", "user:y"))
+    assert c.check_one(ctx, cs, rel.must_from_triple("doc:b", "read", "user:y"))
+
+
+def test_interned_import_validation_errors():
+    import numpy as np
+
+    from gochugaru_tpu.schema.compiler import SchemaValidationError
+
+    c = Client()
+    ctx = background()
+    c.write_schema(ctx, MIXED)
+    itn = c._store.interner
+    d = itn.node_batch("doc", ["a"])
+    f = itn.node_batch("folder", ["f1"])
+    u = itn.node_batch("user", ["x"])
+    t = itn.node_batch("team", ["eng"])
+    # folder as subject of doc#reader: not allowed
+    with pytest.raises(SchemaValidationError):
+        c.import_relationship_id_columns(
+            ctx, resource_ids=d, resource_relation="reader", subject_ids=f,
+        )
+    # team as DIRECT subject (needs #member)
+    with pytest.raises(SchemaValidationError):
+        c.import_relationship_id_columns(
+            ctx, resource_ids=d, resource_relation="reader", subject_ids=t,
+        )
+    # userset form allowed
+    c.import_relationship_id_columns(
+        ctx, resource_ids=d, resource_relation="reader",
+        subject_ids=t, subject_relation="member",
+    )
+    # permission target rejected
+    with pytest.raises(SchemaValidationError):
+        c.import_relationship_id_columns(
+            ctx, resource_ids=d, resource_relation="read", subject_ids=u,
+        )
+    # out-of-range id
+    with pytest.raises(ValueError):
+        c.import_relationship_id_columns(
+            ctx, resource_ids=np.array([99999], np.int32),
+            resource_relation="reader", subject_ids=u,
+        )
+    # wildcard allowed on doc.reader (user:*), forbidden on team.member
+    wc = itn.node("user", "*")
+    c.import_relationship_id_columns(
+        ctx, resource_ids=d, resource_relation="reader",
+        subject_ids=np.array([wc], np.int32),
+    )
+    with pytest.raises(SchemaValidationError):
+        c.import_relationship_id_columns(
+            ctx, resource_ids=t, resource_relation="member",
+            subject_ids=np.array([wc], np.int32),
+        )
