@@ -30,7 +30,25 @@ The dryrun (driver hook: ``__graft_entry__.dryrun_multichip``'s
 multi-process mode) runs this file as a module in N spawned processes on
 the CPU backend (the moral equivalent of serve-testing, SURVEY.md §4)
 and verifies every process's local result shards against the host
-oracle.
+oracle.  Both 2-process (4 devices each) and 4-process (2 devices each)
+splits are exercised by tests/test_multihost.py.
+
+Measured per-dispatch collective accounting (StableHLO lowering of the
+shard_mapped flat kernel on the virtual 8-device mesh, feature schema
+with walked userset/arrow/exclusion sites — r05):
+
+- every collective is an ``all_reduce`` whose replica groups span ONLY
+  the model axis (e.g. ``[[0,1],[2,3],[4,5],[6,7]]`` on a 4x2 mesh):
+  the per-probe psum-OR / single-owner broadcasts stay within a data
+  row, i.e. on ICI when the model axis is laid out within a slice;
+- count: 17 reduces/dispatch on the feature schema (one per walked
+  probe site); a fully folded schema (config-2 shape) drops to 6;
+- payload: int32[B/data] per reduce -> 17 B per query per dispatch
+  crossing ICI, independent of batch size (measured identical at
+  B=8192 and B=131072);
+- NOTHING crosses the data axis inside the kernel: the DCN-analogue
+  boundary carries only the packed query matrix in (32 B/query) and
+  the three result planes out (3 B/query) per dispatch.
 """
 
 from __future__ import annotations

@@ -16,3 +16,11 @@ def test_two_process_dryrun():
     # jax.distributed; every process verifies its addressable result
     # shards and the parent asserts full batch coverage
     dryrun_multihost(n_processes=2, n_devices=8)
+
+
+def test_four_process_dryrun():
+    # 4 CPU processes x 2 virtual devices each over one 8-device global
+    # mesh: the v5e-16 two-slice shape's process count, halved devices
+    # (VERDICT r04 item 9).  Every process must verify its shard rows
+    # against the host oracle.
+    dryrun_multihost(n_processes=4, n_devices=8, timeout_s=900)
