@@ -1,10 +1,11 @@
 #!/bin/bash
 # TPU tunnel watcher (round 5). Loops until killed: probe the axon tunnel;
-# if alive, immediately run the bench TPU child (it emits a JSON line per
-# batch size, so even a mid-ramp kill leaves a real number on stdout).
-# After a run that actually produced a JSON line it keeps probing (a later
-# window can still improve the number) but backs off to 15-min cycles.
-# Stop with: pkill -f tpu_watch
+# if alive, run the bench ladder — config 2 (bench.py child, aligned-table
+# kernel), config 1 founders p99, config 3 docs — each bounded, each
+# emitting JSON per stage so a mid-window kill still leaves numbers.
+# After a run that produced a JSON line it keeps probing (a later window
+# can still improve the number) but backs off to 15-min cycles.
+# Stop with: pkill -f "bash tpu_watch"
 cd /root/repo || exit 1
 mkdir -p tpu_attempts
 log() { echo "[$(date +%H:%M:%S)] $*" >> tpu_attempts/log.txt; }
@@ -19,16 +20,21 @@ attempt=0
 while true; do
   attempt=$((attempt + 1))
   if probe; then
-    log "probe OK — running TPU bench child"
+    log "probe OK — running TPU bench ladder"
     TS=$(date +%H%M%S)
-    timeout 420 python bench.py --child tpu \
+    timeout 560 python bench.py --child tpu \
       > "tpu_attempts/bench_${TS}.out" 2> "tpu_attempts/bench_${TS}.err"
-    rc=$?
-    log "bench child rc=$rc → tpu_attempts/bench_${TS}.out"
+    log "config2 child rc=$? → tpu_attempts/bench_${TS}.out"
     if grep -q '^{' "tpu_attempts/bench_${TS}.out"; then
-      # a real JSON line landed: signal + slow down, don't hammer the chip
       touch tpu_attempts/TPU_CONTACT
       SLEEP=900
+      # window is live: harvest more configs while it lasts
+      timeout 420 python benchmarks/bench1_founders.py \
+        > "tpu_attempts/b1_${TS}.out" 2> "tpu_attempts/b1_${TS}.err"
+      log "config1 rc=$?"
+      timeout 900 python benchmarks/bench3_docs.py \
+        > "tpu_attempts/b3_${TS}.out" 2> "tpu_attempts/b3_${TS}.err"
+      log "config3 rc=$?"
     fi
   else
     log "probe FAIL (attempt ${attempt})"
