@@ -351,16 +351,19 @@ class AlignedIndex:
 
 
 def _aligned_fill(
-    h: np.ndarray, cols: Sequence[np.ndarray], size: int, cap: int
+    h: np.ndarray, cols: Sequence[np.ndarray], size: int, cap: int,
+    counts: Optional[np.ndarray] = None,
 ):
     """Place entries into an int32[size, cap*w] matrix; returns
     (tbl, leftover_row_indices) where leftover rows did not fit their
-    bucket's ``cap`` slots."""
+    bucket's ``cap`` slots.  ``counts`` (bincount of ``h``) is reused
+    when the caller already computed it."""
     w = len(cols)
     n = int(h.shape[0])
     order = np.argsort(h, kind="stable")
     hs = h[order]
-    counts = np.bincount(hs, minlength=size)
+    if counts is None:
+        counts = np.bincount(hs, minlength=size)
     off = np.zeros(size, np.int64)
     np.cumsum(counts[:-1], out=off[1:])
     rank = np.arange(n, dtype=np.int64) - off[hs]
@@ -401,7 +404,25 @@ def build_aligned(
     if max_bytes is not None and size * target_cap * w * 4 > max_bytes:
         return None
     h = (h_full & np.uint32(size - 1)).astype(np.int64)
-    tbl, left = _aligned_fill(h, cols, size, target_cap)
+    # probe cost is LATENCY-bound on TPU (one ~64-256B row fetch per
+    # level), so a somewhat wider primary row that holds ~all entries in
+    # ONE gather beats primary+spill's two.  Widen to the smallest cap
+    # covering 99.9% of ENTRIES, bounded to 3x target_cap — a single hot
+    # key (or the deepest Poisson bucket) must never set the whole
+    # table's row width; whatever still overflows takes the spill level
+    counts = np.bincount(h, minlength=size)
+    cap_need = int(counts.max())
+    if cap_need > target_cap:
+        hist = np.bincount(np.minimum(counts, cap_need))
+        ge = np.cumsum(hist[::-1])[::-1]  # ge[j] = #buckets with count>=j
+        coverage = np.cumsum(ge[1:])  # coverage[c-1] = entries held at cap c
+        bound = min(spill_max_cap, 3 * target_cap, cap_need)
+        c = target_cap
+        while c < bound and coverage[c - 1] < 0.999 * n:
+            c += 1
+        if max_bytes is None or size * c * w * 4 <= max_bytes:
+            target_cap = c
+    tbl, left = _aligned_fill(h, cols, size, target_cap, counts=counts)
     spill = None
     spill_cap = 0
     if left.shape[0]:

@@ -5,7 +5,7 @@
 # emitting JSON per stage so a mid-window kill still leaves numbers.
 # After a run that produced a JSON line it keeps probing (a later window
 # can still improve the number) but backs off to 15-min cycles.
-# Stop with: pkill -f "bash tpu_watch"
+# Stop with: pkill -f 'tpu_watch\.sh'
 cd /root/repo || exit 1
 mkdir -p tpu_attempts
 log() { echo "[$(date +%H:%M:%S)] $*" >> tpu_attempts/log.txt; }
