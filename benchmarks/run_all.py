@@ -105,6 +105,15 @@ def main() -> int:
          + (["--edges", "1000000"] if q else ["--edges", "10000000"]),
          2400),
     ]
+    if not q:
+        # Leopard-scale CPU proxy (VERDICT r04 item 3): the same Watch
+        # re-index loop at a 100M-edge base — BASELINE config 5's
+        # per-chip slice of the 1B / v5e-16 deployment
+        configs.insert(5, (
+            "5b — Watch re-index, 100M-edge base (Leopard-scale proxy)",
+            [py, "benchmarks/bench5_watch.py", "--edges", "100000000"],
+            7200,
+        ))
     if q:
         configs[2] = (
             "3 — Google-Docs nested groups (quick, 5% scale)",
