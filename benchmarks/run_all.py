@@ -91,7 +91,7 @@ def main() -> int:
         ("2 — GitHub RBAC 2-hop, 100k batch (driver headline)",
          [py, "bench.py"], 700),
         ("3 — Google-Docs nested groups, 1M docs / 10M edges, 5-hop",
-         [py, "benchmarks/bench3_docs.py"], 1500),
+         [py, "benchmarks/bench3_docs.py"], 2400),
         ("4 — multi-tenant caveats" + (" (quick)" if q else ", 100M edges"),
          [py, "benchmarks/bench4_caveats.py"]
          + (["--edges", "2000000"] if q else ["--edges", "100000000"]),
