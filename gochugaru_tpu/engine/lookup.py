@@ -143,16 +143,19 @@ def lookup_index(snap: Snapshot, mark_used: bool = True) -> LookupIndex:
         if idx is not None:
             return idx
         # chain-advance fast path: materializing a chained LSM snapshot
-        # whose BASE carries an index advances that index as part of the
+        # whose BASE carries a LIVE index advances it as part of the
         # merge (store/delta.py _materialize_locked) in O(E + D log E)
-        # identity merges — force the (lazy) materialization and pick
-        # the advanced index up, instead of paying the O(E log E)
-        # rebuild.  This is the warm path of the Watch re-index loop
+        # identity merges; an UNUSED (prewarm-only) index is not paid
+        # for per revision — the merge stashes the O(D) advance inputs
+        # and the first real lookup advances from the stash here.
+        # Either way the O(E log E) rebuild is skipped
         if getattr(snap, "_lsm_base", None) is not None:
             snap._materialize()
         idx = getattr(snap, "_lookup_index", None)
         if idx is not None:  # the materialization advanced it
             return idx
+        if redeem_chain_stash(snap):
+            return snap._lookup_index
         return _build_lookup_index(snap)
 
 
@@ -545,7 +548,7 @@ def lookup_subjects_device(
 # ---------------------------------------------------------------------------
 
 
-def _view_keys(idx: "LookupIndex", prev: Snapshot):
+def _view_keys(idx: "LookupIndex", ra_rel_src: Optional[Snapshot]):
     """Packed (k1, k2) int64 key arrays per transposed view, cached on
     the index — advancing then never re-packs or re-casts the O(E)
     columns, only merges them forward (the cache rides to the advanced
@@ -567,15 +570,38 @@ def _view_keys(idx: "LookupIndex", prev: Snapshot):
     if "_ra_k1" not in d:
         d["_ra_k1"] = idx.ra_child.astype(np.int64)
     if "_ra_k2" not in d:
-        ra_rel = _ra_rel_of(prev, idx)
+        ra_rel = _ra_rel_of(ra_rel_src, idx)
         d["_ra_k2"] = ra_rel.astype(np.int64) * _B32 + idx.ra_res
     return d
 
 
+def redeem_chain_stash(snap: Snapshot) -> bool:
+    """Consume a deferred chain-advance stash on ``snap`` (written by
+    store/delta.py _materialize_locked when the base's index was unused):
+    one identity advance produces ``snap._lookup_index``.  Returns True
+    when a stash was redeemed."""
+    stash = snap.__dict__.pop("_lookup_chain_stash", None)
+    if stash is None:
+        return False
+    (bidx, g_rel, g_res, g_subj, g_srel1,
+     a_rel, a_res, a_subj, a_srel1) = stash
+    advance_lookup_index(
+        bidx, snap,
+        num_slots=snap.num_slots,
+        tupleset_slots=snap.compiled.tupleset_slots,
+        g_rel=g_rel, g_res=g_res, g_subj=g_subj, g_srel1=g_srel1,
+        a_rel=a_rel, a_res=a_res, a_subj=a_subj, a_srel1=a_srel1,
+    )
+    return True
+
+
 def advance_lookup_index(
-    prev: Snapshot,
+    idx: "LookupIndex",
     nxt: Snapshot,
     *,
+    num_slots: int,
+    tupleset_slots,
+    ra_rel_src: Optional[Snapshot] = None,
     g_rel: np.ndarray,
     g_res: np.ndarray,
     g_subj: np.ndarray,
@@ -593,12 +619,16 @@ def advance_lookup_index(
     and _materialize_locked calls it when a chained snapshot merges, with
     the base's accumulated tombstones + overlay (store/delta.py).  The
     packed per-view key arrays are cached on the index and merged
-    forward (_view_keys), so repeated advances pay only array copies."""
+    forward (_view_keys), so repeated advances pay only array copies.
+
+    ``idx`` is the index being advanced; ``ra_rel_src`` is the snapshot
+    whose ar view recovers the index's ra-rel column on a cache miss —
+    None is fine when ``idx`` already carries ``_ra_rel`` (the stash
+    path pre-caches it)."""
     from ..store.delta import find_in_view, merge_positions
 
-    idx: LookupIndex = prev._lookup_index
-    keys = _view_keys(idx, prev)
-    NS1 = np.int64(prev.num_slots + 1)
+    keys = _view_keys(idx, ra_rel_src)
+    NS1 = np.int64(num_slots + 1)
     g_rel = g_rel.astype(np.int64)
     g_res = g_res.astype(np.int64)
     g_subj = g_subj.astype(np.int64)
@@ -657,10 +687,10 @@ def advance_lookup_index(
 
     # ra view: arrow rows only (tupleset relation, direct subject), keyed
     # child node; residual order (rel, res)
-    ts = np.asarray(sorted(prev.compiled.tupleset_slots), np.int64)
+    ts = np.asarray(sorted(tupleset_slots), np.int64)
     g_ar = np.isin(g_rel, ts) & (g_srel1 == 0)
     a_ar = np.isin(a_rel, ts) & (a_srel1 == 0)
-    prev_ra_rel = _ra_rel_of(prev, idx)
+    prev_ra_rel = _ra_rel_of(ra_rel_src, idx)
     ra_k1, ra_k2, (ra_res, ra_rel) = advance_view(
         keys["_ra_k1"], keys["_ra_k2"],
         (idx.ra_res, prev_ra_rel),
@@ -697,12 +727,15 @@ def advance_lookup_index(
     nxt._lookup_index = new_idx
 
 
-def _ra_rel_of(snap: Snapshot, idx: LookupIndex) -> np.ndarray:
+def _ra_rel_of(snap: Optional[Snapshot], idx: LookupIndex) -> np.ndarray:
     """rel column of the ra view (child-sorted arrow rows), recovered from
-    the snapshot's ar view once and cached on the index."""
+    the snapshot's ar view once and cached on the index.  ``snap`` may be
+    None only when the cache is already populated (the stash path
+    pre-caches before the source snapshot's chain state is dropped)."""
     cached = getattr(idx, "_ra_rel", None)
     if cached is not None:
         return cached
+    assert snap is not None, "ra-rel cache miss with no source snapshot"
     ra_order = argsort1(snap.ar_child)
     rel = snap.ar_rel[ra_order].astype(np.int64)
     idx._ra_rel = rel
