@@ -143,6 +143,15 @@ def main() -> None:
     note(f"edges={snap.num_edges} nodes={snap.num_nodes}")
     engine = DeviceEngine(cs)
     dsnap = engine.prepare(snap)
+    # measurement hygiene: prepare spawns the lookup-prewarm thread
+    # (engine/device.py); on the ONE-core host its O(E log E) build
+    # steals ~half the core from the first seconds of the throughput
+    # window — join it (bounded) before timing anything
+    import threading
+
+    for t in threading.enumerate():
+        if t.name == "gochugaru-lookup-prewarm":
+            t.join(timeout=300)
 
     rng = np.random.default_rng(7)
     B = 1 << (BATCH - 1).bit_length()
