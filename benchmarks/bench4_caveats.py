@@ -190,7 +190,7 @@ def main() -> None:
     # whole-request rate must hold
     import time as _t
 
-    PB = engine.config.flat_pipeline_batch
+    PB = engine._pipeline_batch() or 32_768
     def pipelined_once():
         lats = []
         t_start = _t.perf_counter()
@@ -198,7 +198,7 @@ def main() -> None:
         n = 0
         for lo, hi, d2, p2, o2 in engine.check_columns_pipelined(
             dsnap, q_res, q_perm, q_subj,
-            q_ctx=q_ctx, qctx_rows=qctx_rows, now_us=EPOCH,
+            q_ctx=q_ctx, qctx_rows=qctx_rows, now_us=EPOCH, sub_batch=PB,
         ):
             t_now = _t.perf_counter()
             lats.append((t_now - t_prev) * 1000)
