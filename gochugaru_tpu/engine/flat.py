@@ -721,7 +721,14 @@ def _fold_packed(fr, cl, snap, maps: SlotMaps, N: int, config: EngineConfig):
     from ..store.closure import NO_EXP
     from .fold import fold_tindex_join
 
-    tj2 = fold_tindex_join(fr, cl, N, maps, config.flat_fold_tindex_factor)
+    max_rows = config.flat_fold_tindex_max_rows
+    if max_rows is None:
+        from .plan import FOLD_TINDEX_AUTO_MAX_ROWS
+
+        max_rows = FOLD_TINDEX_AUTO_MAX_ROWS
+    tj2 = fold_tindex_join(
+        fr, cl, N, maps, config.flat_fold_tindex_factor, max_rows=max_rows
+    )
     if tj2 is None:
         return None
     S1_raw = snap.num_slots + 1

@@ -916,7 +916,9 @@ def t_join_core(
 
 
 def fold_tindex_join(fr: FoldResult, cl, N: int, maps,
-                     factor: int) -> Optional[Tuple[np.ndarray, ...]]:
+                     factor: int,
+                     max_rows: Optional[int] = None,
+                     ) -> Optional[Tuple[np.ndarray, ...]]:
     """pf_t: folded userset rows ⋈ closure-by-target, plus the direct
     group-identity entries — the T-index join over the FOLDED rows,
     packed with the DENSE radices (``maps`` is flat.SlotMaps).  Returns
@@ -940,7 +942,10 @@ def fold_tindex_join(fr: FoldResult, cl, N: int, maps,
     cl_k2 = (
         cl.c_g.astype(np.int64) * S1 + maps.k2[cl.c_grel] + 1
     ).astype(np.int32)
+    budget = factor * max(int(pe.shape[0]), 1024)
+    if max_rows is not None:
+        budget = min(budget, max_rows)
     return t_join_core(
         k1, pe, fr.u_until, cl_k1, cl_k2, cl.c_d_until, cl.c_p_until,
-        factor * max(int(pe.shape[0]), 1024),
+        budget,
     )

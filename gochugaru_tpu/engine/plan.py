@@ -30,6 +30,11 @@ from ..schema.ast import (
 )
 from ..schema.compiler import CompiledSchema, _expr_refs
 
+#: auto value for EngineConfig.flat_fold_tindex_max_rows (see its
+#: sizing note); one definition so the config doc and the resolver
+#: cannot drift
+FOLD_TINDEX_AUTO_MAX_ROWS = 320_000_000
+
 # Expression IR: nested tuples, all leaves static ints.
 #   ("ref", slot) ("arrow", ts_idx, right_slot) ("union", (c...))
 #   ("inter", (c...)) ("excl", base, sub) ("nil",)
@@ -107,6 +112,16 @@ class EngineConfig:
     #: silently rejected, throwing away the whole fold and the ~2x
     #: kernel collapse that comes with it
     flat_fold_tindex_factor: int = 256
+    #: ABSOLUTE row cap on the fold's T join, on top of the factor —
+    #: a guard against runaway joins (an over-budget join drops the
+    #: whole fold, and the walked path is far slower than even a
+    #: cache-hostile fold: config 3 measured fold-on 65ms/step vs
+    #: fold-off 914ms at 10M edges).  None = auto
+    #: (FOLD_TINDEX_AUTO_MAX_ROWS).  Sizing note: the final T table is
+    #: 16 B/row, but t_join_core's transient build peak (index arrays,
+    #: lexsort permutation, reindexed copies) is ~3x that — the auto
+    #: cap of 320M rows bounds the transient at ~15GB
+    flat_fold_tindex_max_rows: Optional[int] = None
     #: incremental fold maintenance (engine/fold.py fold_delta_update):
     #: max total dirty resources per delta chain.  Past it the chain
     #: DOWNGRADES folded pairs to their walked programs (sticky pf_off
