@@ -18,7 +18,7 @@ Robustness contract (the driver runs this unattended):
   child (TimeoutExpired.stdout) and keeps the best parsed line;
 - every stage is stamped on stderr (world/prepare/compile/measure), so a
   timeout names the stage it died in;
-- a persistent XLA compile cache (/tmp/gochugaru_xla_cache) makes attempt
+- a persistent XLA compile cache (/tmp/gochugaru_xla_cache_h2) makes attempt
   2 reuse attempt 1's compilation;
 - if the TPU backend is unusable, attempt 2 reruns degraded on CPU with a
   note; last resort emits value 0.  Always exits 0 with a parseable line.
@@ -236,7 +236,7 @@ def measure_true_rate(engine, dsnap, B, q_perm, args):
 def run_bench(batches, world_kw, budget_s, note=None):
     import jax
 
-    jax.config.update("jax_compilation_cache_dir", "/tmp/gochugaru_xla_cache")
+    jax.config.update("jax_compilation_cache_dir", "/tmp/gochugaru_xla_cache_h2")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     from gochugaru_tpu.engine.device import DeviceEngine
 

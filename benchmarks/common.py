@@ -260,6 +260,6 @@ def maybe_force_cpu() -> str:
         force_cpu_platform()
     import jax
 
-    jax.config.update("jax_compilation_cache_dir", "/tmp/gochugaru_xla_cache")
+    jax.config.update("jax_compilation_cache_dir", "/tmp/gochugaru_xla_cache_h2")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     return jax.default_backend()

@@ -18,7 +18,7 @@ if os.environ.get("GOCHUGARU_TEST_TPU") != "1":
 # buckets) hit disk instead of recompiling across test runs
 import jax
 
-jax.config.update("jax_compilation_cache_dir", "/tmp/gochugaru_xla_cache")
+jax.config.update("jax_compilation_cache_dir", "/tmp/gochugaru_xla_cache_h2")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 # GOCHUGARU_FLAT_ALIGNED=1 runs the whole suite under the bucket-ALIGNED
