@@ -442,3 +442,50 @@ def test_lookup_index_advances_through_lsm_chain(monkeypatch):
         assert got == want, f"{u}: {got} != {want}"
     # the advanced index landed on the tip snapshot
     assert getattr(cur, "_lookup_index", None) is not None
+
+
+def test_stash_redeems_across_chain_hops(monkeypatch):
+    """A mid-chain materialization while the index is still UNUSED
+    stashes the O(D) advance inputs; when a NEW chain hops off that
+    materialized tip and a lookup finally happens, the lineage redeems
+    (base stash first, then the new chain's carry) — never a full
+    rebuild (store/delta.py _materialize_locked carry block)."""
+    from gochugaru_tpu.engine import lookup as lookup_mod
+    from gochugaru_tpu.store.delta import apply_delta
+
+    rels, users, teams, orgs, repos = rbac_world()
+    cs, engine, dsnap, oracle = world(RBAC, rels)
+    snap = dsnap.snapshot
+    lookup_mod.lookup_index(snap, mark_used=False)  # prewarm-style
+    cur_rels = list(rels)
+
+    # chain 1: two deferred revisions, then force a materialization
+    # WITHOUT any lookup (an export does this)
+    adds1 = [rel.must_from_tuple("repo:r0#reader", "user:u19")]
+    r2 = apply_delta(snap, 2, adds1, [], interner=snap.interner, defer=True)
+    adds2 = [rel.must_from_tuple("repo:r1#reader", "user:u18")]
+    r3 = apply_delta(r2, 3, adds2, [], interner=snap.interner, defer=True)
+    cur_rels += adds1 + adds2
+    _ = r3.e_rel  # lazy materialize; index unused -> stash, not advance
+    assert getattr(r3, "_lookup_index", None) is None
+    assert r3.__dict__.get("_lookup_chain_stash") is not None
+
+    # chain 2 hops off the materialized, stash-carrying tip
+    adds3 = [rel.must_from_tuple("repo:r2#reader", "user:u17")]
+    r4 = apply_delta(r3, 4, adds3, [], interner=snap.interner, defer=True)
+    cur_rels += adds3
+
+    def _no_rebuild(s):
+        raise AssertionError("full rebuild despite stash lineage")
+
+    monkeypatch.setattr(lookup_mod, "_build_lookup_index", _no_rebuild)
+    oracle2 = Oracle(cs, cur_rels, {}, now_us=NOW)
+    ds4 = engine.prepare(r4, prev=dsnap)
+    for u in ("user:u19", "user:u18", "user:u17"):
+        got = lookup_resources_device(
+            engine, ds4, "repo", "read", "user", u.split(":")[1], "",
+            now_us=NOW, oracle_factory=lambda: oracle2,
+        )
+        want = sorted(oracle2.lookup_resources(
+            "repo", "read", "user", u.split(":")[1], ""))
+        assert got == want, f"{u}: {got} != {want}"
