@@ -1,35 +1,41 @@
 """Benchmark: BASELINE config 2 — GitHub-style RBAC, 10k repos x 1k users,
 2-hop org→team→repo rewrites, 100k-check batches on one chip.
 
-Prints ONE JSON line:
+Prints one JSON line per metric (headline first):
   {"metric": ..., "value": N, "unit": "checks/sec/chip", "vs_baseline": N,
    "p99_ms": N, "batch": N, "edges": N[, "note": ...]}
 
 ``vs_baseline`` is the fraction of the BASELINE.json north-star target
 (10M checks/sec/chip); the reference itself publishes no numbers
 (BASELINE.md), so the target is the denominator.  ``p99_ms`` is the p99
-batch-evaluation latency (north star: p99 < 2 ms, BASELINE.md:22).
+batch-evaluation latency (north star: p99 < 2 ms, BASELINE.md:22); the
+``rbac_2hop_small_batch_p99_latency`` row measures it the way a serving
+path would — a warm B=1024 latency-mode dispatch (engine/latency.py)
+with its host/H2D/kernel/D2H budget on the row.
+
+Honesty contract: ``value``/``vs_baseline`` are the repeat-harness TRUE
+wall-clock rate (N whole-batch evaluations inside one dispatch,
+t(2K)-t(K) — nothing overlapped, nothing amortized away); the pipelined
+rate (back-to-back queued dispatches) rides along as the secondary
+``pipelined_rate`` field.  While the true rate for a batch is still
+being measured, a provisional line carries ``rate_basis:
+"blocked-dispatch"`` (median individually-blocked dispatch — also
+honest wall clock, slightly pessimistic); the final line for the batch
+carries ``rate_basis: "repeat-harness"`` and supersedes it.
 
 Robustness contract (the driver runs this unattended):
 - the parent NEVER imports jax; children run under bounded timeouts;
 - the TPU child BATCH-RAMPS (8192 → 32768 → 131072) and emits a JSON line
   after EVERY batch size, so even a timeout mid-ramp leaves a real TPU
   number on stdout — the parent salvages partial stdout from a killed
-  child (TimeoutExpired.stdout) and keeps the best parsed line;
+  child (TimeoutExpired.stdout) and keeps the best parsed line per
+  metric;
 - every stage is stamped on stderr (world/prepare/compile/measure), so a
   timeout names the stage it died in;
 - a persistent XLA compile cache (/tmp/gochugaru_xla_cache_h2) makes attempt
   2 reuse attempt 1's compilation;
 - if the TPU backend is unusable, attempt 2 reruns degraded on CPU with a
   note; last resort emits value 0.  Always exits 0 with a parseable line.
-
-Methodology (child): the graph is materialized once through the columnar
-bulk path; queries are lowered to padded int32 device arrays once per
-batch size; throughput is the PIPELINED rate (N back-to-back dispatches of
-the jitted flat kernel, blocked at the end) — the steady-state rate a
-loaded service sees; p99 is per-dispatch blocked latency with a
-same-signature null program's round-trip subtracted (remote-attached TPUs
-pay a fixed tunnel cost per dispatch that is not evaluation time).
 """
 
 import json
@@ -136,12 +142,14 @@ def _flat_args(engine, dsnap, snap, q_res, q_perm, q_subj):
     return got
 
 
-def measure_batch(engine, dsnap, snap, users, repos, slot, B, note,
-                  true_rate=False):
-    """Compile + measure one batch size; returns the result dict.  With
-    ``true_rate``, also measure the repeat-harness rate (N evaluations
-    inside ONE dispatch, t(2K)-t(K) — the tunnel-amortized number the
-    round-2 verdict measured by hand)."""
+def measure_batch(engine, dsnap, snap, users, repos, slot, B, note):
+    """Compile + measure one batch size; returns (result dict,
+    (q_perm, args) for the repeat-harness pass).  ``value`` in the
+    returned dict is the PROVISIONAL honest rate — the median
+    individually-blocked dispatch (no overlap) — which run_bench
+    upgrades to the repeat-harness true rate; the pipelined
+    (overlapped-dispatch) rate rides as the secondary
+    ``pipelined_rate`` field."""
     import numpy as np
     import jax
 
@@ -167,14 +175,14 @@ def measure_batch(engine, dsnap, snap, users, repos, slot, B, note,
     # pipelined throughput: N back-to-back dispatches, blocked at the end
     stage(f"measuring pipelined rate B={B}")
     reps = 4 if B >= 100_000 else 8
-    best_rate = 0.0
+    pipelined_rate = 0.0
     for _ in range(2):
         t0 = time.time()
         for _ in range(reps):
             out = fn(*args)
         jax.block_until_ready(out)
         dt = time.time() - t0
-        best_rate = max(best_rate, reps * B / dt)
+        pipelined_rate = max(pipelined_rate, reps * B / dt)
 
     # p99 evaluation latency: blocked per-dispatch timings minus the fixed
     # dispatch round trip of a same-signature null program
@@ -197,14 +205,18 @@ def measure_batch(engine, dsnap, snap, users, repos, slot, B, note,
     # only when each blocked dispatch is itself long
     reps = 50 if B <= 40_000 else 20
     overhead = float(np.median(timed(null_fn, 12)))
-    lat = np.maximum(timed(fn, reps) - overhead, 0.0) * 1000.0
+    raw = timed(fn, reps)
+    lat = np.maximum(raw - overhead, 0.0) * 1000.0
     p99_ms = float(np.percentile(lat, 99))
+    blocked_rate = B / float(np.median(raw))
 
     out = {
         "metric": "rbac_2hop_bulk_check_throughput",
-        "value": round(best_rate, 1),
+        "value": round(blocked_rate, 1),
         "unit": "checks/sec/chip",
-        "vs_baseline": round(best_rate / NORTH_STAR, 4),
+        "vs_baseline": round(blocked_rate / NORTH_STAR, 4),
+        "rate_basis": "blocked-dispatch",
+        "pipelined_rate": round(pipelined_rate, 1),
         "p99_ms": round(p99_ms, 3),
         "batch": int(B),
         "edges": int(snap.num_edges),
@@ -212,9 +224,36 @@ def measure_batch(engine, dsnap, snap, users, repos, slot, B, note,
         "platform": jax.default_backend(),
         **({"note": note} if note else {}),
     }
-    if true_rate:
-        return out, (q_perm, args)
-    return out, None
+    return out, (q_perm, args)
+
+
+def measure_small_batch(engine, dsnap, snap, users, repos, slot, note):
+    """The latency-mode row: warm B=1024 pinned-kernel dispatch p99 with
+    the host/H2D/kernel/D2H stage budget (engine/latency.py) — the half
+    of the north-star metric (p99 < 2 ms) a 131k-item scan cannot
+    measure.  Measured AND emitted through the shared
+    benchmarks.common.emit_small_batch_row, so this row's shape cannot
+    drift from the config-1/3/4 rows."""
+    import sys
+
+    import numpy as np
+    import jax
+
+    from benchmarks.common import emit_small_batch_row
+
+    rng = np.random.default_rng(9)
+    B = 1024
+    q_res = rng.choice(repos, B).astype(np.int32)
+    q_perm = np.full(B, slot["read"], np.int32)
+    q_subj = rng.choice(users, B).astype(np.int32)
+    stage(f"measuring latency-mode small batch B={B}")
+    emit_small_batch_row(
+        "rbac_2hop_small_batch_p99_latency", engine, dsnap,
+        q_res, q_perm, q_subj, edges=int(snap.num_edges),
+        platform=jax.default_backend(),
+        **({"note": note} if note else {}),
+    )
+    sys.stdout.flush()  # the line must survive a mid-ramp child kill
 
 
 def measure_true_rate(engine, dsnap, B, q_perm, args):
@@ -230,7 +269,10 @@ def measure_true_rate(engine, dsnap, B, q_perm, args):
     # must compile the very program being benchmarked
     slots = tuple(sorted({int(s) for s in np.unique(q_perm) if s >= 0}))
     stage(f"measuring repeat-harness true rate B={B}")
-    return round(measured_rate_flat(engine, dsnap, slots, B, args, iters=8), 1)
+    # enough loop iterations that t1 is ~100ms-class: small batches with
+    # few iterations let host timing jitter swallow the t2 - t1 signal
+    iters = max(16, (1 << 19) // B)
+    return round(measured_rate_flat(engine, dsnap, slots, B, args, iters=iters), 1)
 
 
 def run_bench(batches, world_kw, budget_s, note=None):
@@ -255,23 +297,34 @@ def run_bench(batches, world_kw, budget_s, note=None):
             stage(f"budget {elapsed:.0f}s/{budget_s}s spent; skipping B≥{B}")
             break
         result, tr_inputs = measure_batch(
-            engine, dsnap, snap, users, repos, slot, B, note,
-            # the repeat harness costs two extra compiles: measure it at
-            # the first (smallest, cheapest-to-compile) batch size only
-            true_rate=(i == 0),
+            engine, dsnap, snap, users, repos, slot, B, note
         )
-        print(json.dumps(result), flush=True)  # a line per batch: timeouts
-        # keep the best completed measurement on stdout
-        if tr_inputs is not None:
-            # AFTER the headline line is out: a hang here costs only the
-            # extra figure, never the batch's salvageable result
+        # provisional line FIRST (blocked-dispatch basis): a hang in the
+        # repeat harness below costs only the upgrade, never the batch's
+        # salvageable result
+        print(json.dumps(result), flush=True)
+        if time.time() - t_start <= budget_s * 0.7:
             try:
-                result["true_rate"] = measure_true_rate(
+                result["value"] = measure_true_rate(
                     engine, dsnap, B, *tr_inputs
                 )
+                result["vs_baseline"] = round(result["value"] / NORTH_STAR, 4)
+                result["rate_basis"] = "repeat-harness"
                 print(json.dumps(result), flush=True)
             except Exception as e:
                 stage(f"true-rate measurement failed: {type(e).__name__}: {e}")
+        else:
+            stage(f"budget: keeping blocked-dispatch value for B={B}")
+        if i == 0:
+            # the latency-mode p99 row rides right after the first
+            # (cheapest) batch: early enough to survive a short tunnel
+            # window, late enough that the headline is already out
+            try:
+                measure_small_batch(
+                    engine, dsnap, snap, users, repos, slot, note
+                )
+            except Exception as e:
+                stage(f"small-batch latency failed: {type(e).__name__}: {e}")
 
 
 def child_main(mode: str, note: str | None) -> None:
@@ -300,12 +353,17 @@ def child_main(mode: str, note: str | None) -> None:
         )
 
 
+HEADLINE_METRIC = "rbac_2hop_bulk_check_throughput"
+
+
 def _parse_best(stdout: str):
-    """Best (highest-throughput) JSON result line in a child's stdout;
-    the repeat-harness true rate (measured once, at the smallest batch)
-    is carried onto the winner."""
-    best = None
-    true_rate = None
+    """Reduce a child's stdout to one line per metric.  For the headline
+    throughput metric, repeat-harness lines beat provisional
+    blocked-dispatch ones (same batch emits both; the honest final value
+    must win regardless of magnitude) and the best batch size wins among
+    equals; secondary metrics keep their last emitted line.  Returns
+    {metric: line} or None when nothing parsed."""
+    by_metric = {}
     for line in (stdout or "").splitlines():
         line = line.strip()
         if not line.startswith("{"):
@@ -314,14 +372,18 @@ def _parse_best(stdout: str):
             parsed = json.loads(line)
         except json.JSONDecodeError:
             continue
-        if "metric" in parsed and "value" in parsed:
-            if "true_rate" in parsed:
-                true_rate = max(true_rate or 0.0, parsed["true_rate"])
-            if best is None or parsed["value"] > best["value"]:
-                best = parsed
-    if best is not None and true_rate is not None:
-        best["true_rate"] = true_rate
-    return best
+        if "metric" not in parsed or "value" not in parsed:
+            continue
+        m = parsed["metric"]
+        if m != HEADLINE_METRIC:
+            by_metric[m] = parsed
+            continue
+        cur = by_metric.get(m)
+        def rank(ln):
+            return (ln.get("rate_basis") == "repeat-harness", ln["value"])
+        if cur is None or rank(parsed) > rank(cur):
+            by_metric[m] = parsed
+    return by_metric or None
 
 
 def _run_child(mode: str, timeout_s: int, note: str | None):
@@ -340,12 +402,13 @@ def _run_child(mode: str, timeout_s: int, note: str | None):
         reason = f"{mode} attempt timed out after {timeout_s}s"
     if stderr:
         sys.stderr.write(stderr)
-    best = _parse_best(stdout)
-    if best is not None:
-        if reason:
+    lines = _parse_best(stdout)
+    if lines is not None:
+        if reason and HEADLINE_METRIC in lines:
+            best = lines[HEADLINE_METRIC]
             best.setdefault("note", "")
             best["note"] = (best["note"] + f"; partial ramp: {reason}").lstrip("; ")
-        return best, None
+        return lines, None
     if reason is None:
         reason = f"{mode} attempt produced no JSON line"
     err = (stderr or "").strip().splitlines()
@@ -375,18 +438,18 @@ def main() -> int:
     # never keep the driver-facing process from printing a parseable line.
     reason = _probe_backend()
     if reason is None:
-        best, reason = _run_child("tpu", TPU_CHILD_TIMEOUT_S, None)
+        lines, reason = _run_child("tpu", TPU_CHILD_TIMEOUT_S, None)
     else:
-        best = None
+        lines = None
         sys.stderr.write(f"# {reason}\n")
-    if best is None:
+    if lines is None:
         sys.stderr.write(f"# {reason}; retrying degraded on cpu\n")
-        best, reason2 = _run_child(
+        lines, reason2 = _run_child(
             "cpu", CPU_CHILD_TIMEOUT_S, f"degraded cpu run ({reason})"
         )
-        if best is None:
-            best = {
-                "metric": "rbac_2hop_bulk_check_throughput",
+        if lines is None:
+            lines = {HEADLINE_METRIC: {
+                "metric": HEADLINE_METRIC,
                 "value": 0.0,
                 "unit": "checks/sec/chip",
                 "vs_baseline": 0.0,
@@ -395,8 +458,14 @@ def main() -> int:
                 "edges": 0,
                 "platform": "none",
                 "note": f"all attempts failed: {reason}; {reason2}",
-            }
-    print(json.dumps(best))
+            }}
+    # headline first (drivers that read only line 1 keep working), then
+    # the secondary metrics (small-batch p99 etc.)
+    if HEADLINE_METRIC in lines:
+        print(json.dumps(lines[HEADLINE_METRIC]))
+    for m, line in lines.items():
+        if m != HEADLINE_METRIC:
+            print(json.dumps(line))
     return 0
 
 

@@ -17,7 +17,13 @@ import sys as _sys
 
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
-from benchmarks.common import maybe_force_cpu, NORTH_STAR_P99_MS, emit, note
+from benchmarks.common import (
+    maybe_force_cpu,
+    NORTH_STAR_P99_MS,
+    emit,
+    emit_small_batch_row,
+    note,
+)
 
 from gochugaru_tpu import consistency, rel
 from gochugaru_tpu.client import new_tpu_evaluator
@@ -68,6 +74,34 @@ def main() -> None:
     p50, p99 = float(np.percentile(a, 50)), float(np.percentile(a, 99))
     emit("founders_checkall_p99_latency", p99, "ms", NORTH_STAR_P99_MS / max(p99, 1e-9))
     note(f"p50={p50:.3f}ms p99={p99:.3f}ms mean={a.mean():.3f}ms n=1000")
+
+    # latency-mode small batch (engine/latency.py): a warm B=1024
+    # dispatch on the founders world through the pinned-kernel path,
+    # with the host/H2D/kernel/D2H budget breakdown on the row
+    snap = client._store.snapshot_for(cs)
+    engine = client._engine_for(snap)
+    if engine is None:  # device unavailable: the CheckAll row above
+        note("small-batch latency row skipped: no device engine")
+        return
+    dsnap = client._dsnap_for(engine, snap)
+    slot = snap.compiled.slot_of_name
+    B = 1024
+    doc = snap.interner.lookup("document", "readme")
+    subs = np.array(
+        [snap.interner.lookup("user", n) for n in ("jake", "joey", "jimmy")]
+        + [-1],  # a miss lane: unknown subjects stay definite-false
+        np.int32,
+    )
+    q_res = np.full(B, doc, np.int32)
+    q_perm = np.full(B, slot["view"], np.int32)
+    q_subj = subs[np.arange(B) % subs.shape[0]]
+    try:
+        emit_small_batch_row(
+            "founders_small_batch_p99_latency", engine, dsnap,
+            q_res, q_perm, q_subj, edges=int(snap.num_edges),
+        )
+    except Exception as e:  # optional row must never cost the main one
+        note(f"small-batch latency section failed: {type(e).__name__}: {e}")
 
 
 if __name__ == "__main__":

@@ -20,6 +20,7 @@ from benchmarks.common import (
     NORTH_STAR_P99_MS,
     NORTH_STAR_RATE,
     emit,
+    emit_small_batch_row,
     latency_percentiles,
     note,
     time_steady,
@@ -198,6 +199,20 @@ def main() -> None:
          NORTH_STAR_P99_MS / max(p99, 1e-9),
          edges=int(snap.num_edges), batch=int(B))
     note(f"p50={p50:.2f}ms p99={p99:.2f}ms mean={mean:.2f}ms")
+
+    # latency-mode small batch at spec scale (engine/latency.py): the
+    # p99-half of the north star measured on an interactive-sized
+    # dispatch instead of the 131k-item scan above, with the
+    # host/H2D/kernel/D2H budget breakdown on the row
+    try:
+        SB = 2048
+        emit_small_batch_row(
+            "docs_5hop_small_batch_p99_latency", engine, dsnap,
+            q_res[:SB].copy(), q_perm[:SB].copy(), q_subj[:SB].copy(),
+            edges=int(snap.num_edges), now_us=EPOCH,
+        )
+    except Exception as e:  # optional row must never cost the main ones
+        note(f"small-batch latency section failed: {type(e).__name__}: {e}")
 
     # device-lookup latency at config-3 scale: backs engine/lookup.py's
     # "at 1M docs this is milliseconds of device time" claim with a number

@@ -116,7 +116,7 @@ def main() -> None:
     dsnap = engine.prepare(snap)
 
     rng = np.random.default_rng(5)
-    lat_mat, lat_ship = [], []
+    lat_mat, lat_overlay, lat_probe = [], [], []
     warm_ms = 0.0
     incremental = 0
     for rnd in range(args.warmup + args.rounds):
@@ -132,6 +132,7 @@ def main() -> None:
         snap = apply_delta(snap, snap.revision + 1, adds, deletes, interner=interner)
         t1 = time.perf_counter()
         dsnap = engine.prepare(snap, prev=dsnap)
+        t_ov = time.perf_counter()
         if dsnap.flat_meta is not None and dsnap.flat_meta.delta is not None:
             incremental += 1
         # freshness probe: a just-added edge must be visible at the new
@@ -148,22 +149,33 @@ def main() -> None:
             warm_ms += (t2 - t0) * 1000
             continue
         lat_mat.append((t1 - t0) * 1000)
-        lat_ship.append((t2 - t1) * 1000)
+        lat_overlay.append((t_ov - t1) * 1000)
+        lat_probe.append((t2 - t_ov) * 1000)
 
     # --warmup 0 keeps the old behavior of dropping the first sample
     # (it carries the one-time kernel trace); an empty window is an error
     drop = 1 if args.warmup == 0 and len(lat_mat) > 1 else 0
     mat = np.asarray(lat_mat[drop:])
-    ship = np.asarray(lat_ship[drop:])
+    overlay = np.asarray(lat_overlay[drop:])
+    probe_t = np.asarray(lat_probe[drop:])
     if mat.size == 0:
         raise SystemExit("no measured rounds: raise --rounds")
-    total_ms = mat.mean() + ship.mean()
+    total_ms = mat.mean() + overlay.mean() + probe_t.mean()
     rate = args.delta / (total_ms / 1000)
+    # the per-stage breakdown rides ON the row (not just a stderr note)
+    # so the 100M-edge (config 5b) run's in-suite vs solo spread is
+    # decomposable from the recorded JSON: materialize is host column
+    # merging (memory-pressure-sensitive), overlay is the device delta
+    # prepare, probe is the freshness check dispatch
     emit("watch_reindex_updates_per_sec", rate, "updates/sec", rate / 1_000_000,
-         edges=int(args.edges), batch=int(args.delta))
+         edges=int(args.edges), batch=int(args.delta),
+         materialize_ms=round(float(mat.mean()), 2),
+         overlay_ms=round(float(overlay.mean()), 2),
+         probe_ms=round(float(probe_t.mean()), 2))
     note(
         f"delta={args.delta} materialize={mat.mean():.1f}ms "
-        f"device-overlay+probe={ship.mean():.1f}ms total={total_ms:.1f}ms/delta "
+        f"device-overlay={overlay.mean():.1f}ms probe={probe_t.mean():.1f}ms "
+        f"total={total_ms:.1f}ms/delta "
         f"incremental={incremental}/{args.warmup + args.rounds} rounds; "
         f"warmup ({args.warmup} revs incl. chain-growth retraces) "
         f"{warm_ms:.0f}ms total, excluded"

@@ -1,0 +1,148 @@
+"""TPU tunnel-window harvesters (VERDICT r05 "What's weak" #1): the
+tunnel is the scarcest resource in this environment, so a live window
+must be consumed maximally and unattended.  tpu_watch.sh runs these, in
+priority order, right after a successful config-2 bench:
+
+  --trace DIR   capture a jax.profiler trace of the aligned kernel —
+                one big-batch (32k) and one latency-mode small-batch
+                dispatch loop — into DIR (TensorBoard-loadable), and
+                print a JSON line naming the capture;
+  --ab          aligned-vs-legacy A/B on silicon: the SAME config-2
+                world measured with flat_aligned=True and False, same
+                timing recipe, one JSON line per arm — the measurement
+                the round-5 kernel rebuild was made for and never got.
+
+Every section is wrapped so a dying tunnel costs only the remaining
+sections; JSON goes to stdout (one line per metric, same shape as the
+benches), stages to stderr.
+"""
+
+import argparse
+import json
+import os as _os
+import sys as _sys
+import time
+
+import numpy as np
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+from benchmarks.common import maybe_force_cpu, note
+
+
+def _world(flat_aligned=None):
+    from bench import build_world
+
+    from gochugaru_tpu.engine.device import DeviceEngine
+    from gochugaru_tpu.engine.plan import EngineConfig
+
+    cs, snap, users, repos, slot = build_world()
+    cfg = None
+    if flat_aligned is not None:
+        cfg = EngineConfig.for_schema(cs)
+        from dataclasses import replace
+
+        cfg = replace(cfg, flat_aligned=flat_aligned)
+    engine = DeviceEngine(cs, cfg)
+    dsnap = engine.prepare(snap)
+    return engine, dsnap, snap, users, repos, slot
+
+
+def _queries(users, repos, slot, B, seed=5):
+    rng = np.random.default_rng(seed)
+    q_res = rng.choice(repos, B).astype(np.int32)
+    q_perm = rng.choice(np.array([slot["read"], slot["admin"]], np.int32), B)
+    q_subj = rng.choice(users, B).astype(np.int32)
+    return q_res, q_perm, q_subj
+
+
+def _flat_call(engine, dsnap, snap, q_res, q_perm, q_subj):
+    import jax.numpy as jnp
+
+    queries, qctx = engine._columns_preamble(
+        dsnap, q_res, q_perm, q_subj, None, None, None, None
+    )
+    return engine.flat_fn_and_args(
+        dsnap, queries, qctx,
+        jnp.int32(snap.now_rel32(1_700_000_000_000_000)), q_res.shape[0],
+    )
+
+
+def _blocked_rate(fn, args, B, reps=10):
+    import jax
+
+    jax.block_until_ready(fn(*args))
+    jax.device_get(fn(*args))  # force sync mode (common.time_steady note)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    med = float(np.median(ts))
+    return B / med, med
+
+
+def do_trace(trace_dir: str) -> None:
+    import jax
+
+    engine, dsnap, snap, users, repos, slot = _world()
+    note(f"trace: world prepared, backend={jax.default_backend()}")
+    B = 32_768
+    got = _flat_call(engine, dsnap, snap, *_queries(users, repos, slot, B))
+    assert got is not None
+    fn, args = got
+    jax.block_until_ready(fn(*args))  # compile OUTSIDE the trace
+    lp = engine.latency_path(dsnap)
+    q_res, q_perm, q_subj = _queries(users, repos, slot, 1024, seed=9)
+    lp.dispatch_columns(q_res, q_perm, q_subj)  # pin outside the trace
+    with jax.profiler.trace(trace_dir):
+        for _ in range(10):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        for i in range(10):
+            lp.dispatch_columns(np.roll(q_res, i), q_perm, q_subj)
+    print(json.dumps({
+        "metric": "tpu_profile_trace", "value": 1.0, "unit": "capture",
+        "vs_baseline": 0.0, "trace_dir": trace_dir,
+        "platform": jax.default_backend(),
+        "contents": "10x B=32768 aligned dispatches + 10x B=1024 latency-mode",
+    }), flush=True)
+
+
+def do_ab() -> None:
+    import jax
+
+    B = 32_768
+    for aligned in (True, False):
+        arm = "aligned" if aligned else "legacy-blocks"
+        try:
+            note(f"A/B arm: {arm}")
+            engine, dsnap, snap, users, repos, slot = _world(flat_aligned=aligned)
+            got = _flat_call(engine, dsnap, snap, *_queries(users, repos, slot, B))
+            assert got is not None, "flat path unavailable"
+            rate, med = _blocked_rate(*got, B)
+            print(json.dumps({
+                "metric": f"rbac_2hop_ab_{arm.replace('-', '_')}_rate",
+                "value": round(rate, 1), "unit": "checks/sec/chip",
+                "vs_baseline": round(rate / 10_000_000, 4),
+                "batch": B, "blocked_ms": round(med * 1000, 2),
+                "platform": jax.default_backend(),
+            }), flush=True)
+        except Exception as e:  # a dead arm must not cost the other
+            note(f"A/B arm {arm} failed: {type(e).__name__}: {e}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace", metavar="DIR", default=None)
+    ap.add_argument("--ab", action="store_true")
+    args = ap.parse_args()
+    note(f"platform={maybe_force_cpu()}")
+    if args.trace:
+        do_trace(args.trace)
+    if args.ab:
+        do_ab()
+
+
+if __name__ == "__main__":
+    main()

@@ -87,6 +87,7 @@ class _Options:
         self.store: Optional[Store] = None
         self.use_device = True
         self.profile_dir: Optional[str] = None
+        self.latency_mode = False
 
 
 Option = Callable[[_Options], None]
@@ -131,6 +132,22 @@ def with_host_only_evaluation() -> Option:
     return opt
 
 
+def with_latency_mode() -> Option:
+    """Route interactive-sized Check batches through the latency-mode
+    execution path (engine/latency.py): warm pinned kernels at fixed
+    small-batch tiers, preallocated staging buffers, and a per-stage
+    budget breakdown published as ``latency.*`` metrics with live
+    p50/p99 — the serving shape for the p99 < 2 ms half of the north
+    star.  Batches the path cannot serve (beyond the top tier, too many
+    distinct permissions, non-flat worlds) fall back to the throughput
+    path transparently."""
+
+    def opt(o: _Options) -> None:
+        o.latency_mode = True
+
+    return opt
+
+
 def with_profiling(trace_dir: str) -> Option:
     """Capture a ``jax.profiler`` trace around every check dispatch into
     ``trace_dir`` and publish a ``checks.device_time_s`` timer — the deep
@@ -155,6 +172,7 @@ class Client:
         self._engine_config = o.engine_config
         self._use_device = o.use_device
         self._profile_dir = o.profile_dir
+        self._latency_mode = o.latency_mode
         # jax.profiler allows one active trace per process: profiled
         # dispatches serialize so concurrent check() calls don't collide
         self._profile_lock = threading.Lock()
@@ -315,7 +333,9 @@ class Client:
                     unlock = lambda: None
                 try:
                     with prof, self._metrics.timer("checks.device_time_s"):
-                        d, p, ovf = engine.check_batch(dsnap, rels)
+                        d, p, ovf = engine.check_batch(
+                            dsnap, rels, latency=self._latency_mode
+                        )
                 except Exception as e:  # classify device dispatch failures
                     msg = str(e)
                     if "RESOURCE_EXHAUSTED" in msg or "UNAVAILABLE" in msg:
@@ -732,3 +752,4 @@ NewWithOpts = new_with_opts
 NewPlaintext = new_plaintext
 NewSystemTLS = new_system_tls
 WithOverlapRequired = with_overlap_required
+WithLatencyMode = with_latency_mode

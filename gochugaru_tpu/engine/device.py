@@ -624,6 +624,10 @@ class DeviceSnapshot:
     #: at FULL prepare on folded worlds and carried along a delta chain
     #: so each revision's dl_pf* overlay recomputes from (base, acc)
     fold_state: Optional[Any] = None
+    #: lazily-attached latency-mode dispatcher (engine/latency.py
+    #: LatencyPath) — per-snapshot warm state (staging buffers, local
+    #: pin table); the executables themselves are shared engine-wide
+    latency_path: Optional[Any] = None
 
 
 class DeviceEngine:
@@ -644,6 +648,16 @@ class DeviceEngine:
         )
         #: flat-kernel cache: (slots tuple, FlatMeta) → jitted fn
         self._flat_fns: Dict[Any, Any] = {}
+        #: pinned latency-mode executables shared across snapshots:
+        #: (FlatMeta, array-shape fingerprint, (slots, tier, qctx key))
+        #: → AOT-compiled kernel — a Watch delta chain with stable table
+        #: geometry re-pins per revision without recompiling.  Guarded by
+        #: its own lock: multiple LatencyPaths (concurrent revisions)
+        #: share this dict, and the FIFO eviction iterates it
+        import threading
+
+        self._latency_pins: Dict[Any, Any] = {}
+        self._latency_pins_lock = threading.Lock()
         #: context-free qctx singletons (host + device forms)
         self._empty_qctx_np: Optional[Dict[str, np.ndarray]] = None
         self._empty_qctx_jnp = None
@@ -998,6 +1012,52 @@ class DeviceEngine:
             return self._empty_qctx_jnp
         return {k: jnp.asarray(v) for k, v in qctx.items()}
 
+    # -- latency-mode path (engine/latency.py) ---------------------------
+    #: bound on engine-wide pinned latency executables (FIFO, same
+    #: rationale as FLAT_FN_CACHE_MAX; each pin is one compiled XLA
+    #: program at one small-batch tier)
+    LATENCY_PIN_CACHE_MAX = 32
+
+    def latency_path(self, dsnap: DeviceSnapshot):
+        """The warm small-batch dispatcher attached to this prepared
+        snapshot (created on first use; see engine/latency.py)."""
+        if dsnap.latency_path is None:
+            from .latency import LatencyPath
+
+            with self._latency_pins_lock:
+                if dsnap.latency_path is None:
+                    dsnap.latency_path = LatencyPath(self, dsnap)
+        return dsnap.latency_path
+
+    def check_columns_latency(
+        self,
+        dsnap: DeviceSnapshot,
+        q_res: np.ndarray,
+        q_perm: np.ndarray,
+        q_subj: np.ndarray,
+        *,
+        q_srel: Optional[np.ndarray] = None,
+        q_wc: Optional[np.ndarray] = None,
+        q_ctx: Optional[np.ndarray] = None,
+        qctx_rows: Optional[Sequence[Mapping[str, Any]]] = None,
+        now_us: Optional[int] = None,
+    ):
+        """Latency-mode bulk check from pre-interned columns: pinned
+        kernel, tiered padding, per-stage budget metrics.  Falls back to
+        ``check_columns`` when the latency path cannot serve the batch
+        (no flat tables, too many distinct permissions, batch beyond the
+        top tier) — same result contract either way."""
+        out = self.latency_path(dsnap).dispatch_columns(
+            q_res, q_perm, q_subj, q_srel=q_srel, q_wc=q_wc,
+            q_ctx=q_ctx, qctx_rows=qctx_rows, now_us=now_us,
+        )
+        if out is not None:
+            return out
+        return self.check_columns(
+            dsnap, q_res, q_perm, q_subj, q_srel=q_srel, q_wc=q_wc,
+            q_ctx=q_ctx, qctx_rows=qctx_rows, now_us=now_us,
+        )
+
     # -- flat-kernel plumbing (engine/flat.py) ---------------------------
     #: bound on cached per-permission-subset kernels (simple FIFO eviction:
     #: a pathological workload cycling through C(P, ≤8) subsets pays
@@ -1087,19 +1147,34 @@ class DeviceEngine:
         rels: Sequence[Relationship],
         *,
         now_us: Optional[int] = None,
+        latency: bool = False,
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Returns (definite, possible, overflow) bool arrays of len(rels).
 
         ``definite`` → permission granted.  ``possible & ~definite`` →
         conditional on caveats the device didn't evaluate; the caller
         resolves via the host oracle.  ``overflow`` → a static cap was
-        exceeded; the caller must re-check on the host."""
+        exceeded; the caller must re-check on the host.
+
+        With ``latency``, small batches route through the latency-mode
+        path (engine/latency.py: pinned kernel at a fixed tier, staged
+        budget metrics); batches it cannot serve fall through to the
+        ordinary dispatch below, same contract."""
         if not rels:
             z = np.zeros(0, bool)
             return z, z, z
+        import time as _time
+
+        t_lower = _time.perf_counter()
         snap = dsnap.snapshot
         queries, uniq, qctx = self._lower_queries(snap, rels, dsnap.strings)
         B = len(rels)
+        if latency:
+            out = self.latency_path(dsnap).dispatch(
+                queries, qctx, B, snap.now_rel32(now_us), t_start=t_lower
+            )
+            if out is not None:
+                return out
         now_flat = jnp.int32(snap.now_rel32(now_us))
         PB = self._pipeline_batch()
         if PB and B > PB and dsnap.flat_meta is not None:

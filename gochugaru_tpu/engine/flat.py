@@ -89,10 +89,18 @@ def build_qm(queries: Dict[str, "np.ndarray"], BP: int, meta: "FlatMeta"):
     host: row 3 carries the dense srel1 (-1 = the subject relation can
     never match a stored key), row 7 the dense k1 id of q_perm (-1 =
     inactive — the root probes miss, programs still evaluate)."""
+    return fill_qm(queries, np.empty((QM_ROWS, BP), np.int32), meta)
+
+
+def fill_qm(queries: Dict[str, "np.ndarray"], qm: np.ndarray, meta: "FlatMeta"):
+    """``build_qm`` into a PREALLOCATED [QM_ROWS, BP] int32 buffer.  The
+    latency-mode path (engine/latency.py) keeps one staging buffer per
+    batch tier and refills it in place, so steady-state small-batch
+    dispatch performs zero host-side array allocation."""
     B = queries["q_res"].shape[0]
     k1d = _dense_np(meta.k1_dense)
     k2d = _dense_np(meta.k2_dense)
-    qm = np.full((QM_ROWS, BP), -1, np.int32)
+    qm.fill(-1)
     qm[3] = qm[6] = 0
     qm[0, :B] = queries["q_res"]
     qm[1, :B] = queries["q_perm"]

@@ -146,6 +146,27 @@ class EngineConfig:
     #: CPU (one core executes chunks serially and the per-dispatch
     #: overhead costs ~40% throughput — measured, bench4).  0 disables
     flat_pipeline_batch: Optional[int] = None
+    # -- latency-mode execution path (engine/latency.py) -----------------
+    #: small-batch padding tiers: a latency-mode batch pads to the
+    #: smallest tier ≥ B and runs a pinned AOT-compiled kernel for that
+    #: tier — a handful of tiers bounds the pinned-executable count
+    #: while keeping pad waste ≤ 4×; batches beyond the top tier use
+    #: the throughput path
+    latency_tiers: Tuple[int, ...] = (256, 1024, 4096)
+    #: donate the query-matrix device buffer to the pinned executable
+    #: (XLA aliases it for outputs — zero per-dispatch device
+    #: allocation).  None = auto: on for TPU, off on CPU where the
+    #: runtime cannot use the donation and warns per compile
+    latency_donate: Optional[bool] = None
+    #: fence between budget stages (block after H2D, after kernel) so
+    #: each stage's time is exact.  None = auto: on for TPU (the H2D
+    #: genuinely overlaps and must be fenced to be measured), off on
+    #: CPU (device_put is a synchronous copy; the extra fences cost
+    #: ~0.3 ms per dispatch and the kernel stage absorbs any queued
+    #: transfer remainder).  Timing-only: off-CPU the H2D fence is kept
+    #: regardless (the shared staging buffer must not be refilled while
+    #: an async transfer still reads it)
+    latency_staged_timing: Optional[bool] = None
 
     @staticmethod
     def for_schema(compiled: CompiledSchema, **overrides) -> "EngineConfig":

@@ -417,6 +417,8 @@ class ShardedEngine(DeviceEngine):
         rels: Sequence[Relationship],
         *,
         now_us: Optional[int] = None,
+        latency: bool = False,  # accepted for Client parity; the latency
+        # path is single-chip (engine/latency.py), so it's ignored here
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         if not rels:
             z = np.zeros(0, bool)

@@ -5,6 +5,14 @@ metric here demands measurement, so the client and engine publish counters
 (checks dispatched, batch occupancy, closure/BFS overflow fallbacks, device
 dispatch time) through this registry.  ``jax.profiler`` remains the deep
 tool; these are the cheap always-on numbers.
+
+Timers keep a bounded ring of raw samples alongside the running
+count/total, so tail latency is a first-class readout: ``percentile``
+answers "what is my p99 right now" from the live process, and
+``snapshot`` publishes ``.p50_s``/``.p99_s`` per timer.  The north-star
+metric is a p99, and a mean cannot stand in for it — the latency-mode
+dispatch path (engine/latency.py) publishes its per-stage budget through
+these samples.
 """
 
 from __future__ import annotations
@@ -13,14 +21,20 @@ import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
-from typing import Dict
+from typing import Dict, Optional
 
 
 class Metrics:
+    #: per-timer sample-ring capacity: enough that a p99 is the ~20th
+    #: worst sample (not the max of a handful), small enough that a
+    #: long-lived serving process holds a few KB per timer
+    SAMPLE_CAP = 2048
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[str, float] = defaultdict(float)
         self._timings: Dict[str, list] = defaultdict(lambda: [0, 0.0])  # [n, total_s]
+        self._samples: Dict[str, list] = defaultdict(list)  # ring of raw seconds
 
     def inc(self, name: str, delta: float = 1.0) -> None:
         with self._lock:
@@ -31,6 +45,11 @@ class Metrics:
             t = self._timings[name]
             t[0] += 1
             t[1] += seconds
+            s = self._samples[name]
+            if len(s) < self.SAMPLE_CAP:
+                s.append(seconds)
+            else:
+                s[(t[0] - 1) % self.SAMPLE_CAP] = seconds
 
     @contextmanager
     def timer(self, name: str):
@@ -44,20 +63,39 @@ class Metrics:
         with self._lock:
             return self._counters.get(name, 0.0)
 
+    def percentile(self, name: str, q: float) -> Optional[float]:
+        """The q-th percentile (seconds) over the timer's sample ring, or
+        None when the timer has no samples.  Honest within the ring: at
+        ≥ SAMPLE_CAP observations it is the p-of-the-last-SAMPLE_CAP, a
+        sliding window — exactly what a serving SLO wants."""
+        with self._lock:
+            s = self._samples.get(name)
+            if not s:
+                return None
+            s = sorted(s)
+        # nearest-rank on the sorted ring: no numpy dependency here
+        i = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+        return s[i]
+
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             out = dict(self._counters)
+            samples = {k: sorted(v) for k, v in self._samples.items() if v}
             for k, (n, total) in self._timings.items():
                 out[f"{k}.count"] = n
                 out[f"{k}.total_s"] = total
                 if n:
                     out[f"{k}.mean_s"] = total / n
-            return out
+        for k, s in samples.items():
+            out[f"{k}.p50_s"] = s[int(round(0.50 * (len(s) - 1)))]
+            out[f"{k}.p99_s"] = s[int(round(0.99 * (len(s) - 1)))]
+        return out
 
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
             self._timings.clear()
+            self._samples.clear()
 
 
 #: Process-global default registry.

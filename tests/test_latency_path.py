@@ -1,0 +1,249 @@
+"""Latency-mode execution path (engine/latency.py): differential parity
+against the host oracle, the no-retrace pin invariant, tier routing, and
+the budget-breakdown smoke the CI tier runs so the path can't silently
+rot between bench runs."""
+
+import numpy as np
+import pytest
+
+from gochugaru_tpu import consistency, rel
+from gochugaru_tpu.client import (
+    new_tpu_evaluator,
+    with_host_only_evaluation,
+    with_latency_mode,
+    with_store,
+)
+from gochugaru_tpu.engine.device import DeviceEngine
+from gochugaru_tpu.rel.txn import Txn
+from gochugaru_tpu.schema import compile_schema, parse_schema
+from gochugaru_tpu.store.interner import Interner
+from gochugaru_tpu.store.snapshot import build_snapshot_from_columns
+from gochugaru_tpu.utils import metrics
+from gochugaru_tpu.utils.context import background
+
+RBAC_SCHEMA = """
+definition user {}
+definition team { relation member: user }
+definition org {
+    relation admin: user
+    relation member: user | team#member
+}
+definition repo {
+    relation org: org
+    relation maintainer: user | team#member
+    relation reader: user
+    permission admin = org->admin + maintainer
+    permission read = reader + admin + org->member
+}
+"""
+
+EPOCH = 1_700_000_000_000_000
+
+
+def build_rbac_world(n_users=40, n_teams=4, n_orgs=3, n_repos=25, seed=7):
+    cs = compile_schema(parse_schema(RBAC_SCHEMA))
+    interner = Interner()
+    rng = np.random.default_rng(seed)
+    users = np.array([interner.node("user", f"u{i}") for i in range(n_users)], np.int64)
+    teams = np.array([interner.node("team", f"t{i}") for i in range(n_teams)], np.int64)
+    orgs = np.array([interner.node("org", f"o{i}") for i in range(n_orgs)], np.int64)
+    repos = np.array([interner.node("repo", f"r{i}") for i in range(n_repos)], np.int64)
+    slot = cs.slot_of_name
+    res, rel_s, subj, srel = [], [], [], []
+
+    def add(r, rl, s, sr):
+        res.append(r); rel_s.append(rl); subj.append(s); srel.append(sr)
+
+    for t in teams:
+        for u in rng.choice(users, 6, replace=False):
+            add(t, slot["member"], u, -1)
+    for o in orgs:
+        add(o, slot["admin"], rng.choice(users), -1)
+        add(o, slot["member"], rng.choice(teams), slot["member"])
+        for u in rng.choice(users, 3, replace=False):
+            add(o, slot["member"], u, -1)
+    for r in repos:
+        add(r, slot["org"], rng.choice(orgs), -1)
+        add(r, slot["maintainer"], rng.choice(teams), slot["member"])
+        add(r, slot["reader"], rng.choice(users), -1)
+    snap = build_snapshot_from_columns(
+        1, cs, interner,
+        res=np.asarray(res, np.int64), rel=np.asarray(rel_s, np.int64),
+        subj=np.asarray(subj, np.int64), srel=np.asarray(srel, np.int64),
+        epoch_us=EPOCH,
+    )
+    return cs, snap, users, repos, slot
+
+
+@pytest.fixture(scope="module")
+def rbac_world():
+    cs, snap, users, repos, slot = build_rbac_world()
+    engine = DeviceEngine(cs)
+    dsnap = engine.prepare(snap)
+    return engine, dsnap, snap, users, repos, slot
+
+
+def _random_queries(users, repos, slot, B, seed):
+    rng = np.random.default_rng(seed)
+    q_res = rng.choice(repos, B).astype(np.int32)
+    q_perm = rng.choice(
+        np.array([slot["read"], slot["admin"]], np.int32), B
+    )
+    q_subj = rng.choice(users, B).astype(np.int32)
+    return q_res, q_perm, q_subj
+
+
+def test_latency_path_parity_rbac(rbac_world):
+    """Latency-path planes == throughput-path planes == oracle verdicts
+    on the RBAC world."""
+    engine, dsnap, snap, users, repos, slot = rbac_world
+    from gochugaru_tpu.engine.oracle import SnapshotOracle, T
+
+    oracle = SnapshotOracle(snap, {})
+    q_res, q_perm, q_subj = _random_queries(users, repos, slot, 200, seed=3)
+    d0, p0, o0 = engine.check_columns(
+        dsnap, q_res, q_perm, q_subj, now_us=EPOCH
+    )
+    d1, p1, o1 = engine.check_columns_latency(
+        dsnap, q_res, q_perm, q_subj, now_us=EPOCH
+    )
+    assert (d0 == d1).all() and (p0 == p1).all() and (o0 == o1).all()
+    # and against ground truth, resolving the possible plane like the
+    # client does (no caveats in this schema: d is the verdict when the
+    # device didn't overflow)
+    perm_name = {slot["read"]: "read", slot["admin"]: "admin"}
+    for i in range(q_res.shape[0]):
+        if o1[i] or (p1[i] and not d1[i]):
+            continue  # host-resolved slice: not the device's verdict
+        rtype, rid = snap.interner.key_of(int(q_res[i]))
+        stype, sid = snap.interner.key_of(int(q_subj[i]))
+        r = rel.must_from_triple(
+            f"{rtype}:{rid}", perm_name[int(q_perm[i])], f"{stype}:{sid}"
+        )
+        assert bool(d1[i]) == (oracle.check_relationship(r) == T), r
+
+
+def test_latency_mode_client_parity_founders():
+    """A with_latency_mode client answers the founders-world checks
+    exactly like a host-only (oracle) client sharing the same store."""
+    lat_client = new_tpu_evaluator(with_latency_mode())
+    ctx = background()
+    lat_client.write_schema(ctx, """
+    definition user {}
+    definition document {
+        relation founder: user
+        permission view = founder
+    }
+    """)
+    txn = Txn()
+    for name in ("jake", "joey", "jimmy"):
+        txn.touch(rel.must_from_triple("document:readme", "founder", f"user:{name}"))
+    lat_client.write(ctx, txn)
+    oracle_client = new_tpu_evaluator(
+        with_host_only_evaluation(), with_store(lat_client.store)
+    )
+    cs = consistency.full()
+    checks = [
+        rel.must_from_triple("document:readme", "view", f"user:{n}")
+        for n in ("jake", "joey", "jimmy", "judas", "jeb")
+    ] + [rel.must_from_triple("document:readme", "founder", "user:jake")]
+    before = metrics.default.counter("latency.dispatches")
+    got = lat_client.check(ctx, cs, *checks)
+    want = oracle_client.check(ctx, cs, *checks)
+    assert got == want == [True, True, True, False, False, True]
+    assert metrics.default.counter("latency.dispatches") > before, (
+        "latency mode was configured but the latency path never ran"
+    )
+
+
+def test_latency_path_no_retrace_warm(rbac_world):
+    """≥100 warm dispatches at one tier with VARYING query contents pay
+    ZERO additional compiles — the pinned-executable invariant."""
+    engine, dsnap, snap, users, repos, slot = rbac_world
+    lp = engine.latency_path(dsnap)
+    q_res, q_perm, q_subj = _random_queries(users, repos, slot, 700, seed=11)
+    d_ref, p_ref, o_ref = engine.check_columns(
+        dsnap, q_res, q_perm, q_subj, now_us=EPOCH
+    )
+    out = lp.dispatch_columns(q_res, q_perm, q_subj, now_us=EPOCH)
+    assert out is not None
+    warm_compiles = lp.compile_count
+    assert warm_compiles >= 1
+    for i in range(110):
+        d, p, o = lp.dispatch_columns(
+            np.roll(q_res, i), q_perm, np.roll(q_subj, i), now_us=EPOCH
+        )
+        assert lp.last_budget.tier == lp.tier_for(700)
+        if i % 37 == 0:  # spot-check answers stay right while warm
+            dd, pp, oo = engine.check_columns(
+                dsnap, np.roll(q_res, i), q_perm, np.roll(q_subj, i),
+                now_us=EPOCH,
+            )
+            assert (d == dd).all() and (p == pp).all() and (o == oo).all()
+    assert lp.compile_count == warm_compiles, (
+        f"latency path retraced: {lp.compile_count - warm_compiles} extra"
+        " compiles across 110 warm same-tier dispatches"
+    )
+    # same-tier, different batch size: still the same pinned kernel
+    lp.dispatch_columns(q_res[:500], q_perm[:500], q_subj[:500], now_us=EPOCH)
+    assert lp.compile_count == warm_compiles
+
+
+def test_latency_path_tier_routing(rbac_world):
+    """Batches beyond the top tier return None from the path and fall
+    back (check_columns_latency still answers, identically)."""
+    engine, dsnap, snap, users, repos, slot = rbac_world
+    top = max(engine.config.latency_tiers)
+    B = top + 1
+    q_res, q_perm, q_subj = _random_queries(users, repos, slot, B, seed=13)
+    lp = engine.latency_path(dsnap)
+    assert lp.tier_for(B) is None
+    assert lp.dispatch_columns(q_res, q_perm, q_subj, now_us=EPOCH) is None
+    d0, p0, o0 = engine.check_columns(dsnap, q_res, q_perm, q_subj, now_us=EPOCH)
+    d1, p1, o1 = engine.check_columns_latency(
+        dsnap, q_res, q_perm, q_subj, now_us=EPOCH
+    )
+    assert (d0 == d1).all() and (p0 == p1).all() and (o0 == o1).all()
+
+
+def test_latency_pins_shared_across_prepares(rbac_world):
+    """A re-prepared snapshot with identical geometry re-pins from the
+    engine-wide cache: zero new XLA compiles."""
+    engine, dsnap, snap, users, repos, slot = rbac_world
+    q_res, q_perm, q_subj = _random_queries(users, repos, slot, 200, seed=17)
+    lp = engine.latency_path(dsnap)
+    lp.dispatch_columns(q_res, q_perm, q_subj, now_us=EPOCH)
+    dsnap2 = engine.prepare(snap)
+    lp2 = engine.latency_path(dsnap2)
+    assert lp2 is not lp
+    out = lp2.dispatch_columns(q_res, q_perm, q_subj, now_us=EPOCH)
+    assert out is not None
+    assert lp2.compile_count == 0, "identical geometry should reuse pins"
+    assert lp2.pin_count == 1
+
+
+def test_latency_budget_smoke(rbac_world):
+    """Fast CI smoke: the latency path end-to-end on a tiny world, with
+    the host/H2D/kernel/D2H budget populated in last_budget AND in the
+    metrics registry (counts, totals, live p50/p99)."""
+    engine, dsnap, snap, users, repos, slot = rbac_world
+    reg = metrics.default
+    before = reg.counter("latency.dispatches")
+    q_res, q_perm, q_subj = _random_queries(users, repos, slot, 64, seed=19)
+    lp = engine.latency_path(dsnap)
+    for i in range(5):
+        out = lp.dispatch_columns(np.roll(q_res, i), q_perm, q_subj, now_us=EPOCH)
+        assert out is not None
+    b = lp.last_budget
+    assert b is not None and b.batch == 64 and b.tier == lp.tier_for(64)
+    for stage in ("host_lower_s", "h2d_s", "kernel_s", "d2h_s"):
+        assert getattr(b, stage) >= 0.0
+    assert b.total_s >= b.host_lower_s + b.h2d_s  # stages nest inside total
+    assert not b.compiled, "5th dispatch must be warm"
+    snapm = reg.snapshot()
+    assert reg.counter("latency.dispatches") >= before + 5
+    for stage in ("host_lower", "h2d", "kernel", "d2h", "dispatch"):
+        assert snapm[f"latency.{stage}_s.count"] >= 1
+        assert f"latency.{stage}_s.p99_s" in snapm
+        assert f"latency.{stage}_s.p50_s" in snapm
+    assert reg.percentile("latency.dispatch_s", 99) is not None
