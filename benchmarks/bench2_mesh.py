@@ -1,0 +1,204 @@
+"""Config-2 mesh comparison + degraded-mode columns (ADVICE item 9
+foregrounded): the same GitHub-RBAC world checked on a single device,
+a 1×8 mesh, and a 4×2 mesh of the 8-virtual-device CPU proxy — plus a
+store-backed degraded-mode phase run under injected faults and a tight
+admission gate, so shed-rate and retry-count ride the row and
+degraded-mode throughput is visible in the trajectory (Graphulo measures
+its degraded mode explicitly; so do we).
+
+One JSON line:
+  {"metric": "rbac_2hop_mesh_degraded_comparison", "value": <single
+   rate>, ..., "mesh_1x8_rate": N, "mesh_4x2_rate": N,
+   "shed_rate": N, "retry_count": N, "faults_injected": N, ...}
+
+CPU-proxy by design (`force_cpu_platform(8)`): sharded throughput has
+never been timed even on the virtual mesh (VERDICT r05 weak #6) — this
+row is that timing, plus the collective-overhead ratio a real multichip
+run will be judged against.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--repos", type=int, default=2000)
+    ap.add_argument("--users", type=int, default=500)
+    ap.add_argument("--batch", type=int, default=32_768)
+    args = ap.parse_args()
+
+    from gochugaru_tpu.utils.platform import force_cpu_platform
+
+    force_cpu_platform(8)
+
+    import jax
+    import numpy as np
+
+    from benchmarks.common import NORTH_STAR_RATE, emit, note
+    from bench import build_world
+    from gochugaru_tpu.engine.device import DeviceEngine
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/gochugaru_xla_cache_h2")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    cs, snap, users, repos, slot = build_world(
+        n_repos=args.repos, n_users=args.users
+    )
+    note(f"world: edges={snap.num_edges} repos={args.repos}")
+    B = args.batch
+    rng = np.random.default_rng(5)
+    q_res = rng.choice(repos, B).astype(np.int32)
+    q_perm = rng.choice(np.array([slot["read"], slot["admin"]], np.int32), B)
+    q_subj = rng.choice(users, B).astype(np.int32)
+
+    def rate_of(engine, label):
+        """Steady-state checks/s of one engine's columnar dispatch."""
+        dsnap = engine.prepare(snap)
+        fn = lambda: engine.check_columns(
+            dsnap, q_res, q_perm, q_subj, now_us=1_700_000_000_000_000
+        )
+        d0, _, _ = fn()  # warm: compile + page-in
+        fn()
+        reps = 6
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn()
+        dt = time.perf_counter() - t0
+        note(f"{label}: {reps * B / dt:,.0f} checks/s granted={int(d0.sum())}")
+        return reps * B / dt
+
+    single_rate = rate_of(DeviceEngine(cs), "single-device")
+
+    mesh_rates = {}
+    for shape in ((1, 8), (4, 2)):
+        key = f"mesh_{shape[0]}x{shape[1]}_rate"
+        try:
+            from gochugaru_tpu.parallel import ShardedEngine, make_mesh
+
+            eng = ShardedEngine(cs, make_mesh(*shape))
+            mesh_rates[key] = round(rate_of(eng, key), 1)
+        except Exception as e:  # mesh unavailable: report, don't die
+            note(f"{key} failed: {type(e).__name__}: {e}")
+            mesh_rates[key] = None
+
+    # ---- degraded-mode phase: client checks under injected faults ------
+    # store-backed world so the full client path (admission gate, retry
+    # envelope, breaker) is the thing being measured
+    from gochugaru_tpu import consistency, rel
+    from gochugaru_tpu.client import (
+        new_tpu_evaluator,
+        with_admission_control,
+        with_latency_mode,
+    )
+    from gochugaru_tpu.utils import faults
+    from gochugaru_tpu.utils import metrics as _metrics
+    from gochugaru_tpu.utils.admission import AdmissionConfig
+    from gochugaru_tpu.utils.context import background
+
+    c = new_tpu_evaluator(
+        with_latency_mode(),
+        with_admission_control(
+            AdmissionConfig(max_inflight=2, breaker_threshold=4)
+        ),
+    )
+    ctx = background()
+    c.write_schema(ctx, """
+    definition user {}
+    definition team { relation member: user }
+    definition org {
+        relation admin: user
+        relation member: user | team#member
+    }
+    definition repo {
+        relation org: org
+        relation maintainer: user | team#member
+        relation reader: user
+        permission admin = org->admin + maintainer
+        permission read = reader + admin + org->member
+    }
+    """)
+    wrng = np.random.default_rng(11)
+    txn = rel.Txn()
+    for i in range(200):
+        txn.touch(rel.must_from_triple(
+            f"repo:r{i}", "reader", f"user:u{wrng.integers(100)}"
+        ))
+        txn.touch(rel.must_from_triple(f"repo:r{i}", "org", "org:o0"))
+    txn.touch(rel.must_from_triple("org:o0", "admin", "user:u0"))
+    c.write(ctx, txn)
+
+    m = _metrics.default
+    base = m.snapshot()
+    # seeded 5%-probability dispatch faults: the degraded mode under test
+    faults.arm("device.dispatch", probability=0.05, seed=42)
+    faults.arm("latency.dispatch", probability=0.05, seed=43)
+
+    import threading
+
+    DB, PER_WORKER, WORKERS = 64, 25, 4
+    checks_done = [0] * WORKERS
+
+    def worker(w):
+        lrng = np.random.default_rng(100 + w)
+        for _ in range(PER_WORKER):
+            qs = [
+                rel.must_from_triple(
+                    f"repo:r{lrng.integers(200)}", "read",
+                    f"user:u{lrng.integers(100)}",
+                )
+                for _ in range(DB)
+            ]
+            c.check(background().with_timeout(30.0), consistency.full(), *qs)
+            checks_done[w] += DB
+
+    c.check(ctx, consistency.full(),
+            rel.must_from_triple("repo:r0", "read", "user:u0"))  # warm
+    t0 = time.perf_counter()
+    ts = [threading.Thread(target=worker, args=(w,)) for w in range(WORKERS)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    degraded_dt = time.perf_counter() - t0
+    faults.reset()
+    snap_m = m.snapshot()
+
+    def delta(key):
+        return snap_m.get(key, 0) - base.get(key, 0)
+
+    total_checks = sum(checks_done)
+    sheds = delta("admission.sheds") + delta("admission.deadline_sheds")
+    retries = delta("retry.retries")
+    injected = delta("faults.injected")
+    degraded_rate = total_checks / degraded_dt
+
+    emit(
+        "rbac_2hop_mesh_degraded_comparison",
+        round(single_rate, 1),
+        "checks/sec",
+        single_rate / NORTH_STAR_RATE,
+        **mesh_rates,
+        degraded_rate=round(degraded_rate, 1),
+        shed_rate=round(sheds / max(total_checks / DB, 1), 4),
+        retry_count=int(retries),
+        faults_injected=int(injected),
+        breaker_trips=int(delta("breaker.trips")),
+        edges=int(snap.num_edges),
+        batch=int(B),
+        platform=jax.default_backend(),
+        note=(
+            "CPU proxy (8 virtual devices); mesh = data x model;"
+            " degraded phase: 5% injected dispatch faults,"
+            " max_inflight=2, 4 workers"
+        ),
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

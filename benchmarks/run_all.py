@@ -105,6 +105,14 @@ def main() -> int:
          + (["--edges", "1000000"] if q else ["--edges", "10000000"]),
          2400),
     ]
+    # appended (not inserted) so the --quick index overrides above keep
+    # pointing at the rows they name
+    configs.append((
+        "2m — config-2 CPU mesh comparison + degraded-mode columns",
+        [py, "benchmarks/bench2_mesh.py"]
+        + (["--repos", "500", "--batch", "8192"] if q else []),
+        900,
+    ))
     if not q:
         # Leopard-scale CPU proxy (VERDICT r04 item 3): the same Watch
         # re-index loop at a 100M-edge base — BASELINE config 5's

@@ -51,7 +51,9 @@ from .rel.txn import Txn
 from .rel.update import Update, UpdateFilter
 from .store.snapshot import Snapshot
 from .store.store import Store, parse_revision
+from .utils import faults
 from .utils import metrics as _metrics
+from .utils.admission import AdmissionConfig, AdmissionController
 from .utils.context import Context
 from .utils.errors import (
     AlreadyExistsError,
@@ -59,6 +61,7 @@ from .utils.errors import (
     OverlapKeyMissingError,
     PartialDeletionError,
     UnavailableError,
+    classify_dispatch_exception,
 )
 from .utils.retry import retry_retriable_errors
 
@@ -88,6 +91,7 @@ class _Options:
         self.use_device = True
         self.profile_dir: Optional[str] = None
         self.latency_mode = False
+        self.admission: Optional[AdmissionConfig] = None
 
 
 Option = Callable[[_Options], None]
@@ -148,6 +152,20 @@ def with_latency_mode() -> Option:
     return opt
 
 
+def with_admission_control(config: AdmissionConfig) -> Option:
+    """Tune the dispatch admission controller (utils/admission.py): the
+    bounded in-flight gate, the deadline-budget shed, and the latency-path
+    circuit breaker.  Admission is ON by default with generous limits;
+    this option tightens or disables it (``max_inflight=0`` no gate,
+    ``breaker_threshold=0`` no breaker, ``deadline_shed=False`` no
+    deadline-budget shedding)."""
+
+    def opt(o: _Options) -> None:
+        o.admission = config
+
+    return opt
+
+
 def with_profiling(trace_dir: str) -> Option:
     """Capture a ``jax.profiler`` trace around every check dispatch into
     ``trace_dir`` and publish a ``checks.device_time_s`` timer — the deep
@@ -182,6 +200,9 @@ class Client:
         self._dsnap_cache: Dict[int, DeviceSnapshot] = {}
         self._oracle_cache: Dict[int, Oracle] = {}
         self._metrics = _metrics.default
+        #: dispatch admission: bounded in-flight gate + deadline budget +
+        #: latency-path circuit breaker (utils/admission.py)
+        self._admission = AdmissionController(o.admission)
 
     # -- store access (shared by watch etc.) -----------------------------
     @property
@@ -314,62 +335,107 @@ class Client:
         self._metrics.inc("checks.requested", len(rels))
 
         def dispatch() -> List[bool]:
-            snap = self._store.snapshot_for(cs)
-            engine = self._engine_for(snap)
-            with self._metrics.timer("checks.dispatch"):
-                if engine is None:
-                    self._metrics.inc("checks.oracle", len(rels))
-                    oracle = self._oracle_for(snap)
-                    return [oracle.check_relationship(r) == T for r in rels]
-                dsnap = self._dsnap_for(engine, snap)
-                if self._profile_dir is not None:
-                    import jax
+            import time as _time
 
-                    self._profile_lock.acquire()
-                    prof = jax.profiler.trace(self._profile_dir)
-                    unlock = self._profile_lock.release
-                else:
-                    prof = contextlib.nullcontext()
-                    unlock = lambda: None
-                try:
-                    with prof, self._metrics.timer("checks.device_time_s"):
-                        d, p, ovf = engine.check_batch(
-                            dsnap, rels, latency=self._latency_mode
-                        )
-                except Exception as e:  # classify device dispatch failures
-                    msg = str(e)
-                    if "RESOURCE_EXHAUSTED" in msg or "UNAVAILABLE" in msg:
-                        raise UnavailableError(msg) from e
-                    raise
-                finally:
-                    unlock()
-                needs_host = (p & ~d) | ovf
-                if not needs_host.any():
-                    self._metrics.inc("checks.device_definite", len(rels))
-                    return [bool(x) for x in d]
-                oracle = self._oracle_for(snap)
-                out = []
-                for i, r in enumerate(rels):
-                    if needs_host[i]:
-                        self._metrics.inc(
-                            "checks.fallback_overflow"
-                            if ovf[i]
-                            else "checks.fallback_conditional"
-                        )
-                        try:
-                            out.append(oracle.check_relationship(r) == T)
-                        except Exception as e:
-                            # per-item error: abort with partial results,
-                            # mirroring the reference's bulk mapping loop
-                            # (client/client.go:279-283).  Not retriable —
-                            # the reference retries the RPC, not the
-                            # per-item mapping
-                            raise BulkCheckItemError(i, out, e) from e
-                    else:
-                        out.append(bool(d[i]))
-                return out
+            adm = self._admission
+            # deadline budget: a dispatch that cannot finish inside the
+            # context deadline sheds BEFORE any snapshot/device work
+            adm.check_deadline(ctx)
+            t_disp = _time.perf_counter()
+            with adm.gate.admit():
+                out = self._dispatch_admitted(ctx, cs, rels)
+            adm.observe_cost(_time.perf_counter() - t_disp)
+            return out
 
         return retry_retriable_errors(ctx, dispatch)
+
+    def _dispatch_admitted(
+        self, ctx: Context, cs: Strategy, rels: List[Relationship]
+    ) -> List[bool]:
+        """One admitted check dispatch (inside the gate, one retry
+        attempt): snapshot selection, device dispatch with classified
+        failures feeding the circuit breaker, host-oracle resolution."""
+        adm = self._admission
+        snap = self._store.snapshot_for(cs)
+        engine = self._engine_for(snap)
+        with self._metrics.timer("checks.dispatch"):
+            if engine is None:
+                self._metrics.inc("checks.oracle", len(rels))
+                oracle = self._oracle_for(snap)
+                return [oracle.check_relationship(r) == T for r in rels]
+            dsnap = self._dsnap_for(engine, snap)
+            if self._profile_dir is not None:
+                import jax
+
+                self._profile_lock.acquire()
+                prof = jax.profiler.trace(self._profile_dir)
+                unlock = self._profile_lock.release
+            else:
+                prof = contextlib.nullcontext()
+                unlock = lambda: None
+            # circuit breaker: after consecutive transient dispatch
+            # failures, latency-mode traffic reroutes onto the batch
+            # path until the breaker half-opens a probe
+            use_latency = self._latency_mode and adm.breaker.allow_latency()
+            if self._latency_mode and not use_latency:
+                self._metrics.inc("breaker.latency_rerouted")
+            # a latency-mode call may silently fall back to the batch path
+            # (batch beyond the top tier, no flat tables, ...): the probe
+            # flag fed to the breaker must reflect whether the latency
+            # path actually SERVED, so read its dispatch counter around
+            # the call (per-snapshot counter; a concurrent same-snapshot
+            # dispatch can inflate it, which at worst closes the breaker
+            # on that other dispatch's success — still a latency success)
+            lp = dsnap.latency_path if use_latency else None
+            lp_n = lp.dispatch_count if lp is not None else 0
+            try:
+                with prof, self._metrics.timer("checks.device_time_s"):
+                    d, p, ovf = engine.check_batch(
+                        dsnap, rels, latency=use_latency
+                    )
+            except Exception as e:  # classify device dispatch failures
+                classified = classify_dispatch_exception(e)
+                if isinstance(classified, UnavailableError):
+                    adm.breaker.record_failure()
+                    if classified is e:
+                        raise
+                    raise classified
+                raise
+            else:
+                lp2 = dsnap.latency_path
+                served_latency = (
+                    use_latency
+                    and lp2 is not None
+                    and lp2.dispatch_count > lp_n
+                )
+                adm.breaker.record_success(probe=served_latency)
+            finally:
+                unlock()
+            needs_host = (p & ~d) | ovf
+            if not needs_host.any():
+                self._metrics.inc("checks.device_definite", len(rels))
+                return [bool(x) for x in d]
+            oracle = self._oracle_for(snap)
+            out = []
+            for i, r in enumerate(rels):
+                if needs_host[i]:
+                    self._metrics.inc(
+                        "checks.fallback_overflow"
+                        if ovf[i]
+                        else "checks.fallback_conditional"
+                    )
+                    try:
+                        out.append(oracle.check_relationship(r) == T)
+                    except Exception as e:
+                        # per-item error: abort with partial results,
+                        # mirroring the reference's bulk mapping loop
+                        # (client/client.go:279-283).  Not retriable —
+                        # the reference retries the RPC, not the
+                        # per-item mapping
+                        raise BulkCheckItemError(i, out, e) from e
+                else:
+                    out.append(bool(d[i]))
+            return out
 
     # ------------------------------------------------------------------
     # Reads (client/client.go:286-315)
@@ -438,12 +504,27 @@ class Client:
     def updates(self, ctx: Context, f: UpdateFilter) -> Iterator[Update]:
         return self.updates_since_revision(ctx, f, "")
 
+    #: consecutive no-progress stream faults tolerated before the watch
+    #: surfaces the UnavailableError to its consumer — bounded so a
+    #: permanently-faulted stream classifies instead of spinning forever
+    WATCH_MAX_RESUMES = 64
+
     def updates_since_revision(
         self, ctx: Context, f: UpdateFilter, revision: str
     ) -> Iterator[Update]:
         """Subscribe to ordered, filtered, resumable updates.  Cancel via
         the context, exactly like the reference's Watch loop
-        (client/client.go:394-411)."""
+        (client/client.go:394-411).
+
+        Resume-on-fault: a transient stream failure (``UnavailableError``
+        from the store or the ``watch.stream`` injection site) does not
+        surface to the consumer — the subscription re-subscribes from the
+        last delivered cursor with exactly-once delivery.  The cursor is
+        (last fully-delivered revision, raw updates delivered of the
+        partially-delivered revision), tracked pre-filter so filtered
+        streams resume at the right raw position; redelivered prefixes
+        are skipped, so no event is lost or duplicated across stream
+        breaks."""
         self._check_overlap(ctx)
         if f.object_types and f.relationship_filters:
             raise ValueError(
@@ -456,33 +537,49 @@ class Client:
         since = parse_revision(revision) if revision else self._store.head_revision
         stop = threading.Event()
 
-        def watch() -> Iterator[Update]:
+        def gen() -> Iterator[Update]:
+            base = since  # every revision ≤ base fully delivered
+            part_rev: Optional[int] = None  # revision partially delivered
+            part_n = 0  # raw updates of part_rev already delivered
+            no_progress = 0
             try:
-                for _rev, u in self._store.updates_since(
-                    since, stop=stop, poll_interval=0.05, cancelled=ctx.done
-                ):
+                while True:
                     if ctx.done():
                         return
-                    if f.admits(u):
-                        yield u
-                    if ctx.done():
-                        return
+                    skip_rev, to_skip, skipped = part_rev, part_n, 0
+                    try:
+                        for rev, u in self._store.updates_since(
+                            base, stop=stop, poll_interval=0.05,
+                            cancelled=ctx.done,
+                        ):
+                            if ctx.done():
+                                return
+                            if rev != part_rev:
+                                if part_rev is not None:
+                                    # moved past it → fully delivered
+                                    base = part_rev
+                                part_rev, part_n = rev, 0
+                            if rev == skip_rev and skipped < to_skip:
+                                # redelivered prefix of the partially-
+                                # delivered revision: already consumed
+                                skipped += 1
+                                continue
+                            faults.fire("watch.stream")
+                            part_n += 1
+                            no_progress = 0
+                            if f.admits(u):
+                                yield u
+                        return  # stream ended: stop set or ctx cancelled
+                    except UnavailableError:
+                        self._metrics.inc("watch.resumes")
+                        no_progress += 1
+                        if no_progress > self.WATCH_MAX_RESUMES:
+                            raise
+                        # brief context-aware pause, then re-subscribe
+                        # from the (base, part_n) cursor
+                        ctx.wait(min(0.002 * no_progress, 0.05))
             finally:
                 stop.set()
-
-        # poll the context from the consuming thread between items; the
-        # stop event ends the store-side wait loop
-        def gen() -> Iterator[Update]:
-            it = watch()
-            while True:
-                if ctx.done():
-                    stop.set()
-                    return
-                try:
-                    u = next(it)
-                except StopIteration:
-                    return
-                yield u
 
         return gen()
 
@@ -753,3 +850,4 @@ NewPlaintext = new_plaintext
 NewSystemTLS = new_system_tls
 WithOverlapRequired = with_overlap_required
 WithLatencyMode = with_latency_mode
+WithAdmissionControl = with_admission_control

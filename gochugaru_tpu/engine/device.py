@@ -49,6 +49,10 @@ from ..caveats.device import (
 from ..rel.relationship import Relationship, WILDCARD_ID
 from ..schema.compiler import CompiledSchema
 from ..store.snapshot import Snapshot
+from ..utils import faults
+from ..utils.context import background as _background
+from ..utils.errors import classify_dispatch_exception
+from ..utils.retry import retry_retriable_errors
 from .plan import DevicePlan, EngineConfig, build_plan
 
 #: edge-count floor for the prepare-time lookup-index prewarm thread:
@@ -760,6 +764,7 @@ class DeviceEngine:
         incremental path first: base tables stay resident, only small
         ``dl_*`` overlays ship (engine/flat.py build_delta_arrays) — the
         Watch-driven re-index costs O(delta), not O(E), per revision."""
+        faults.fire("device.prepare")
         if prev is not None:
             out = self._prepare_delta(snap, prev)
             if out is not None:
@@ -1029,6 +1034,11 @@ class DeviceEngine:
                     dsnap.latency_path = LatencyPath(self, dsnap)
         return dsnap.latency_path
 
+    #: bounded retries for the deadline-less engine-level latency entry
+    #: point (callers with a Context pass their own; the envelope itself
+    #: is the client's, utils/retry.py)
+    LATENCY_RETRY_TRIES = 3
+
     def check_columns_latency(
         self,
         dsnap: DeviceSnapshot,
@@ -1041,21 +1051,43 @@ class DeviceEngine:
         q_ctx: Optional[np.ndarray] = None,
         qctx_rows: Optional[Sequence[Mapping[str, Any]]] = None,
         now_us: Optional[int] = None,
+        ctx: Optional[Any] = None,
     ):
         """Latency-mode bulk check from pre-interned columns: pinned
         kernel, tiered padding, per-stage budget metrics.  Falls back to
         ``check_columns`` when the latency path cannot serve the batch
         (no flat tables, too many distinct permissions, batch beyond the
-        top tier) — same result contract either way."""
-        out = self.latency_path(dsnap).dispatch_columns(
-            q_res, q_perm, q_subj, q_srel=q_srel, q_wc=q_wc,
-            q_ctx=q_ctx, qctx_rows=qctx_rows, now_us=now_us,
-        )
-        if out is not None:
-            return out
-        return self.check_columns(
-            dsnap, q_res, q_perm, q_subj, q_srel=q_srel, q_wc=q_wc,
-            q_ctx=q_ctx, qctx_rows=qctx_rows, now_us=now_us,
+        top tier) — same result contract either way.
+
+        Failure contract now matches the batch path (client.py check):
+        raw dispatch errors are classified onto the retry taxonomy
+        (transient → ``UnavailableError``) and transient failures retry
+        under the reference's backoff envelope — bounded by ``ctx`` when
+        given, else by ``LATENCY_RETRY_TRIES`` so a deadline-less bench
+        caller cannot hang on a persistent fault."""
+
+        def dispatch():
+            try:
+                out = self.latency_path(dsnap).dispatch_columns(
+                    q_res, q_perm, q_subj, q_srel=q_srel, q_wc=q_wc,
+                    q_ctx=q_ctx, qctx_rows=qctx_rows, now_us=now_us,
+                )
+                if out is not None:
+                    return out
+                return self.check_columns(
+                    dsnap, q_res, q_perm, q_subj, q_srel=q_srel, q_wc=q_wc,
+                    q_ctx=q_ctx, qctx_rows=qctx_rows, now_us=now_us,
+                )
+            except Exception as e:
+                classified = classify_dispatch_exception(e)
+                if classified is None or classified is e:
+                    raise
+                raise classified
+
+        return retry_retriable_errors(
+            ctx if ctx is not None else _background(),
+            dispatch,
+            max_tries=None if ctx is not None else self.LATENCY_RETRY_TRIES,
         )
 
     # -- flat-kernel plumbing (engine/flat.py) ---------------------------
@@ -1163,6 +1195,7 @@ class DeviceEngine:
         if not rels:
             z = np.zeros(0, bool)
             return z, z, z
+        faults.fire("device.dispatch")
         import time as _time
 
         t_lower = _time.perf_counter()
@@ -1352,6 +1385,7 @@ class DeviceEngine:
         materializing *sliced* views of jit outputs degrades every
         subsequent dispatch on remote-attached platforms.
         """
+        faults.fire("device.dispatch")
         snap = dsnap.snapshot
         B = q_res.shape[0]
         BP = _ceil_pow2(B, max(bucket_min, self.config.batch_bucket_min))

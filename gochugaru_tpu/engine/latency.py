@@ -54,6 +54,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from ..utils import faults
 from ..utils import metrics as _metrics
 from .flat import QM_ROWS, fill_qm
 
@@ -106,6 +107,11 @@ class LatencyPath:
         #: XLA compilations this path actually paid for (engine-cache
         #: misses) — the no-retrace assertion's subject
         self.compile_count = 0
+        #: dispatches this path actually SERVED (not fallbacks) — the
+        #: client reads it around check_batch to learn whether a
+        #: latency-mode call really ran on this path (the breaker's
+        #: half-open probe must not close on a silent batch fallback)
+        self.dispatch_count = 0
         #: number of pinned-executable entries (incl. engine-cache hits)
         self.pin_count = 0
         self.last_budget: Optional[DispatchBudget] = None
@@ -253,6 +259,9 @@ class LatencyPath:
         )
         if len(slots) > self.engine.config.flat_max_slots:
             return None
+        # injection site AFTER the availability checks: a batch this path
+        # would decline falls back without ever reaching the fault
+        faults.fire("latency.dispatch")
 
         # ---- stage 1: host lowering (pack into the staging buffer) -----
         # the staging buffer is shared per tier: hold the path lock from
@@ -310,6 +319,7 @@ class LatencyPath:
             total_s=t4 - t0, compiled=fresh,
         )
         self.last_budget = budget
+        self.dispatch_count += 1
         m = self._m
         m.inc("latency.dispatches")
         if not fresh:

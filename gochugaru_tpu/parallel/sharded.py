@@ -31,6 +31,16 @@ try:  # jax ≥ 0.8
 except ImportError:  # pragma: no cover — older jax
     from jax.experimental.shard_map import shard_map
 
+import inspect
+
+#: the replication-check kwarg was renamed check_rep → check_vma across
+#: jax versions; feature-detect so both signatures disable it
+_SHARD_MAP_NO_CHECK = (
+    {"check_vma": False}
+    if "check_vma" in inspect.signature(shard_map).parameters
+    else {"check_rep": False}
+)
+
 from ..engine.device import (
     DeviceEngine,
     DeviceSnapshot,
@@ -43,6 +53,7 @@ from ..engine.plan import EngineConfig
 from ..rel.relationship import Relationship
 from ..schema.compiler import CompiledSchema
 from ..store.snapshot import Snapshot
+from ..utils import faults
 from .mesh import DATA_AXIS, MODEL_AXIS
 
 
@@ -88,7 +99,7 @@ class ShardedEngine(DeviceEngine):
         self._fn = jax.jit(
             shard_map(
                 raw, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                check_vma=False,
+                **_SHARD_MAP_NO_CHECK,
             )
         )
         #: shard_mapped flat kernels per (slots, FlatMeta, array keys)
@@ -137,7 +148,7 @@ class ShardedEngine(DeviceEngine):
             shard_map(
                 raw, mesh=self.mesh, in_specs=in_specs,
                 out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-                check_vma=False,
+                **_SHARD_MAP_NO_CHECK,
             )
         )
         while len(self._flat_sharded_fns) >= self.FLAT_FN_CACHE_MAX:
@@ -257,6 +268,7 @@ class ShardedEngine(DeviceEngine):
         """Dispatch over the bucket-sharded flat tables: queries partition
         along the data axis; the kernel's probe sites OR-reduce over the
         model axis internally (engine/flat.py make_flat_fn with axis)."""
+        faults.fire("sharded.collective")
         snap = dsnap.snapshot
         D = self.data_size
         B = queries["q_res"].shape[0]
@@ -349,6 +361,7 @@ class ShardedEngine(DeviceEngine):
         here per shard.  With ``fetch=False`` the raw padded sharded
         device outputs (length BP ≥ B) are returned for pipelined
         dispatch, mirroring DeviceEngine.check_columns."""
+        faults.fire("sharded.dispatch")
         if dsnap.flat_meta is not None:
             return self._dispatch_flat(
                 dsnap, queries, qctx, now_us, fetch, bucket_min=bucket_min
@@ -391,6 +404,7 @@ class ShardedEngine(DeviceEngine):
             u_qctx[s * UP : s * UP + n] = uniq[:, 3]
         q["q_row"] = rows
 
+        faults.fire("sharded.collective")
         now = jnp.int32(snap.now_rel32(now_us))
         dsh = NamedSharding(self.mesh, P(DATA_AXIS))
         rep = NamedSharding(self.mesh, P())

@@ -422,6 +422,11 @@ def finish_snapshot(
     by (rel, res, subj, srel1).  Shared by the full build above and the
     incremental delta path (store/delta.py), so both produce identical
     snapshots by construction."""
+    from ..utils import faults
+
+    # injection site: both the full build and the delta path funnel
+    # through here, so one armed site covers every snapshot construction
+    faults.fire("snapshot.finish")
     node_type = interner.node_type_array()
     num_nodes = max(len(interner), 1)
     num_slots = max(compiled.num_slots, 1)

@@ -47,6 +47,7 @@ from ..rel.update import Update, UpdateType
 from ..schema import CompiledSchema, compile_schema, parse_schema
 from ..native.sort import lexsort2, lexsort4
 from ..schema.compiler import SchemaValidationError
+from ..utils import faults
 from ..utils.errors import (
     AlreadyExistsError,
     PreconditionFailedError,
@@ -991,6 +992,10 @@ class Store:
             return self._head_rev
 
     def _materialize_locked(self, rev: int) -> Snapshot:
+        # injection site: a snapshot swap that fails mid-materialization
+        # leaves prior generations untouched (RCU semantics) — callers see
+        # a transient error and retry against the old generation or later
+        faults.fire("store.materialize")
         snap = self._delta_materialize_locked(rev)
         if snap is None and self._segments:
             snap = self._materialize_columnar_locked(rev)
@@ -1096,6 +1101,7 @@ class Store:
     def snapshot_for(self, strategy: Strategy) -> Snapshot:
         """Select (materializing if needed) the snapshot generation a
         request evaluates at (consistency/consistency.go:29-77)."""
+        faults.fire("store.snapshot_for")
         with self._lock:
             self._require_schema()
             req = strategy.requirement

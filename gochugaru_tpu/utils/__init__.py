@@ -1,4 +1,5 @@
-"""Cross-cutting utilities: Context, error taxonomy, retry, metrics."""
+"""Cross-cutting utilities: Context, error taxonomy, retry, metrics,
+fault injection, admission control."""
 
 from .context import Context, background, todo
 from .errors import (
@@ -7,7 +8,9 @@ from .errors import (
     PreconditionFailedError,
     AlreadyExistsError,
     RevisionUnavailableError,
+    ShedError,
     UnavailableError,
+    classify_dispatch_exception,
 )
 from .retry import retry_retriable_errors
 
@@ -16,10 +19,12 @@ __all__ = [
     "background",
     "todo",
     "UnavailableError",
+    "ShedError",
     "DeadlineExceededError",
     "PermanentError",
     "PreconditionFailedError",
     "AlreadyExistsError",
     "RevisionUnavailableError",
+    "classify_dispatch_exception",
     "retry_retriable_errors",
 ]

@@ -1,0 +1,264 @@
+"""Admission control for the dispatch path: bounded in-flight gate,
+deadline-budget shedding, and a circuit breaker for the latency path.
+
+The north star is a serving system, and a serving system's failure mode
+under overload must be *load shedding*, not queue growth: a dispatch
+gate that refuses work with ``ShedError`` (an ``UnavailableError``
+subclass) converts overload into client-side exponential backoff through
+the existing retry envelope — the same contract a gRPC server states by
+returning ``codes.Unavailable``.  Samyama's unified in-database design
+(PAPERS.md) leans on exactly this to keep hardware-accelerated paths
+honest under overload; Graphulo benchmarks the degraded mode explicitly.
+
+Three mechanisms, composed by the client (client.py ``check``):
+
+- **DispatchGate** — a bounded in-flight counter.  ``admit()`` raises
+  ``ShedError`` when ``max_inflight`` dispatches are already in the
+  engine; no queueing, no blocking.  Counter: ``admission.sheds``.
+- **Deadline budget** — ``check_deadline`` sheds a dispatch whose
+  context deadline cannot cover the expected dispatch cost (client-local
+  EWMA of recent dispatch times, floored by ``deadline_floor_s``): a
+  check that would blow its deadline is rejected before H2D, not after
+  the kernel has burned the budget.  Counter:
+  ``admission.deadline_sheds``.
+- **CircuitBreaker** — trips OPEN after ``breaker_threshold``
+  *consecutive* transient dispatch failures; while open, latency-mode
+  traffic routes back to the batch path (the latency path's pinned
+  kernels and staging buffers are the most state-coupled dispatch
+  surface, so it is first to lose trust).  After ``breaker_cooldown_s``
+  the breaker HALF-OPENs and admits probes; one success closes it, one
+  failure re-trips.  Counters: ``breaker.trips``, ``breaker.half_opens``,
+  ``breaker.closes``; gauge ``breaker.state`` (0/1/2 =
+  closed/half-open/open).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from . import metrics as _metrics
+from .context import Context
+from .errors import DeadlineExceededError, ShedError
+
+#: breaker states (also the ``breaker.state`` gauge values)
+CLOSED, HALF_OPEN, OPEN = 0, 1, 2
+
+#: EWMA weight of the newest dispatch-cost sample
+_EWMA_ALPHA = 0.2
+
+
+@dataclass(frozen=True)
+class AdmissionConfig:
+    """Tuning for the client's admission controller."""
+
+    #: concurrent dispatches admitted before shedding (0 disables the gate)
+    max_inflight: int = 64
+    #: consecutive transient dispatch failures that trip the breaker
+    #: (0 disables the breaker)
+    breaker_threshold: int = 5
+    #: seconds OPEN before the breaker half-opens a probe
+    breaker_cooldown_s: float = 0.25
+    #: floor on the expected-dispatch-cost estimate used for deadline
+    #: shedding; 0.0 means "shed only on observed history" (a fresh
+    #: client never deadline-sheds until it has its own samples)
+    deadline_floor_s: float = 0.0
+    #: False disables deadline-budget shedding entirely (requests whose
+    #: deadline already passed still fail in the retry envelope itself)
+    deadline_shed: bool = True
+
+
+class DispatchGate:
+    """Bounded in-flight dispatch counter.  Shed-don't-queue: a full gate
+    raises immediately so the caller's retry envelope backs off instead
+    of this layer buffering unboundedly."""
+
+    def __init__(
+        self, max_inflight: int, registry: Optional[_metrics.Metrics] = None
+    ) -> None:
+        self.max_inflight = max_inflight
+        self._m = registry or _metrics.default
+        self._lock = threading.Lock()
+        self._inflight = 0
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    @contextmanager
+    def admit(self):
+        if self.max_inflight > 0:
+            with self._lock:
+                if self._inflight >= self.max_inflight:
+                    self._m.inc("admission.sheds")
+                    raise ShedError(
+                        f"dispatch admission: {self._inflight} in-flight"
+                        f" >= max_inflight {self.max_inflight}"
+                    )
+                self._inflight += 1
+                self._m.set_gauge("admission.inflight", self._inflight)
+        try:
+            yield
+        finally:
+            if self.max_inflight > 0:
+                with self._lock:
+                    self._inflight -= 1
+                    self._m.set_gauge("admission.inflight", self._inflight)
+
+
+class CircuitBreaker:
+    """Consecutive-transient-failure breaker gating the latency path.
+
+    ``allow_latency()`` answers "may this dispatch use the latency-mode
+    path right now"; ``record_success``/``record_failure`` feed it from
+    dispatch outcomes.  ``clock`` is injectable so tests drive the
+    cooldown deterministically."""
+
+    def __init__(
+        self,
+        threshold: int,
+        cooldown_s: float,
+        registry: Optional[_metrics.Metrics] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self._m = registry or _metrics.default
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._m.set_gauge("breaker.state", CLOSED)
+
+    @property
+    def state(self) -> int:
+        with self._lock:
+            return self._state
+
+    def allow_latency(self) -> bool:
+        if self.threshold <= 0:
+            return True
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if self._clock() - self._opened_at >= self.cooldown_s:
+                    self._state = HALF_OPEN
+                    self._m.inc("breaker.half_opens")
+                    self._m.set_gauge("breaker.state", HALF_OPEN)
+                    return True  # this dispatch is the probe
+                return False
+            return True  # HALF_OPEN: probes flow until an outcome lands
+
+    def record_success(self, probe: bool = False) -> None:
+        """Feed one successful dispatch.  ``probe`` says the dispatch
+        actually ran on the latency path: only a successful latency
+        *probe* may close an open breaker — a batch-path success says
+        nothing about the latency path's health, so while OPEN the
+        breaker keeps rerouting until the half-open probe succeeds."""
+        if self.threshold <= 0:
+            return
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._state == HALF_OPEN and probe:
+                self._state = CLOSED
+                self._m.inc("breaker.closes")
+                self._m.set_gauge("breaker.state", CLOSED)
+
+    def record_failure(self) -> None:
+        """Feed one *transient* dispatch failure (callers classify first:
+        permanent errors say nothing about path health)."""
+        if self.threshold <= 0:
+            return
+        with self._lock:
+            self._consecutive_failures += 1
+            if self._state == HALF_OPEN:
+                # failed probe: straight back to OPEN, fresh cooldown
+                self._state = OPEN
+                self._opened_at = self._clock()
+                self._m.inc("breaker.trips")
+                self._m.set_gauge("breaker.state", OPEN)
+            elif (
+                self._state == CLOSED
+                and self._consecutive_failures >= self.threshold
+            ):
+                self._state = OPEN
+                self._opened_at = self._clock()
+                self._m.inc("breaker.trips")
+                self._m.set_gauge("breaker.state", OPEN)
+
+
+class AdmissionController:
+    """The client-facing bundle: gate + breaker + deadline budget."""
+
+    def __init__(
+        self,
+        config: Optional[AdmissionConfig] = None,
+        registry: Optional[_metrics.Metrics] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.config = config or AdmissionConfig()
+        self._m = registry or _metrics.default
+        self._clock = clock
+        self.gate = DispatchGate(self.config.max_inflight, registry=self._m)
+        self.breaker = CircuitBreaker(
+            self.config.breaker_threshold,
+            self.config.breaker_cooldown_s,
+            registry=self._m,
+            clock=clock,
+        )
+        self._lock = threading.Lock()
+        #: client-local EWMA of dispatch cost (seconds); None until the
+        #: first sample so a fresh client never sheds on other clients'
+        #: history
+        self._cost_ewma: Optional[float] = None
+
+    # -- deadline budget -------------------------------------------------
+    def expected_cost_s(self) -> float:
+        with self._lock:
+            ewma = self._cost_ewma
+        return max(self.config.deadline_floor_s, ewma or 0.0)
+
+    def observe_cost(self, seconds: float) -> None:
+        with self._lock:
+            if self._cost_ewma is None:
+                self._cost_ewma = seconds
+            else:
+                self._cost_ewma += _EWMA_ALPHA * (seconds - self._cost_ewma)
+
+    def check_deadline(self, ctx: Context) -> None:
+        """Shed a dispatch whose deadline cannot cover the expected cost
+        — before any device work (pre-H2D), not after the kernel has
+        spent the budget.  Raises ``DeadlineExceededError`` (classified,
+        retriable; the retry envelope converts it into a bounded wait
+        that expires exactly at the context deadline).
+
+        Every shed HALVES the estimate: the EWMA learns from admitted
+        dispatches only, and a one-off cold-start outlier (snapshot
+        materialization, first-compile) must not lock deadline-bearing
+        traffic out forever — after a few decaying sheds the estimate
+        drops under real deadlines and requests flow again, re-teaching
+        the EWMA from warm samples."""
+        if not self.config.deadline_shed:
+            return
+        dl = ctx.deadline()
+        if dl is None:
+            return
+        remaining = dl - self._clock()
+        est = self.expected_cost_s()
+        if remaining <= 0 or (est > 0.0 and remaining < est):
+            if remaining > 0:
+                # the ESTIMATE caused this shed: decay it
+                with self._lock:
+                    if self._cost_ewma is not None:
+                        self._cost_ewma /= 2.0
+            self._m.inc("admission.deadline_sheds")
+            raise DeadlineExceededError(
+                f"deadline budget: {max(remaining, 0.0) * 1000:.1f} ms remain,"
+                f" dispatch expected to take {est * 1000:.1f} ms"
+            )

@@ -20,6 +20,16 @@ class UnavailableError(AuthzError):
     (the local analogue of gRPC ``codes.Unavailable``)."""
 
 
+class ShedError(UnavailableError):
+    """Admission control refused the request before dispatch (bounded
+    in-flight gate full, or the deadline budget cannot cover a dispatch).
+    A subclass of ``UnavailableError`` ON PURPOSE: a shed engages the
+    existing retry/backoff envelope — load-shedding converts queue growth
+    into client-side backoff instead of unbounded buffering, the same
+    move gRPC servers make by returning ``codes.Unavailable`` under
+    overload."""
+
+
 class DeadlineExceededError(AuthzError):
     """The context deadline passed (gRPC ``codes.DeadlineExceeded``)."""
 
@@ -89,6 +99,30 @@ class OverlapKeyMissingError(RuntimeError):
 
     def __init__(self) -> None:
         super().__init__("failed to configure required overlap key for request")
+
+
+#: Substrings marking a raw device/runtime failure as transient — the
+#: XLA/jax analogues of gRPC Unavailable: allocator pressure and
+#: backend/transfer hiccups retry; everything else is a real bug.
+TRANSIENT_DISPATCH_MARKERS = ("RESOURCE_EXHAUSTED", "UNAVAILABLE", "ABORTED")
+
+
+def classify_dispatch_exception(err: BaseException):
+    """Map a raw engine/JAX dispatch failure onto the retry taxonomy.
+
+    Returns an ``UnavailableError`` (with ``err`` as cause) when the
+    failure carries a transient marker, ``err`` itself when it is
+    already a classified ``AuthzError``, and None when it is neither —
+    the caller re-raises unclassifiable errors unchanged so genuine bugs
+    keep their tracebacks."""
+    if isinstance(err, AuthzError):
+        return err
+    msg = str(err)
+    if any(m in msg for m in TRANSIENT_DISPATCH_MARKERS):
+        e = UnavailableError(msg)
+        e.__cause__ = err
+        return e
+    return None
 
 
 def is_retriable(err: BaseException) -> bool:

@@ -35,10 +35,21 @@ class Metrics:
         self._counters: Dict[str, float] = defaultdict(float)
         self._timings: Dict[str, list] = defaultdict(lambda: [0, 0.0])  # [n, total_s]
         self._samples: Dict[str, list] = defaultdict(list)  # ring of raw seconds
+        self._gauges: Dict[str, float] = {}  # last-set values (breaker state)
 
     def inc(self, name: str, delta: float = 1.0) -> None:
         with self._lock:
             self._counters[name] += delta
+
+    def set_gauge(self, name: str, value: float) -> None:
+        """Last-write-wins instantaneous value (e.g. ``breaker.state``:
+        0=closed, 1=half-open, 2=open; ``admission.inflight``)."""
+        with self._lock:
+            self._gauges[name] = value
+
+    def gauge(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._gauges.get(name, default)
 
     def observe(self, name: str, seconds: float) -> None:
         with self._lock:
@@ -80,6 +91,7 @@ class Metrics:
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             out = dict(self._counters)
+            out.update(self._gauges)
             samples = {k: sorted(v) for k, v in self._samples.items() if v}
             for k, (n, total) in self._timings.items():
                 out[f"{k}.count"] = n
@@ -96,6 +108,7 @@ class Metrics:
             self._counters.clear()
             self._timings.clear()
             self._samples.clear()
+            self._gauges.clear()
 
 
 #: Process-global default registry.

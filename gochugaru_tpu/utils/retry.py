@@ -3,6 +3,16 @@
 Mirrors the reference's envelope exactly: initial 50 ms, max interval 2 s,
 multiplier 1.5, randomization factor 0.5 (client/client.go:205-210 with
 cenkalti/backoff defaults), bounded by the context deadline.
+
+Cancellation-honesty contract (tests/test_retry.py):
+- the default backoff pause is the *context-aware* ``ctx.wait``, so a
+  cancellation arriving mid-backoff interrupts the pause instead of
+  waiting it out;
+- ``ctx.err()`` is re-checked immediately after every pause, so a
+  cancellation or deadline that landed during the backoff surfaces
+  before the next ``fn()`` attempt, never after it;
+- a deadline clamp that produces ``pause == 0`` skips the sleep call
+  entirely (an injected fake sleep must not observe zero-length pauses).
 """
 
 from __future__ import annotations
@@ -11,6 +21,7 @@ import random
 import time
 from typing import Callable, Optional, TypeVar
 
+from . import metrics as _metrics
 from .context import Context
 from .errors import DeadlineExceededError, PermanentError, is_retriable
 
@@ -26,12 +37,15 @@ def retry_retriable_errors(
     ctx: Context,
     fn: Callable[[], T],
     *,
-    sleep: Callable[[float], None] = time.sleep,
+    sleep: Optional[Callable[[float], None]] = None,
     max_tries: Optional[int] = None,
 ) -> T:
     """Run ``fn`` until it succeeds or fails permanently
-    (client/client.go:193-211).  ``max_tries`` is an escape hatch for tests;
-    the reference bounds retries only by the context."""
+    (client/client.go:193-211).  ``max_tries`` is an escape hatch for tests
+    and deadline-less engine paths; the reference bounds retries only by
+    the context.  ``sleep`` overrides the backoff pause (tests inject a
+    fake); the default pause is ``ctx.wait`` so cancellation interrupts
+    the backoff."""
     interval = INITIAL_INTERVAL
     tries = 0
     while True:
@@ -56,5 +70,17 @@ def retry_retriable_errors(
             if dl is not None:
                 # Never sleep past the deadline (backoff.WithContext behavior).
                 pause = min(pause, max(dl - time.monotonic(), 0.0))
-            sleep(pause)
+            _metrics.default.inc("retry.retries")
+            if pause > 0.0:
+                if sleep is not None:
+                    sleep(pause)
+                else:
+                    # context-aware pause: returns early on cancellation
+                    ctx.wait(pause)
+            # re-check immediately after the pause: a cancellation or
+            # deadline that landed during the backoff must surface before
+            # the next fn() attempt
+            err = ctx.err()
+            if err is not None:
+                raise err
             interval = min(interval * MULTIPLIER, MAX_INTERVAL)

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Chaos smoke: the fault-matrix soak (tests/test_chaos.py) with a fixed
+# seed under the tier-1 timeout.  Tier-1-compatible by construction: the
+# soak carries no `slow` marker, so `-m 'not slow'` (the tier-1 filter)
+# selects it — this wrapper exists for running the matrix alone, fast,
+# with reproducible parameters.
+#
+# Usage:
+#   scripts/chaos_smoke.sh                 # fixed default seed, 30 rounds
+#   GOCHUGARU_CHAOS_SEED=7 scripts/chaos_smoke.sh   # another fault schedule
+#   GOCHUGARU_CHAOS_ROUNDS=100 scripts/chaos_smoke.sh  # longer soak
+set -o pipefail
+
+cd "$(dirname "$0")/.."
+
+: "${GOCHUGARU_CHAOS_SEED:=20260803}"
+: "${GOCHUGARU_CHAOS_ROUNDS:=30}"
+: "${CHAOS_TIMEOUT_S:=600}"
+
+export GOCHUGARU_CHAOS_SEED GOCHUGARU_CHAOS_ROUNDS
+
+echo "# chaos smoke: seed=${GOCHUGARU_CHAOS_SEED} rounds=${GOCHUGARU_CHAOS_ROUNDS}" >&2
+timeout -k 10 "${CHAOS_TIMEOUT_S}" \
+    env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_faults.py tests/test_retry.py \
+    -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+rc=$?
+if [ "$rc" -eq 0 ]; then
+    echo "# chaos smoke: PASS" >&2
+else
+    echo "# chaos smoke: FAIL rc=${rc} (reproduce with the same GOCHUGARU_CHAOS_SEED)" >&2
+fi
+exit "$rc"

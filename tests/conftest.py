@@ -37,3 +37,17 @@ if _env_aligned is not None:
         return _orig_for_schema(compiled, **overrides)
 
     EngineConfig.for_schema = staticmethod(_for_schema_aligned)
+
+
+# Fault-injection hygiene: no test may leak an armed injection site into
+# the next (utils/faults.py is a process-global registry by design).
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    from gochugaru_tpu.utils import faults
+
+    faults.reset()
+    yield
+    faults.reset()

@@ -1,0 +1,213 @@
+"""Chaos soak: a mixed Check/Write/Watch workload under seeded random
+fault injection (utils/faults.py), asserting the system's end-to-end
+robustness contract:
+
+- every returned check result matches the host oracle exactly;
+- no watch event is lost or duplicated across injected stream breaks;
+- every failure that surfaces is a classified ``AuthzError`` — never a
+  raw JAX traceback;
+- no hang: every round completes within its context deadline or sheds
+  with ``UnavailableError``.
+
+Deterministic by construction: the workload RNG and every fault policy
+RNG are seeded from ``GOCHUGARU_CHAOS_SEED`` (default 20260803), so a
+failure reproduces with the same command.  ``scripts/chaos_smoke.sh``
+runs exactly this file with the fixed seed under the tier-1 timeout.
+"""
+
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from gochugaru_tpu import consistency, rel
+from gochugaru_tpu.client import (
+    new_tpu_evaluator,
+    with_admission_control,
+    with_host_only_evaluation,
+    with_latency_mode,
+    with_store,
+)
+from gochugaru_tpu.utils import faults
+from gochugaru_tpu.utils import metrics as _metrics
+from gochugaru_tpu.utils.admission import AdmissionConfig
+from gochugaru_tpu.utils.context import background
+from gochugaru_tpu.utils.errors import (
+    AuthzError,
+    DeadlineExceededError,
+    UnavailableError,
+)
+
+SEED = int(os.environ.get("GOCHUGARU_CHAOS_SEED", "20260803"))
+ROUNDS = int(os.environ.get("GOCHUGARU_CHAOS_ROUNDS", "30"))
+
+SCHEMA = """
+definition user {}
+definition team { relation member: user }
+definition doc {
+    relation owner: user
+    relation reader: user | team#member
+    relation banned: user
+    permission read = reader + owner - banned
+}
+"""
+
+#: fault sites the check phase randomly arms each round (watch.stream is
+#: armed separately, for the whole stream's life)
+CHAOS_SITES = (
+    "device.dispatch",
+    "latency.dispatch",
+    "device.prepare",
+    "store.snapshot_for",
+    "store.materialize",
+    "snapshot.finish",
+)
+
+
+def _fixed_world(c):
+    """A static base world so early rounds have something to check."""
+    ctx = background()
+    c.write_schema(ctx, SCHEMA)
+    txn = rel.Txn()
+    for i in range(8):
+        txn.touch(rel.must_from_triple(f"doc:base{i}", "owner", f"user:own{i % 3}"))
+        txn.touch(rel.must_from_triple(f"doc:base{i}", "reader", f"user:rd{i % 5}"))
+    txn.touch(rel.must_from_triple("team:core", "member", "user:tm1"))
+    txn.touch(rel.must_from_tuple("doc:base0#reader", "team:core#member"))
+    txn.touch(rel.must_from_triple("doc:base1", "banned", "user:rd1"))
+    c.write(ctx, txn)
+
+
+def _key(update_type: str, r) -> tuple:
+    return (
+        update_type,
+        r.resource_type, r.resource_id, r.resource_relation,
+        r.subject_type, r.subject_id,
+    )
+
+
+def test_chaos_soak():
+    rng = random.Random(SEED)
+    m = _metrics.default
+
+    chaos = new_tpu_evaluator(
+        with_latency_mode(),
+        with_admission_control(
+            AdmissionConfig(
+                max_inflight=8, breaker_threshold=3, breaker_cooldown_s=0.2
+            )
+        ),
+    )
+    _fixed_world(chaos)
+    oracle = new_tpu_evaluator(
+        with_store(chaos.store), with_host_only_evaluation()
+    )
+
+    # ---- watch consumer: alive for the whole soak, faulted throughout --
+    watch_ctx = background().with_cancel()
+    collected = []
+    watch_err = {}
+    # cursor = head NOW (after the fixed world, before any soak write)
+    stream = chaos.updates(watch_ctx, rel.UpdateFilter())
+
+    def consume():
+        try:
+            for u in stream:
+                collected.append(_key(u.update_type.name, u.relationship))
+        except BaseException as e:  # a surfaced error must be classified
+            watch_err["e"] = e
+
+    watcher = threading.Thread(target=consume, daemon=True)
+    watcher.start()
+    # persistent low-probability stream breaker: every break exercises the
+    # cursor-resume path; progress resets the resume budget, so the
+    # stream recovers rather than surfacing
+    faults.arm("watch.stream", probability=0.10, seed=SEED ^ 0xBEEF)
+
+    expected_updates = []  # every applied update, in log order
+    live = []  # (resource_id, subject_id) of soak-written reader rels
+    users = [f"user:cu{i}" for i in range(6)]
+    mismatches = []
+    sheds = 0
+    unclassified = []
+
+    for rnd in range(ROUNDS):
+        # ---- write phase: fresh touches + an occasional delete ---------
+        txn = rel.Txn()
+        for w in range(rng.randint(1, 3)):
+            r = rel.must_from_triple(
+                f"doc:r{rnd}w{w}", "reader", rng.choice(users)
+            )
+            txn.touch(r)
+            expected_updates.append(_key("TOUCH", r))
+            live.append((r.resource_id, r.subject_id))
+        if live and rng.random() < 0.3:
+            rid, sid = live.pop(rng.randrange(len(live)))
+            d = rel.must_from_triple(f"doc:{rid}", "reader", f"user:{sid}")
+            txn.delete(d)
+            expected_updates.append(_key("DELETE", d))
+        chaos.write(background(), txn)
+
+        # ---- arm a random subset of sites for the check phase ----------
+        armed = []
+        for site in CHAOS_SITES:
+            if rng.random() < 0.35:
+                faults.arm(
+                    site,
+                    probability=1.0,
+                    times=rng.randint(1, 2),
+                    seed=rng.randrange(1 << 30),
+                )
+                armed.append(site)
+
+        # ---- check phase under faults ----------------------------------
+        queries = [
+            rel.must_from_triple(
+                rng.choice([f"doc:base{rng.randrange(8)}", f"doc:r{rnd}w0"]),
+                "read",
+                rng.choice(users + ["user:own0", "user:rd1", "user:tm1"]),
+            )
+            for _ in range(rng.randint(2, 6))
+        ]
+        ctx = background().with_timeout(30.0)
+        result = None
+        try:
+            result = chaos.check(ctx, consistency.full(), *queries)
+        except (UnavailableError, DeadlineExceededError):
+            sheds += 1  # allowed: a classified shed, within the deadline
+        except BaseException as e:
+            if not isinstance(e, AuthzError):
+                unclassified.append((rnd, repr(e)))
+        finally:
+            for site in armed:
+                faults.disarm(site)
+
+        # ---- oracle comparison (faults disarmed, same head) ------------
+        if result is not None:
+            want = oracle.check(background(), consistency.full(), *queries)
+            if result != want:
+                mismatches.append((rnd, result, want))
+
+    # ---- drain + verify the watch stream -------------------------------
+    drain = background().with_timeout(20.0)
+    while (
+        len(collected) < len(expected_updates)
+        and not drain.done()
+        and "e" not in watch_err
+    ):
+        time.sleep(0.05)
+    watch_ctx.cancel()
+    watcher.join(5.0)
+
+    assert not unclassified, f"unclassified exceptions: {unclassified}"
+    assert not mismatches, f"oracle mismatches: {mismatches[:3]}"
+    assert "e" not in watch_err, f"watch surfaced: {watch_err.get('e')!r}"
+    # exactly-once, in-order delivery across injected stream breaks
+    assert collected == expected_updates
+    # the soak must actually have injected faults and exercised recovery
+    assert m.counter("faults.injected") > 0
+    assert m.counter("retry.retries") > 0
+    # sheds are allowed but must be the exception, not the rule
+    assert sheds <= ROUNDS // 3, f"{sheds}/{ROUNDS} rounds shed"

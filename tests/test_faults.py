@@ -1,0 +1,504 @@
+"""Fault-injection registry (utils/faults.py) and admission control
+(utils/admission.py): unit behavior plus the client-level wiring —
+injected transient faults engage the real retry envelope, the in-flight
+gate sheds with ShedError, the deadline budget sheds before dispatch,
+the circuit breaker reroutes latency-mode traffic, and the watch stream
+resumes from its cursor with exactly-once delivery."""
+
+import threading
+import time
+
+import pytest
+
+from gochugaru_tpu import consistency, rel
+from gochugaru_tpu.client import (
+    new_tpu_evaluator,
+    with_admission_control,
+    with_latency_mode,
+    with_store,
+)
+from gochugaru_tpu.utils import faults
+from gochugaru_tpu.utils import metrics as _metrics
+from gochugaru_tpu.utils.admission import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    AdmissionConfig,
+    AdmissionController,
+    CircuitBreaker,
+    DispatchGate,
+)
+from gochugaru_tpu.utils.context import background
+from gochugaru_tpu.utils.errors import (
+    DeadlineExceededError,
+    ShedError,
+    UnavailableError,
+    classify_dispatch_exception,
+)
+
+SCHEMA = """
+definition user {}
+definition team { relation member: user }
+definition doc {
+    relation owner: user
+    relation reader: user | team#member
+    permission read = reader + owner
+}
+"""
+
+
+def _client(*opts):
+    c = new_tpu_evaluator(*opts)
+    ctx = background()
+    c.write_schema(ctx, SCHEMA)
+    txn = rel.Txn()
+    txn.touch(rel.must_from_triple("doc:a", "owner", "user:u1"))
+    txn.touch(rel.must_from_triple("doc:a", "reader", "user:u2"))
+    txn.touch(rel.must_from_triple("team:t1", "member", "user:u3"))
+    txn.touch(rel.must_from_tuple("doc:b#reader", "team:t1#member"))
+    c.write(ctx, txn)
+    return c
+
+
+CHECKS = [
+    rel.must_from_triple("doc:a", "read", "user:u1"),
+    rel.must_from_triple("doc:a", "read", "user:u2"),
+    rel.must_from_triple("doc:b", "read", "user:u3"),
+    rel.must_from_triple("doc:b", "read", "user:u2"),
+]
+EXPECT = [True, True, True, False]
+
+
+# ---------------------------------------------------------------------------
+# registry unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_registry_policies_deterministic():
+    reg = faults.FaultRegistry(_metrics.Metrics())
+    # probability draws come from a per-spec seeded RNG: same seed, same
+    # firing pattern
+    def pattern(seed):
+        spec = reg.arm("x", probability=0.5, seed=seed)
+        out = []
+        for _ in range(32):
+            try:
+                reg.maybe_fire("x")
+                out.append(False)
+            except UnavailableError:
+                out.append(True)
+        reg.disarm("x")
+        return out
+
+    assert pattern(7) == pattern(7)
+    assert pattern(7) != pattern(8)  # overwhelmingly likely for 32 draws
+
+
+def test_registry_times_and_after():
+    reg = faults.FaultRegistry(_metrics.Metrics())
+    spec = reg.arm("y", times=2, after=1)
+    fired = 0
+    for _ in range(6):
+        try:
+            reg.maybe_fire("y")
+        except UnavailableError:
+            fired += 1
+    assert fired == 2  # hit 1 skipped (after=1); hits 2,3 fire; then spent
+    assert spec.hits == 6 and spec.fired == 2
+
+
+def test_module_fire_is_noop_when_disarmed():
+    faults.reset()
+    faults.fire("device.dispatch")  # must not raise
+    with faults.armed("device.dispatch", times=1):
+        with pytest.raises(UnavailableError):
+            faults.fire("device.dispatch")
+        faults.fire("device.dispatch")  # one-shot spent
+    faults.fire("device.dispatch")  # disarmed again
+
+
+def test_custom_error_factory():
+    with faults.armed("z", error=RuntimeError("RESOURCE_EXHAUSTED: injected")):
+        with pytest.raises(RuntimeError) as ei:
+            faults.fire("z")
+    assert classify_dispatch_exception(ei.value).__class__ is UnavailableError
+
+
+# ---------------------------------------------------------------------------
+# injected faults engage the real retry envelope, end to end
+# ---------------------------------------------------------------------------
+
+
+def test_injected_dispatch_fault_is_retried_transparently():
+    c = _client()
+    ctx = background()
+    m = _metrics.default
+    before = m.counter("faults.injected.device.dispatch")
+    with faults.armed("device.dispatch", times=2) as spec:
+        assert c.check(ctx, consistency.full(), *CHECKS) == EXPECT
+    assert spec.fired == 2
+    assert m.counter("faults.injected.device.dispatch") == before + 2
+
+
+def test_injected_snapshot_fault_is_retried_transparently():
+    c = _client()
+    ctx = background()
+    with faults.armed("store.snapshot_for", times=1) as spec:
+        assert c.check(ctx, consistency.full(), *CHECKS) == EXPECT
+    assert spec.fired == 1
+
+
+def test_persistent_fault_surfaces_classified_not_hung():
+    c = _client()
+    ctx = background().with_timeout(1.5)
+    t0 = time.monotonic()
+    with faults.armed("device.dispatch"):
+        with pytest.raises(DeadlineExceededError):
+            c.check(ctx, consistency.full(), *CHECKS)
+    assert time.monotonic() - t0 < 3.0  # bounded by the context, no hang
+
+
+def test_latency_site_fault_retries_through_client():
+    """A transient fault inside the latency path retries under the same
+    envelope as the batch path (satellite: no unwrapped escape)."""
+    c = _client(with_latency_mode())
+    ctx = background()
+    assert c.check(ctx, consistency.full(), *CHECKS) == EXPECT  # warm
+    with faults.armed("latency.dispatch", times=1) as spec:
+        assert c.check(ctx, consistency.full(), *CHECKS) == EXPECT
+    assert spec.fired == 1
+
+
+def test_check_columns_latency_classifies_and_retries():
+    """DeviceEngine.check_columns_latency (the bench/test columnar entry)
+    classifies raw transient errors and retries them bounded."""
+    import numpy as np
+
+    c = _client(with_latency_mode())
+    ctx = background()
+    assert c.check(ctx, consistency.full(), *CHECKS) == EXPECT  # build engine
+    snap = c.store.snapshot_for(consistency.full())
+    engine = c._engine_for(snap)
+    dsnap = c._dsnap_for(engine, snap)
+    interner = snap.interner
+    slot = snap.compiled.slot_of_name
+    q_res = np.array([interner.lookup("doc", "a")], np.int32)
+    q_perm = np.array([slot["read"]], np.int32)
+    q_subj = np.array([interner.lookup("user", "u1")], np.int32)
+
+    # transient RAW error (not AuthzError) → classified → retried → result
+    with faults.armed(
+        "latency.dispatch", times=1,
+        error=RuntimeError("UNAVAILABLE: injected backend hiccup"),
+    ) as spec:
+        d, p, ovf = engine.check_columns_latency(dsnap, q_res, q_perm, q_subj)
+    assert spec.fired == 1
+    assert bool(d[0])
+
+    # persistent transient error → bounded tries, classified surfacing
+    with faults.armed(
+        "latency.dispatch",
+        error=RuntimeError("UNAVAILABLE: injected backend hiccup"),
+    ) as spec:
+        with pytest.raises(UnavailableError):
+            engine.check_columns_latency(dsnap, q_res, q_perm, q_subj)
+    assert spec.fired == engine.LATENCY_RETRY_TRIES
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+
+def test_gate_sheds_when_full():
+    m = _metrics.Metrics()
+    gate = DispatchGate(2, registry=m)
+    with gate.admit():
+        with gate.admit():
+            assert gate.inflight == 2
+            with pytest.raises(ShedError):
+                with gate.admit():
+                    pass
+    assert gate.inflight == 0
+    assert m.counter("admission.sheds") == 1
+
+
+def test_gate_shed_engages_retry_envelope():
+    """A shed during concurrent load is retried by the envelope: the
+    caller sees a slow success, not an error."""
+    c = _client(
+        with_admission_control(
+            AdmissionConfig(max_inflight=1, breaker_threshold=0)
+        )
+    )
+    ctx = background().with_timeout(10.0)
+    # hold the gate from another thread through a slow store access
+    release = threading.Event()
+    entered = threading.Event()
+    orig = c._store.snapshot_for
+
+    def slow_snapshot_for(cs):
+        entered.set()
+        release.wait(2.0)
+        return orig(cs)
+
+    results = {}
+
+    def holder():
+        c._store.snapshot_for = slow_snapshot_for
+        try:
+            results["holder"] = c.check(ctx, consistency.full(), *CHECKS)
+        finally:
+            pass
+
+    t = threading.Thread(target=holder)
+    t.start()
+    entered.wait(2.0)
+    c._store.snapshot_for = orig  # the second caller is fast
+    m = _metrics.default
+    sheds_before = m.counter("admission.sheds")
+    release.set()  # holder finishes while the retry backs off
+    results["main"] = c.check(ctx, consistency.full(), *CHECKS)
+    t.join(5.0)
+    assert results["main"] == EXPECT
+    assert results["holder"] == EXPECT
+
+
+def test_deadline_shed_before_dispatch():
+    c = _client(
+        with_admission_control(
+            AdmissionConfig(deadline_floor_s=5.0, breaker_threshold=0)
+        )
+    )
+    m = _metrics.default
+    before = m.counter("admission.deadline_sheds")
+    ctx = background().with_timeout(0.3)
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceededError):
+        c.check(ctx, consistency.full(), *CHECKS)
+    # shed immediately (pre-dispatch), then the envelope waits out the
+    # (short) deadline — never 5 s of dispatch work
+    assert time.monotonic() - t0 < 2.0
+    assert m.counter("admission.deadline_sheds") >= before + 1
+
+
+def test_breaker_state_machine():
+    m = _metrics.Metrics()
+    clock = {"t": 0.0}
+    br = CircuitBreaker(3, 1.0, registry=m, clock=lambda: clock["t"])
+    assert br.state == CLOSED and br.allow_latency()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == CLOSED  # below threshold
+    br.record_failure()
+    assert br.state == OPEN
+    assert m.counter("breaker.trips") == 1
+    assert not br.allow_latency()  # cooldown not elapsed
+    clock["t"] = 1.1
+    assert br.allow_latency()  # half-open probe admitted
+    assert br.state == HALF_OPEN
+    assert m.counter("breaker.half_opens") == 1
+    br.record_failure()  # failed probe
+    assert br.state == OPEN and m.counter("breaker.trips") == 2
+    clock["t"] = 2.3
+    assert br.allow_latency()
+    br.record_success(probe=False)  # batch-path success: stays half-open
+    assert br.state == HALF_OPEN
+    br.record_success(probe=True)  # successful latency probe closes it
+    assert br.state == CLOSED
+    assert m.counter("breaker.closes") == 1
+    assert m.gauge("breaker.state") == CLOSED
+
+
+def test_breaker_reroutes_latency_traffic_to_batch_path():
+    """Consecutive transient dispatch failures trip the breaker; while
+    open, latency-mode checks run on the batch path (no latency
+    dispatches), and a half-open probe closes it again."""
+    c = _client(
+        with_latency_mode(),
+        with_admission_control(
+            # cooldown far beyond anything the test's own dispatches can
+            # take (XLA compiles vary with cache state); the half-open
+            # transition is driven deterministically by back-dating the
+            # trip time below, never by sleeping
+            AdmissionConfig(breaker_threshold=2, breaker_cooldown_s=60.0)
+        ),
+    )
+    ctx = background()
+    m = _metrics.default
+    assert c.check(ctx, consistency.full(), *CHECKS) == EXPECT  # warm pins
+
+    # two consecutive transient failures trip the breaker; the envelope
+    # retries through and succeeds on the batch path
+    trips_before = m.counter("breaker.trips")
+    with faults.armed("device.dispatch", times=2):
+        assert c.check(ctx, consistency.full(), *CHECKS) == EXPECT
+    assert m.counter("breaker.trips") == trips_before + 1
+    assert c._admission.breaker.state == OPEN
+
+    # while OPEN: latency traffic rerouted (latency.dispatches flat)
+    lat_before = m.counter("latency.dispatches")
+    rerouted_before = m.counter("breaker.latency_rerouted")
+    assert c.check(ctx, consistency.full(), *CHECKS) == EXPECT
+    assert m.counter("latency.dispatches") == lat_before
+    assert m.counter("breaker.latency_rerouted") == rerouted_before + 1
+
+    # "after the cooldown": back-date the trip so the next dispatch is
+    # the half-open probe — it uses the latency path again and closes
+    # the breaker
+    c._admission.breaker._opened_at -= 61.0
+    closes_before = m.counter("breaker.closes")
+    assert c.check(ctx, consistency.full(), *CHECKS) == EXPECT
+    assert c._admission.breaker.state == CLOSED
+    assert m.counter("breaker.closes") == closes_before + 1
+    assert m.counter("latency.dispatches") > lat_before
+
+
+def test_breaker_probe_must_actually_run_latency_path():
+    """A half-open probe whose batch silently falls back to the batch
+    path (beyond the top latency tier) must NOT close the breaker — only
+    a dispatch the latency path actually served counts as a probe."""
+    c = _client(
+        with_latency_mode(),
+        with_admission_control(
+            AdmissionConfig(breaker_threshold=2, breaker_cooldown_s=60.0)
+        ),
+    )
+    ctx = background()
+    assert c.check(ctx, consistency.full(), *CHECKS) == EXPECT  # warm
+    with faults.armed("device.dispatch", times=2):
+        c.check(ctx, consistency.full(), *CHECKS)
+    assert c._admission.breaker.state == OPEN
+    # back-date the trip: cooldown "elapsed", next dispatch is the probe
+    c._admission.breaker._opened_at -= 61.0
+    top_tier = max(c._engine.config.latency_tiers)
+    big = [CHECKS[i % len(CHECKS)] for i in range(top_tier + 1)]
+    assert c.check(ctx, consistency.full(), *big) == [
+        EXPECT[i % len(EXPECT)] for i in range(top_tier + 1)
+    ]
+    # the oversized probe fell back to the batch path: still half-open
+    assert c._admission.breaker.state == HALF_OPEN
+    # a tier-served batch is a real probe and closes it
+    assert c.check(ctx, consistency.full(), *CHECKS) == EXPECT
+    assert c._admission.breaker.state == CLOSED
+
+
+# ---------------------------------------------------------------------------
+# watch resume-on-fault
+# ---------------------------------------------------------------------------
+
+
+def _collect_watch(c, ctx, n_expected, timeout_s=10.0):
+    got = []
+    done = threading.Event()
+
+    def consume():
+        try:
+            for u in c.updates(ctx, rel.UpdateFilter()):
+                got.append(u)
+                if len(got) >= n_expected:
+                    break
+        finally:
+            done.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    return got, done, t
+
+
+def test_watch_resumes_from_cursor_exactly_once():
+    c = _client()
+    ctx = background().with_cancel()
+    m = _metrics.default
+    resumes_before = m.counter("watch.resumes")
+
+    # every 3rd delivery faults: the stream must resume from its cursor
+    # and deliver each event exactly once, in order
+    faults.arm("watch.stream", probability=1.0, seed=3, after=2, times=1)
+    expected = []
+    got, done, t = _collect_watch(c, ctx, 9)
+    for i in range(3):
+        txn = rel.Txn()
+        for j in range(3):
+            r = rel.must_from_triple(f"doc:w{i}", "reader", f"user:wu{j}")
+            txn.touch(r)
+            expected.append(("TOUCH", r.resource_id, r.subject_id))
+        c.write(background(), txn)
+        # re-arm a fresh one-shot mid-stream fault for the next burst
+        faults.arm("watch.stream", after=1, times=1, seed=i)
+    assert done.wait(10.0), "watch consumer hung"
+    ctx.cancel()
+    t.join(2.0)
+    assert [
+        (u.update_type.name, u.relationship.resource_id, u.relationship.subject_id)
+        for u in got
+    ] == expected
+    assert m.counter("watch.resumes") > resumes_before
+
+
+def test_watch_persistent_fault_surfaces_bounded():
+    """A permanently-broken stream classifies as UnavailableError after
+    WATCH_MAX_RESUMES no-progress attempts — never a hang."""
+    c = _client()
+    ctx = background().with_cancel()
+    faults.arm("watch.stream")  # every delivery faults, forever
+    err = {}
+    done = threading.Event()
+
+    def consume():
+        try:
+            for _u in c.updates(ctx, rel.UpdateFilter()):
+                pass
+        except UnavailableError as e:
+            err["e"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    txn = rel.Txn()
+    txn.touch(rel.must_from_triple("doc:x", "reader", "user:ux"))
+    c.write(background(), txn)
+    assert done.wait(15.0), "watch consumer hung on persistent fault"
+    ctx.cancel()
+    t.join(2.0)
+    assert isinstance(err.get("e"), UnavailableError)
+
+
+# ---------------------------------------------------------------------------
+# sharded-engine injection sites
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_sites_fire():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    import numpy as np
+
+    from gochugaru_tpu.parallel import ShardedEngine, make_mesh
+    from gochugaru_tpu.schema import compile_schema, parse_schema
+    from gochugaru_tpu.store.interner import Interner
+    from gochugaru_tpu.store.snapshot import build_snapshot
+
+    cs = compile_schema(parse_schema(SCHEMA))
+    interner = Interner()
+    rels = [
+        rel.must_from_triple("doc:a", "owner", "user:u1"),
+        rel.must_from_triple("doc:a", "reader", "user:u2"),
+    ]
+    snap = build_snapshot(1, cs, interner, rels, epoch_us=1_700_000_000_000_000)
+    eng = ShardedEngine(cs, make_mesh(4, 2))
+    dsnap = eng.prepare(snap)
+    queries = [rel.must_from_triple("doc:a", "read", "user:u1")]
+    d, _, _ = eng.check_batch(dsnap, queries, now_us=1_700_000_000_000_000)
+    assert bool(d[0])
+    with faults.armed("sharded.dispatch", times=1) as spec:
+        with pytest.raises(UnavailableError):
+            eng.check_batch(dsnap, queries, now_us=1_700_000_000_000_000)
+    assert spec.fired == 1
+    with faults.armed("sharded.collective", times=1) as spec:
+        with pytest.raises(UnavailableError):
+            eng.check_batch(dsnap, queries, now_us=1_700_000_000_000_000)
+    assert spec.fired == 1

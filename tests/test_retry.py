@@ -1,0 +1,183 @@
+"""The retry envelope itself (utils/retry.py): jitter bounds, interval
+cap, deadline clamping, cancellation honesty, and PermanentError
+unwrapping — all with an injected fake sleep so no test actually waits.
+
+The envelope mirrors the reference exactly (client/client.go:205-210,
+cenkalti/backoff defaults: initial 50 ms, multiplier 1.5, randomization
+0.5, max 2 s, bounded by the context deadline); these tests pin the
+numbers so a refactor cannot silently drift them.
+"""
+
+import time
+
+import pytest
+
+from gochugaru_tpu.utils.context import background
+from gochugaru_tpu.utils.errors import (
+    CancelledError,
+    DeadlineExceededError,
+    PermanentError,
+    UnavailableError,
+)
+from gochugaru_tpu.utils.retry import (
+    INITIAL_INTERVAL,
+    MAX_INTERVAL,
+    MULTIPLIER,
+    RANDOMIZATION_FACTOR,
+    retry_retriable_errors,
+)
+
+
+def _failing_fn(failures: int):
+    """A fn that raises UnavailableError ``failures`` times, then returns."""
+    state = {"calls": 0}
+
+    def fn():
+        state["calls"] += 1
+        if state["calls"] <= failures:
+            raise UnavailableError(f"transient #{state['calls']}")
+        return "ok"
+
+    return fn, state
+
+
+def test_jitter_stays_within_randomization_band():
+    """Every pause lies in [interval·(1−RF), interval·(1+RF)] for the
+    unclamped ladder interval of its attempt."""
+    pauses = []
+    fn, _ = _failing_fn(8)
+    assert retry_retriable_errors(background(), fn, sleep=pauses.append) == "ok"
+    assert len(pauses) == 8
+    interval = INITIAL_INTERVAL
+    for p in pauses:
+        lo = interval * (1 - RANDOMIZATION_FACTOR)
+        hi = interval * (1 + RANDOMIZATION_FACTOR)
+        assert lo <= p <= hi, (p, lo, hi)
+        interval = min(interval * MULTIPLIER, MAX_INTERVAL)
+
+
+def test_interval_caps_at_max_interval():
+    """Deep ladders stop growing: late pauses are bounded by
+    MAX_INTERVAL·(1+RF) and the underlying interval by MAX_INTERVAL."""
+    pauses = []
+    fn, _ = _failing_fn(25)
+    retry_retriable_errors(background(), fn, sleep=pauses.append)
+    # by attempt k the unclamped interval is INITIAL·MULT^k capped at MAX
+    assert max(pauses) <= MAX_INTERVAL * (1 + RANDOMIZATION_FACTOR)
+    # the tail attempts must actually reach the cap region
+    assert max(pauses[-5:]) > MAX_INTERVAL * (1 - RANDOMIZATION_FACTOR) * 0.9
+
+
+def test_backoff_never_sleeps_past_deadline():
+    """With a context deadline, every pause is clamped to the remaining
+    budget at the moment it is computed."""
+    budget = 0.12
+    ctx = background().with_timeout(budget)
+    t0 = time.monotonic()
+    pauses = []
+
+    def sleep(p):
+        pauses.append((p, time.monotonic()))
+        time.sleep(p)  # real (short) sleep so the deadline advances
+
+    fn, _ = _failing_fn(100)
+    with pytest.raises(DeadlineExceededError):
+        retry_retriable_errors(ctx, fn, sleep=sleep)
+    dl = t0 + budget
+    for p, at in pauses:
+        assert p <= max(dl - at, 0.0) + 0.01, (p, dl - at)
+    # and the whole envelope respected the deadline (+ small scheduling slop)
+    assert time.monotonic() - t0 <= budget + 0.2
+
+
+def test_zero_length_pause_is_skipped(monkeypatch):
+    """A deadline clamp producing pause == 0 must not call sleep at all
+    (an injected fake sleep observes no zero-length pauses).  The
+    envelope's clock is steered so the deadline check sees remaining
+    budget but the clamp sees exactly none — the racy instant the
+    satellite fix covers."""
+    import types
+
+    import gochugaru_tpu.utils.retry as retry_mod
+
+    ctx = background().with_timeout(10.0)
+    dl = ctx.deadline()
+    # retry's own clock: first call (the deadline check) still inside the
+    # budget, second call (the clamp) exactly at the deadline → pause 0.
+    seq = iter([dl - 1.0, dl])
+    fake = types.SimpleNamespace(monotonic=lambda: next(seq, dl))
+    monkeypatch.setattr(retry_mod, "time", fake)
+
+    pauses = []
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise UnavailableError("transient")
+        return "ok"
+
+    assert retry_retriable_errors(ctx, fn, sleep=pauses.append) == "ok"
+    assert pauses == []  # the zero-length pause never reached sleep
+    assert calls["n"] == 2
+
+
+def test_cancellation_after_pause_surfaces_before_next_attempt():
+    """A cancellation landing during the backoff pause raises before
+    fn() is attempted again."""
+    ctx = background().with_cancel()
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        raise UnavailableError("transient")
+
+    def sleep(p):
+        ctx.cancel()  # cancellation arrives mid-backoff
+
+    with pytest.raises(CancelledError):
+        retry_retriable_errors(ctx, fn, sleep=sleep)
+    assert calls["n"] == 1  # no second attempt after the cancelled pause
+
+
+def test_default_pause_is_context_aware():
+    """Without an injected sleep, the pause is ctx.wait — a cancellation
+    from another thread interrupts the backoff instead of waiting it
+    out.  Uses a failure deep enough in the ladder that the pause would
+    be ~2 s if not interrupted."""
+    import threading
+
+    ctx = background().with_cancel()
+    fn, state = _failing_fn(100)
+    threading.Timer(0.15, ctx.cancel).start()
+    t0 = time.monotonic()
+    with pytest.raises(CancelledError):
+        retry_retriable_errors(ctx, fn)
+    # the ladder reaches ~0.17s pauses by try 4; an uninterruptible sleep
+    # chain would overshoot well past the cancel point
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_permanent_error_unwrap_preserves_cause_chain():
+    """PermanentError unwraps to its __cause__, and that cause keeps its
+    own __cause__ chain intact."""
+    root = KeyError("root")
+    mid = ValueError("mid")
+    mid.__cause__ = root
+
+    def fn():
+        raise PermanentError("wrapped") from mid
+
+    with pytest.raises(ValueError) as ei:
+        retry_retriable_errors(background(), fn, sleep=lambda s: None)
+    assert ei.value is mid
+    assert ei.value.__cause__ is root
+
+
+def test_max_tries_bounds_retries():
+    fn, state = _failing_fn(100)
+    with pytest.raises(UnavailableError):
+        retry_retriable_errors(
+            background(), fn, sleep=lambda s: None, max_tries=4
+        )
+    assert state["calls"] == 4
