@@ -113,6 +113,14 @@ def main() -> int:
         + (["--repos", "500", "--batch", "8192"] if q else []),
         900,
     ))
+    configs.append((
+        "7 — incremental closure: member-edge write throughput"
+        + (" (quick)" if q else ""),
+        [py, "benchmarks/bench6_closure.py"]
+        + (["--edges", "1000000", "--rounds", "10", "--warmup", "5"]
+           if q else ["--edges", "10000000"]),
+        4000,
+    ))
     if not q:
         # Leopard-scale CPU proxy (VERDICT r04 item 3): the same Watch
         # re-index loop at a 100M-edge base — BASELINE config 5's

@@ -628,6 +628,11 @@ class DeviceSnapshot:
     #: at FULL prepare on folded worlds and carried along a delta chain
     #: so each revision's dl_pf* overlay recomputes from (base, acc)
     fold_state: Optional[Any] = None
+    #: host-side closure advance state (engine/flat.py ClosureHostState):
+    #: set at FULL prepare, ADVANCED each revision by the membership-delta
+    #: path (store/closure.py advance_closure) so member-edge writes keep
+    #: the flattened closure fresh without a rebuild
+    closure_state: Optional[Any] = None
     #: lazily-attached latency-mode dispatcher (engine/latency.py
     #: LatencyPath) — per-snapshot warm state (staging buffers, local
     #: pin table); the executables themselves are shared engine-wide
@@ -774,12 +779,13 @@ class DeviceEngine:
         arrays.update(ectx)
         flat_meta = None
         fold_state = None
+        closure_state = None
         if self.config.use_flat:
             from .flat import build_flat_arrays
 
             built = build_flat_arrays(snap, self.config, plan=self.plan)
             if built is not None:  # unpackable graphs use the legacy path
-                flat_arrays, flat_meta, fold_state = built
+                flat_arrays, flat_meta, fold_state, closure_state = built
                 arrays.update(flat_arrays)
         arrays = {k: jnp.asarray(v) for k, v in arrays.items()}
         tid_map = np.full(max(self.plan.num_schema_types, 1), -1, dtype=np.int32)
@@ -812,6 +818,7 @@ class DeviceEngine:
             strings=strings,
             flat_meta=flat_meta,
             fold_state=fold_state,
+            closure_state=closure_state,
         )
 
     def _delta_prev_ok(self, prev: DeviceSnapshot) -> bool:
@@ -852,7 +859,7 @@ class DeviceEngine:
         built = build_delta_arrays(snap, prev, self.compiled, self.config)
         if built is None:
             return None
-        dl_arrays, dmeta, acc = built
+        dl_arrays, dmeta, acc, extras = built
         arrays = dict(prev.arrays)
         # drop the previous overlay's tables: the new overlay replaces them
         # (a shrunk accumulated delta must not leave stale tables behind)
@@ -880,7 +887,8 @@ class DeviceEngine:
         # an empty collapsed delta (or one that cancelled out) compiles as
         # the plain base kernel — don't pay a retrace for DeltaMeta()
         meta = _dc_replace(
-            prev.flat_meta, delta=dmeta if dl_arrays else None
+            prev.flat_meta, delta=dmeta if dl_arrays else None,
+            **extras.get("meta_up", {}),
         )
         return DeviceSnapshot(
             revision=snap.revision,
@@ -891,6 +899,7 @@ class DeviceEngine:
             flat_meta=meta,
             delta_acc=acc,
             fold_state=prev.fold_state,
+            closure_state=extras.get("closure_state"),
         )
 
     # -- query lowering --------------------------------------------------

@@ -169,8 +169,13 @@ class DeltaMeta:
     pf_ovl_hascav: bool = False  # overlay layout flags (independent of base)
     pf_ovl_hasuntil: bool = False
     pf_ovl_haswc: bool = False
-    pf_ovl_t: bool = False  # overlay pf_t rows
-    pfo_t_cap: int = 4
+    pf_ovl_u: bool = False  # overlay pf_u (folded userset) rows
+    pfo_u_cap: int = 4
+    pfo_u_fan: int = 1
+    #: T-index disabled for the rest of this chain (sticky, like pf_off):
+    #: membership-closure deltas staled more baked T rows than the dirty
+    #: budget covers — the KU path probes the live closure instead
+    t_off: bool = False
 
 
 @dataclass(frozen=True)
@@ -275,18 +280,42 @@ class FlatMeta:
     k1_dense: Tuple[int, ...] = ()
     k2_dense: Tuple[int, ...] = ()
     #: permission fold (engine/fold.py P-index): (type_name, perm_slot)
-    #: pairs whose BASE evaluation is the pf_e/pf_t probe pair — their
-    #: programs compile to nothing when no delta level rides the base
-    #: (a delta reverts to the walked program, which keeps add/tombstone
-    #: semantics exact without incremental fold maintenance)
+    #: pairs whose BASE evaluation is the pf_e probe + the pf_u range
+    #: slice intersected with the closure — their programs compile to
+    #: nothing when no delta level rides the base (a delta reverts to
+    #: the walked program, which keeps add/tombstone semantics exact
+    #: without incremental fold maintenance)
     fold_pairs: Tuple[Tuple[str, int], ...] = ()
     pf_e_cap: int = 4
-    pf_t_cap: int = 4
+    pf_u_cap: int = 4  # pf_u group-table probe cap
+    pf_u_fan: int = 1  # max folded groups per (slot, resource), pow2
+    #: csr closure-by-source view (the fold's subject side): probe cap of
+    #: the source-keyed group table and max closure rows per source.
+    #: The kernel slices the subject's group closure ONCE per query and
+    #: intersects it with each pf_u group list in registers — the
+    #: sorted-key-column intersection that replaces both the dense
+    #: (resource × member) T-join and per-group hash probes
+    pf_s_cap: int = 4
+    pf_s_fan: int = 1
+    #: DIRECT range lookup for the fold's pf_u/csr views (single-chip):
+    #: ``pfu_start``/``csr_start`` offset arrays indexed by the packed
+    #: key itself — two element gathers per range instead of a hash
+    #: probe (~14× cheaper on gather-poor CPUs; measured in-repo).
+    #: False = the key space outgrew the budget, hash group tables used.
+    #: The csr side has its own flag: membership-delta chains flip it to
+    #: the hash layout (rebuilding the dense offset array per revision
+    #: costs more than the write budget; a full prepare restores direct)
+    pf_direct: bool = False
+    pf_s_direct: bool = False
+    #: every pf_u row / closure row is unexpiring on both planes: the
+    #: kernel skips the until-column slices and plane masks entirely
+    pf_u_alllive: bool = False
+    pf_s_alllive: bool = False
     pf_hascav: bool = False
     pf_hasuntil: bool = False
     pf_haswc: bool = False
     pf_has_e: bool = False
-    pf_has_t: bool = False
+    pf_has_u: bool = False
 
 
 def _gate_cols(hascav: bool, hasexp: bool) -> list:
@@ -720,25 +749,25 @@ def _rc_build(
     return out
 
 
-def _fold_packed(fr, cl, snap, maps: SlotMaps, N: int, config: EngineConfig):
+def _fold_packed(fr, snap, maps: SlotMaps, N: int, config: EngineConfig):
     """Dense-packed fold arrays shared by both layout builders:
-    (pf_k1, pf_k2, pf_subj, (T2_k1, T2_k2, T2_d, T2_p), flags) or None
-    when the fold's T join is over budget.  Fold rows carry RAW int64
+    (pf_k1, pf_k2, pf_subj, (u_k1, u_gk, u_until, u_fan), flags) or None
+    when some resource's folded group fan exceeds the cap (the fold then
+    declines; the walked path answers).  Fold rows carry RAW int64
     (subj·(num_slots+1)+srel1) identity keys — decomposed here and
-    repacked with the dense radices."""
+    repacked with the dense radices.  The u side is the reachability-
+    pruned (resource, group) table of fold_userset_rows: the member
+    closure is intersected at probe time, never joined in."""
     from ..store.closure import NO_EXP
-    from .fold import fold_tindex_join
+    from .fold import fold_userset_rows
 
-    max_rows = config.flat_fold_tindex_max_rows
-    if max_rows is None:
-        from .plan import FOLD_TINDEX_AUTO_MAX_ROWS
-
-        max_rows = FOLD_TINDEX_AUTO_MAX_ROWS
-    tj2 = fold_tindex_join(
-        fr, cl, N, maps, config.flat_fold_tindex_factor, max_rows=max_rows
-    )
-    if tj2 is None:
-        return None
+    u_k1, u_gk, u_until = fold_userset_rows(fr, N, maps)
+    u_fan = 0
+    if u_k1.shape[0]:
+        _, counts = np.unique(u_k1, return_counts=True)
+        u_fan = int(counts.max())
+        if u_fan > config.flat_fold_u_fan_cap:
+            return None
     S1_raw = snap.num_slots + 1
     pf_subj = (fr.e_k2 // S1_raw).astype(np.int32)
     pf_srel1 = (fr.e_k2 % S1_raw).astype(np.int32)
@@ -748,15 +777,162 @@ def _fold_packed(fr, cl, snap, maps: SlotMaps, N: int, config: EngineConfig):
         pf_hascav=bool((fr.e_cav != 0).any()),
         pf_hasuntil=bool((fr.e_until != NO_EXP).any()),
     )
-    return pf_k1, pf_k2, pf_subj, tj2, flags
+    return pf_k1, pf_k2, pf_subj, (u_k1, u_gk, u_until, _round_fan(u_fan)), flags
+
+
+class ClosureHostState:
+    """Per-prepared-snapshot host state for the membership-delta path
+    (build_delta_arrays): the store-level closure advance state plus the
+    reverse indexes the engine needs to keep the device tables honest.
+
+    ``used`` is the BASE revision's userset-subject key set and stays the
+    chain's classification authority: every advance classifies delta rows
+    against it, so the maintained closure covers the base's used-superset
+    even when a chain delta removes a userset's last referencing row.
+    That superset is probe-equivalent (closure rows of a dereferenced
+    group can only be reached through a userset row citing the group, and
+    none exist) and keeps later re-references exact — the group's rows
+    were maintained all along.  ``t_pe``/``t_k1`` map raw packed group
+    keys of T-covered userset rows to their dense (slot·N + res) keys:
+    the rows whose baked T-index entries go stale when a group's closure
+    changes."""
+
+    __slots__ = ("st", "used", "t_pe", "t_k1")
+
+    def __init__(self, st, used, t_pe, t_k1):
+        self.st = st
+        self.used = used
+        self.t_pe = t_pe
+        self.t_k1 = t_k1
+
+
+def _closure_host_state(snap, cl, config: EngineConfig, us_gk, t_slots):
+    """Build the advance-ready closure state at full-prepare time."""
+    from ..store.closure import build_closure_state
+
+    used = getattr(snap, "us_used_keys", None)
+    if used is None:
+        return None
+    num_slots = snap.num_slots
+    if t_slots and snap.us_rel.shape[0]:
+        elig = np.isin(snap.us_rel, np.asarray(t_slots, np.int64))
+        pe = (
+            snap.us_subj[elig].astype(np.int64) * (num_slots + 1)
+            + snap.us_srel[elig] + 1
+        )
+        order = np.argsort(pe, kind="stable")
+        t_pe, t_k1 = pe[order], us_gk[elig][order]
+    else:
+        t_pe = np.zeros(0, np.int64)
+        t_k1 = np.zeros(0, np.int32)
+    return ClosureHostState(
+        build_closure_state(
+            snap, cl, per_source_cap=config.closure_source_cap
+        ),
+        used, t_pe, t_k1,
+    )
+
+
+def _pf_starts(keys: np.ndarray, size: int) -> np.ndarray:
+    """Offset array of a key-sorted row set over a dense key domain:
+    ``start[k] .. start[k+1]`` is key ``k``'s row range."""
+    counts = np.bincount(keys, minlength=size)
+    st = np.zeros(size + 1, np.int64)
+    np.cumsum(counts, out=st[1:])
+    return st.astype(np.int32)
+
+
+def _pf_col(a: np.ndarray, pad: int, fill) -> np.ndarray:
+    """One split pf-view row column: [pow2(rows+pad), 1] int32."""
+    n = _ceil_pow2(max(a.shape[0] + pad, 1))
+    padded = np.full((n, 1), fill, np.int32)
+    padded[: a.shape[0], 0] = a
+    return padded
+
+
+def _max_run_sorted(keys: np.ndarray) -> int:
+    """Longest equal-key run of a SORTED key column, O(n) with no sort
+    (np.unique would re-sort; this sits on the membership-write path)."""
+    if keys.shape[0] == 0:
+        return 0
+    bounds = np.flatnonzero(np.diff(keys)) + 1
+    return int(np.diff(
+        np.concatenate([[0], bounds, [keys.shape[0]]])
+    ).max())
+
+
+def _pf_view_tables(
+    u_k1, u_gk, u_until, u_fan,
+    cl_k1, cl_k2, cl_d, cl_p, s_fan,
+    *, maps: SlotMaps, N: int, S1: int, fold_slots, config: EngineConfig,
+):
+    """Single-chip pf_u / csr view tables: SPLIT 1-wide row columns
+    (narrow contiguous slices vectorize ~15× better than wide ones on
+    gather-poor CPUs; measured in-repo) with the row range resolved
+    DIRECTLY — ``pfu_start``/``csr_start`` offset arrays indexed by the
+    packed key itself, two element gathers per range — or through legacy
+    hash group tables when the key space is over budget.  Until columns
+    are omitted entirely when every row is unexpiring (the common case;
+    the kernel then skips the plane masks).  Returns (arrays, meta kw)."""
+    from ..store.closure import NO_EXP
+
+    out: Dict[str, np.ndarray] = {}
+    pad_u, pad_s = max(64, u_fan), max(64, s_fan)
+    out["pfu_gk"] = _pf_col(u_gk, pad_u, -1)
+    u_alllive = bool((u_until == NO_EXP).all()) if u_until.shape[0] else True
+    if not u_alllive:
+        out["pfu_u"] = _pf_col(u_until, pad_u, 0)
+    out["csr_gk"] = _pf_col(cl_k2, pad_s, -1)
+    s_alllive = (
+        bool((cl_d == NO_EXP).all() and (cl_p == NO_EXP).all())
+        if cl_k1.shape[0] else True
+    )
+    if not s_alllive:
+        out["csr_d"] = _pf_col(cl_d, pad_s, 0)
+        out["csr_p"] = _pf_col(cl_p, pad_s, 0)
+    n_f = max(len(fold_slots), 1)
+    budget = config.flat_pf_direct_max_entries
+    u_direct = n_f * N + 1 <= budget
+    s_direct = N * S1 + 1 <= budget
+    kw = dict(
+        pf_direct=u_direct, pf_s_direct=s_direct,
+        pf_u_alllive=u_alllive, pf_s_alllive=s_alllive,
+    )
+    if u_direct:
+        # remap fold slots to a compact id so pfu_start spans only
+        # fold-slots·N entries (the full active-k1 domain would be ~3×)
+        fidx = np.full(max(maps.n_k1, 1), -1, np.int64)
+        for i, s in enumerate(fold_slots):
+            fidx[maps.k1[s]] = i
+        u64 = u_k1.astype(np.int64)
+        out["pfu_start"] = _pf_starts(fidx[u64 // N] * N + u64 % N, n_f * N)
+    else:
+        pfu = build_range_hash(u_k1)
+        out["pfu_off"] = pfu.index.off
+        out["pfugx"] = interleave_buckets(
+            pfu.index, [pfu.gk, pfu.glo, pfu.ghi]
+        )
+        kw.update(pf_u_cap=_round_cap(pfu.index.cap))
+    if s_direct:
+        out["csr_start"] = _pf_starts(cl_k1.astype(np.int64), N * S1)
+    else:
+        csr = build_range_hash(cl_k1)
+        out["csr_off"] = csr.index.off
+        out["csrgx"] = interleave_buckets(
+            csr.index, [csr.gk, csr.glo, csr.ghi]
+        )
+        kw.update(pf_s_cap=_round_cap(csr.index.cap))
+    return out, kw
 
 
 def build_flat_arrays(
     snap, config: EngineConfig, plan: Optional[DevicePlan] = None
-) -> Optional[Tuple[Dict[str, np.ndarray], FlatMeta, Optional[object]]]:
+) -> Optional[Tuple[Dict[str, np.ndarray], FlatMeta, Optional[object],
+                    Optional[ClosureHostState]]]:
     """Hash-index the snapshot + flatten its membership closure.  Returns
-    padded host arrays (merged into DeviceSnapshot.arrays) and the static
-    FlatMeta — or None when even the DENSE keys don't pack into int32
+    padded host arrays (merged into DeviceSnapshot.arrays), the static
+    FlatMeta, the fold maintenance state, and the closure advance state —
+    or None when even the DENSE keys don't pack into int32
     (pow2(num_nodes) · max(active k1 slots, active srels+1) ≥ 2³¹; such
     graphs use the legacy engine)."""
     from ..store.closure import NEVER, build_closure
@@ -992,36 +1168,44 @@ def build_flat_arrays(
 
     # ---- permission fold (P-index): rewrites → root-level tables -------
     fold_kw: Dict = {}
-    if fr is not None:
-        got = _fold_packed(fr, cl, snap, maps, N, config)
-        if got is not None:
-            pf_k1, pf_k2, pf_subj, (T2_k1, T2_k2, T2_d, T2_p), pff = got
-            pfh = put_block(
-                "pfx", "pfh_off", lambda: build_hash([pf_k1, pf_k2]),
-                [pf_k1, pf_k2],
-                [pf_k1, pf_k2]
-                + ([fr.e_cav, fr.e_ctx] if pff["pf_hascav"] else [])
-                + ([fr.e_until] if pff["pf_hasuntil"] else []),
-            )
-            pft = put_block(
-                "pftx", "pfth_off", lambda: build_hash([T2_k1, T2_k2]),
-                [T2_k1, T2_k2],
-                [T2_k1, T2_k2, T2_d, T2_p],
-            )
-            fold_kw = dict(
-                fold_pairs=fr.pairs,
-                pf_e_cap=_round_cap(pfh.cap) if pfh is not None else 4,
-                pf_t_cap=_round_cap(pft.cap) if pft is not None else 4,
-                pf_haswc=bool(np.isin(pf_subj, wc_nodes).any()),
-                pf_has_e=pf_k1.shape[0] > 0,
-                pf_has_t=T2_k1.shape[0] > 0,
-                **pff,
-            )
-            # arm the maintenance state with the packing context it
-            # needs at delta time (fold_delta_update)
-            fstate.maps, fstate.N, fstate.cl = maps, N, cl
-        else:
-            fstate = None
+    got = _fold_packed(fr, snap, maps, N, config) if fr is not None else None
+    if got is not None:
+        # subject side: a subject whose closure is wider than the
+        # compare-tile cap declines the fold (the walked path answers)
+        s_run = _max_run_sorted(cl_k1)
+        if s_run > config.flat_fold_subj_fan_cap:
+            got = None
+    if got is not None:
+        pf_k1, pf_k2, pf_subj, (u_k1, u_gk, u_until, u_fan), pff = got
+        pfh = put_block(
+            "pfx", "pfh_off", lambda: build_hash([pf_k1, pf_k2]),
+            [pf_k1, pf_k2],
+            [pf_k1, pf_k2]
+            + ([fr.e_cav, fr.e_ctx] if pff["pf_hascav"] else [])
+            + ([fr.e_until] if pff["pf_hasuntil"] else []),
+        )
+        s_fan = _round_fan(max(s_run, 1))
+        fold_slots = tuple(sorted({s for _, s in fr.pairs}))
+        pf_arrays, pf_kw = _pf_view_tables(
+            u_k1, u_gk, u_until, u_fan,
+            cl_k1, cl_k2, cl.c_d_until, cl.c_p_until, s_fan,
+            maps=maps, N=N, S1=S1, fold_slots=fold_slots, config=config,
+        )
+        out.update(pf_arrays)
+        fold_kw = dict(
+            fold_pairs=fr.pairs,
+            pf_e_cap=_round_cap(pfh.cap) if pfh is not None else 4,
+            pf_u_fan=u_fan,
+            pf_s_fan=s_fan,
+            pf_haswc=bool(np.isin(pf_subj, wc_nodes).any()),
+            pf_has_e=pf_k1.shape[0] > 0,
+            pf_has_u=u_k1.shape[0] > 0,
+            **pf_kw,
+            **pff,
+        )
+        # arm the maintenance state with the packing context it
+        # needs at delta time (fold_delta_update)
+        fstate.maps, fstate.N = maps, N
     else:
         fstate = None
 
@@ -1066,7 +1250,12 @@ def build_flat_arrays(
             or np.isin(cl.ovf_src[cl.ovf_srel1 == 0], wc_nodes).any()
         ),
     )
-    return out, meta, fstate
+    cstate = (
+        _closure_host_state(snap, cl, config, us_gk, t_kw.get("t_slots", ()))
+        if config.closure_delta and BS
+        else None
+    )
+    return out, meta, fstate, cstate
 
 
 # ---------------------------------------------------------------------------
@@ -1171,7 +1360,8 @@ def _stack_range(ri, row_cols: Sequence[np.ndarray], M: int, fan_pad: int):
 def build_flat_arrays_sharded(
     snap, config: EngineConfig, model_size: int,
     plan: Optional[DevicePlan] = None,
-) -> Optional[Tuple[Dict[str, np.ndarray], FlatMeta, Optional[object]]]:
+) -> Optional[Tuple[Dict[str, np.ndarray], FlatMeta, Optional[object],
+                    Optional[ClosureHostState]]]:
     """The bucket-sharded counterpart of build_flat_arrays: every hash /
     range / closure / T table stacked per model shard (leading axis splits
     M ways under shard_map; probes mask bucket ownership and OR-reduce).
@@ -1267,36 +1457,44 @@ def build_flat_arrays_sharded(
 
     wc_nodes = snap.wildcard_node_of_type[snap.wildcard_node_of_type >= 0]
     fold_kw: Dict = {}
-    if fr is not None:
-        got = _fold_packed(fr, cl, snap, maps, N, config)
-        if got is not None:
-            pf_k1, pf_k2, pf_subj, (T2_k1, T2_k2, T2_d, T2_p), pff = got
-            pfh = build_hash([pf_k1, pf_k2], min_size=ms)
-            out["pfh_off"], out["pfx"] = _stack_point(
-                pfh,
-                [pf_k1, pf_k2]
-                + ([fr.e_cav, fr.e_ctx] if pff["pf_hascav"] else [])
-                + ([fr.e_until] if pff["pf_hasuntil"] else []),
-                M,
-            )
-            pft = build_hash([T2_k1, T2_k2], min_size=ms)
-            out["pfth_off"], out["pftx"] = _stack_point(
-                pft, [T2_k1, T2_k2, T2_d, T2_p], M
-            )
-            fold_kw = dict(
-                fold_pairs=fr.pairs,
-                pf_e_cap=_round_cap(pfh.cap),
-                pf_t_cap=_round_cap(pft.cap),
-                pf_haswc=bool(np.isin(pf_subj, wc_nodes).any()),
-                pf_has_e=pf_k1.shape[0] > 0,
-                pf_has_t=T2_k1.shape[0] > 0,
-                **pff,
-            )
-            # arm the maintenance state with the packing context it
-            # needs at delta time (fold_delta_update)
-            fstate.maps, fstate.N, fstate.cl = maps, N, cl
-        else:
-            fstate = None
+    got = _fold_packed(fr, snap, maps, N, config) if fr is not None else None
+    if got is not None:
+        csr = build_range_hash(cl_k1, min_size=ms)
+        if int(csr.max_run) > config.flat_fold_subj_fan_cap:
+            got = None
+    if got is not None:
+        pf_k1, pf_k2, pf_subj, (u_k1, u_gk, u_until, u_fan), pff = got
+        pfh = build_hash([pf_k1, pf_k2], min_size=ms)
+        out["pfh_off"], out["pfx"] = _stack_point(
+            pfh,
+            [pf_k1, pf_k2]
+            + ([fr.e_cav, fr.e_ctx] if pff["pf_hascav"] else [])
+            + ([fr.e_until] if pff["pf_hasuntil"] else []),
+            M,
+        )
+        pfu = build_range_hash(u_k1, min_size=ms)
+        out["pfu_off"], out["pfugx"], out["pfux"], pfu_cap = _stack_range(
+            pfu, [u_gk, u_until], M, max(64, u_fan)
+        )
+        s_fan = _round_fan(max(int(csr.max_run), 1))
+        out["csr_off"], out["csrgx"], out["csrx"], csr_cap = _stack_range(
+            csr, [cl_k2, cl.c_d_until, cl.c_p_until], M, max(64, s_fan)
+        )
+        fold_kw = dict(
+            fold_pairs=fr.pairs,
+            pf_e_cap=_round_cap(pfh.cap),
+            pf_u_cap=_round_cap(pfu_cap),
+            pf_u_fan=u_fan,
+            pf_s_cap=_round_cap(csr_cap),
+            pf_s_fan=s_fan,
+            pf_haswc=bool(np.isin(pf_subj, wc_nodes).any()),
+            pf_has_e=pf_k1.shape[0] > 0,
+            pf_has_u=u_k1.shape[0] > 0,
+            **pff,
+        )
+        # arm the maintenance state with the packing context it
+        # needs at delta time (fold_delta_update)
+        fstate.maps, fstate.N = maps, N
     else:
         fstate = None
 
@@ -1347,7 +1545,9 @@ def build_flat_arrays_sharded(
             or np.isin(cl.ovf_src[cl.ovf_srel1 == 0], wc_nodes).any()
         ),
     )
-    return out, meta, fstate
+    # closure-delta maintenance is single-chip for now: the sharded
+    # incremental prepare bails to a full rebuild on membership rows
+    return out, meta, fstate, None
 
 
 # ---------------------------------------------------------------------------
@@ -1436,18 +1636,35 @@ def _acc_collapse(acc: Optional[Dict], di, N: int, S1: int, m1, m2) -> Dict:
 
 def build_delta_arrays(
     snap, prev_dsnap, compiled: CompiledSchema, config: EngineConfig
-) -> Optional[Tuple[Dict[str, np.ndarray], "DeltaMeta", Dict]]:
+) -> Optional[Tuple[Dict[str, np.ndarray], "DeltaMeta", Dict, Dict]]:
     """Advance a blockslice-prepared DeviceSnapshot by one revision's
     delta: returns the small ``dl_*`` overlay arrays, the static DeltaMeta,
-    and the new accumulated-delta state — or None when the delta cannot be
-    applied incrementally (caller does a full prepare).
+    the new accumulated-delta state, and an extras dict ({"meta_up":
+    FlatMeta field overrides, "closure_state": the advanced closure host
+    state}) — or None when the delta cannot be applied incrementally
+    (caller does a full prepare).
 
-    Sound-bail conditions (every one falls back to a FULL rebuild, never
-    to wrong answers): membership-subgraph rows (the closure/T-index would
-    change), newly-used userset subjects, permission-valued userset rows,
-    node-radix overflow, wildcard introduction, renumbered contexts, gate
-    columns the base layout lacks, and accumulated-delta size beyond the
-    compaction threshold."""
+    Membership-subgraph rows no longer force a rebuild: the flattened
+    closure advances in place (store/closure.py advance_closure, O(Δ·depth)
+    host work) and the closure-derived device tables — clx/ovfx, sized
+    O(closure), not O(E) — reship with the same names and bucketing, so
+    the compiled kernel keeps serving.  Baked T-index rows of groups whose
+    member set changed are voided through the dirty mechanism (dl_td);
+    past the dirty budget the chain flips the T-index off (sticky
+    ``t_off``) and the KU path probes the live closure instead.
+
+    Used-set SHRINK (a userset losing its last referencing row) does NOT
+    bail: classification stays pinned to the chain-base superset
+    (ClosureHostState.used), whose extra closure rows are unreachable by
+    any probe and keep later re-references exact.
+
+    Remaining sound-bail conditions (every one falls back to a FULL
+    rebuild, never to wrong answers): affected-source set past the cap,
+    newly-used userset subjects, permission-valued userset rows,
+    closure-overflow or wildcard-source transitions the compiled kernel
+    has no probe sites for, node-radix overflow, wildcard introduction,
+    renumbered contexts, gate columns the base layout lacks, and
+    accumulated-delta size beyond the compaction threshold."""
     di = getattr(snap, "delta_info", None)
     meta = prev_dsnap.flat_meta
     if (
@@ -1474,9 +1691,24 @@ def build_delta_arrays(
     all_subj = np.concatenate([di.a_subj, di.g_subj])
     all_srel1 = np.concatenate([di.a_srel1, di.g_srel1])
     # membership-subgraph test: a row FEEDS the closure when the userset
-    # it grants is used as a subject anywhere
+    # it grants is used as a subject anywhere.  Such rows ride the normal
+    # dl_* overlays like any other (they ARE primary/us/ar rows) and
+    # ADDITIONALLY advance the flattened closure below.  Classification
+    # MUST use the closure state's own base used-set (a chain superset —
+    # see ClosureHostState): a mid-chain materialization may recompute a
+    # smaller truth on the snapshot, and classifying against that would
+    # desynchronize the advance from its own edge sets
+    chs = getattr(prev_dsnap, "closure_state", None)
+    if chs is not None:
+        used = chs.used
     edge_key = all_res.astype(np.int64) * num_slots + all_rel.astype(np.int64)
-    if np.isin(edge_key, used).any():
+    mem_any = bool(np.isin(edge_key, used).any())
+    if mem_any and (
+        not config.closure_delta
+        or meta.sharded
+        or chs is None
+        or not meta.has_closure
+    ):
         return None
     us_rows = all_srel1 > 0
     if us_rows.any():
@@ -1550,6 +1782,11 @@ def build_delta_arrays(
     )
     if prev_acc and prev_acc.get("pf_off"):
         acc["pf_off"] = True  # sticky downgrade for the chain remainder
+    if prev_acc:
+        if prev_acc.get("t_off"):
+            acc["t_off"] = True  # sticky T disable for the chain remainder
+        elif prev_acc.get("cl_dirty_k1") is not None:
+            acc["cl_dirty_k1"] = prev_acc["cl_dirty_k1"]
     if meta.rc_slots:
         # rows of a FLATTENED tupleset shift its ancestor closure: bail
         # EARLY (before any table builds) to a full rebuild.  Incremental
@@ -1568,6 +1805,183 @@ def build_delta_arrays(
         return None  # compaction: fold the delta into a fresh base
 
     out: Dict[str, np.ndarray] = {}
+    meta_up: Dict = {}
+    new_chs = chs
+
+    # ---- membership-closure advance ------------------------------------
+    if mem_any:
+        from ..store.closure import advance_closure
+
+        S1r = np.int64(num_slots + 1)
+        a_mem = np.isin(
+            di.a_res.astype(np.int64) * num_slots + di.a_rel, used
+        )
+        g_mem = np.isin(
+            di.g_res.astype(np.int64) * num_slots + di.g_rel, used
+        )
+
+        def edges4(mask):
+            if not mask.any():
+                return None
+            return (
+                di.a_subj[mask].astype(np.int64) * S1r + di.a_srel1[mask],
+                di.a_res[mask].astype(np.int64) * S1r + di.a_rel[mask] + 1,
+                di.a_cav[mask], di.a_exp[mask],
+            )
+
+        def edges2(mask):
+            if not mask.any():
+                return None
+            return (
+                di.g_subj[mask].astype(np.int64) * S1r + di.g_srel1[mask],
+                di.g_res[mask].astype(np.int64) * S1r + di.g_rel[mask] + 1,
+            )
+
+        adv = advance_closure(
+            chs.st, snap.revision,
+            pair_add=edges4(a_mem & (di.a_srel1 > 0)),
+            pair_del=edges2(g_mem & (di.g_srel1 > 0)),
+            seed_add=edges4(a_mem & (di.a_srel1 == 0)),
+            seed_del=edges2(g_mem & (di.g_srel1 == 0)),
+            affected_cap=config.closure_delta_affected_cap,
+        )
+        if adv is None:
+            return None  # affected set over cap / unconverged: rebuild
+        new_cl = adv.state.cl
+        wc_nodes = snap.wildcard_node_of_type[snap.wildcard_node_of_type >= 0]
+        # transitions the compiled kernel has no probe sites for: overflow
+        # appearing under a no-ovf kernel, or under an armed fold (fold
+        # eligibility requires an overflow-free closure, so any overflow
+        # here IS a transition)
+        if adv.state.ovf.shape[0] and (not meta.has_ovf or meta.fold_pairs):
+            return None
+        if (
+            not meta.has_wc_closure
+            and wc_nodes.size
+            and np.isin(
+                (adv.affected_users // S1r).astype(np.int32), wc_nodes
+            ).any()
+        ):
+            return None  # wildcard closure source may appear: rebuild
+
+        # dense-repacked closure keys (the advance cannot introduce slots
+        # the base maps lack — `used` is stable — but verify cheaply)
+        m_srel = m2(new_cl.c_srel1)
+        if ((m_srel <= 0) & (new_cl.c_srel1 > 0)).any():
+            return None
+        grel_d = k2d[np.clip(new_cl.c_grel, 0, max(k2d.shape[0] - 1, 0))]
+        if new_cl.c_grel.shape[0] and (grel_d < 0).any():
+            return None
+        cl_k1 = (
+            new_cl.c_src.astype(np.int64) * S1 + m_srel
+        ).astype(np.int32)
+        cl_k2 = (
+            new_cl.c_g.astype(np.int64) * S1 + grel_d + 1
+        ).astype(np.int32)
+        aligned_tbls = {t[0]: (t[1], t[2], t[3]) for t in meta.aligned}
+
+        def reship_point(tbl_key, off_key, key_cols, cols,
+                         cap_key, n_key):
+            """Rebuild one closure-derived point table in the base
+            layout.  Aligned tables must reproduce their exact geometry
+            (cap/width/spill are part of the compiled kernel) — a
+            mismatch rebuilds; the legacy layout just re-buckets and
+            records the (pow2-stable) cap/size in meta_up."""
+            if tbl_key in aligned_tbls and tbl_key + "_al" in prev_dsnap.arrays:
+                ai = build_aligned(
+                    key_cols, cols, max_bytes=config.flat_aligned_max_bytes
+                )
+                if ai is None or (ai.cap, ai.w, ai.spill_cap) != aligned_tbls[tbl_key]:
+                    return False
+                out[tbl_key + "_al"] = ai.tbl
+                if ai.spill is not None:
+                    out[tbl_key + "_als"] = ai.spill
+                return True
+            h = build_hash(key_cols)
+            out[off_key] = h.off
+            out[tbl_key] = interleave_buckets(h, cols)
+            meta_up[cap_key] = _round_cap(h.cap)
+            meta_up[n_key] = _ceil_pow2(max(h.n, 1))
+            return True
+
+        if not reship_point(
+            "clx", "clh_off", [cl_k1, cl_k2],
+            [cl_k1, cl_k2, new_cl.c_d_until, new_cl.c_p_until],
+            "cl_cap", "cl_n",
+        ):
+            return None
+        if meta.has_ovf:
+            ovf_srel_d = m2(new_cl.ovf_srel1)
+            if ((ovf_srel_d <= 0) & (new_cl.ovf_srel1 > 0)).any():
+                return None
+            ovf_k = (
+                new_cl.ovf_src.astype(np.int64) * S1 + ovf_srel_d
+            ).astype(np.int32)
+            if not reship_point(
+                "ovfx", "ovfh_off", [ovf_k], [ovf_k], "ovf_cap", "ovf_n"
+            ):
+                return None
+        if meta.fold_pairs:
+            # the fold's subject-side csr view IS the closure re-keyed by
+            # source: reship it alongside clx so pf intersections see the
+            # advanced membership.  Gated on the fold being ARMED, not on
+            # pf_has_u — a fold with no base userset rows can still grow
+            # dl_pfu overlay rows mid-chain, and those intersect against
+            # these tables
+            from ..store.closure import NO_EXP as _NO_EXP
+
+            s_run = _max_run_sorted(cl_k1)
+            if s_run > config.flat_fold_subj_fan_cap:
+                return None  # a subject's closure outgrew the tile cap
+            s_fan = _round_fan(max(s_run, 1))
+            pad_s = max(64, s_fan)
+            out["csr_gk"] = _pf_col(cl_k2, pad_s, -1)
+            s_alllive = (
+                bool(
+                    (new_cl.c_d_until == _NO_EXP).all()
+                    and (new_cl.c_p_until == _NO_EXP).all()
+                )
+                if cl_k1.shape[0] else True
+            )
+            if not s_alllive:
+                out["csr_d"] = _pf_col(new_cl.c_d_until, pad_s, 0)
+                out["csr_p"] = _pf_col(new_cl.c_p_until, pad_s, 0)
+            meta_up["pf_s_fan"] = s_fan
+            meta_up["pf_s_alllive"] = s_alllive
+            # hash-backed csr along the chain: rebuilding the dense
+            # offset array per revision costs more host time + H2D than
+            # the whole write budget; the probe-side hash penalty only
+            # applies until the next full prepare restores direct
+            csr = build_range_hash(cl_k1)
+            out["csr_off"] = csr.index.off
+            out["csrgx"] = interleave_buckets(
+                csr.index, [csr.gk, csr.glo, csr.ghi]
+            )
+            meta_up["pf_s_cap"] = _round_cap(csr.index.cap)
+            meta_up["pf_s_direct"] = False
+
+        # stale baked T rows: every T-covered userset row whose group's
+        # member set changed gets its (slot·N + res) key dirtied; past
+        # the budget the chain turns the T-index off instead
+        if meta.has_tindex and not acc.get("t_off"):
+            from ..store.closure import _expand_join as _xj
+
+            if adv.changed_dsts.shape[0] and new_chs.t_pe.shape[0]:
+                _, ii = _xj(new_chs.t_pe, adv.changed_dsts)
+                fresh_dirty = np.unique(new_chs.t_k1[ii])
+            else:
+                fresh_dirty = np.zeros(0, np.int32)
+            prev_dirty = acc.get("cl_dirty_k1")
+            dirty = (
+                np.union1d(prev_dirty, fresh_dirty)
+                if prev_dirty is not None else fresh_dirty
+            )
+            if dirty.shape[0] > config.flat_tindex_dirty_cap:
+                acc["t_off"] = True
+                acc.pop("cl_dirty_k1", None)
+            elif dirty.shape[0]:
+                acc["cl_dirty_k1"] = dirty.astype(np.int32)
+        new_chs = ClosureHostState(adv.state, chs.used, chs.t_pe, chs.t_k1)
 
     def pk(a, radix, b):
         return (a.astype(np.int64) * radix + b).astype(np.int32)
@@ -1681,19 +2095,30 @@ def build_delta_arrays(
             utb, [g_k1[gm], g_k2[gm]], pad=dlpad(int(gm.sum()))
         )
         kw.update(has_ustomb=True, utb_cap=_round_cap(max(16, utb.cap)))
-        if meta.has_tindex:
-            dirty = np.unique(
+    if acc.get("t_off"):
+        kw.update(t_off=True)  # T disabled: no voiding needed, KU answers
+    elif meta.has_tindex:
+        dirty_parts = []
+        if gm.any():
+            dirty_parts.append(np.unique(
                 g_k1[gm][
                     np.isin(acc["g_rel"][gm], np.asarray(meta.t_slots, np.int64))
                 ]
+            ))
+        cld = acc.get("cl_dirty_k1")
+        if cld is not None and cld.shape[0]:
+            dirty_parts.append(cld)
+        dirty = (
+            np.unique(np.concatenate(dirty_parts))
+            if dirty_parts else np.zeros(0, np.int32)
+        )
+        if dirty.size:
+            td = floored_hash([dirty])
+            out["dl_td_off"] = td.off
+            out["dl_tdx"] = interleave_buckets(
+                td, [dirty], pad=dlpad(int(dirty.size))
             )
-            if dirty.size:
-                td = floored_hash([dirty])
-                out["dl_td_off"] = td.off
-                out["dl_tdx"] = interleave_buckets(
-                    td, [dirty], pad=dlpad(int(dirty.size))
-                )
-                kw.update(t_dirty=True, td_cap=_round_cap(max(16, td.cap)))
+            kw.update(t_dirty=True, td_cap=_round_cap(max(16, td.cap)))
 
     # delta arrow view (tupleset relations, direct subjects)
     ts = np.asarray(sorted(compiled.tupleset_slots), np.int64)
@@ -1751,7 +2176,7 @@ def build_delta_arrays(
         if got is None:
             acc["pf_off"] = True
             kw.update(pf_off=True)
-            return out, DeltaMeta(**kw), acc
+            return out, DeltaMeta(**kw), acc, {"meta_up": meta_up, "closure_state": new_chs}
         dirty_k1, ovl = got
         if dirty_k1.shape[0]:
             pdh = floored_hash([dirty_k1])
@@ -1761,10 +2186,14 @@ def build_delta_arrays(
             )
             kw.update(pf_dirty=True, pfd_cap=_round_cap(max(16, pdh.cap)))
         if ovl is not None:
-            packed = _fold_packed(ovl, fstate.cl, snap, fstate.maps, N, config)
+            packed = _fold_packed(ovl, snap, fstate.maps, N, config)
             if packed is None:
-                return None  # overlay T join over budget: rebuild
-            pf_k1, pf_k2, pf_subj, (T2_k1, T2_k2, T2_d, T2_p), pff = packed
+                # overlay fan past the cap: downgrade the chain (sticky
+                # pf_off — folded pairs walk until compaction re-folds)
+                acc["pf_off"] = True
+                kw.update(pf_off=True)
+                return out, DeltaMeta(**kw), acc, {"meta_up": meta_up, "closure_state": new_chs}
+            pf_k1, pf_k2, pf_subj, (u_k1, u_gk, u_until, u_fan), pff = packed
             if pf_k1.shape[0]:
                 peh = floored_hash([pf_k1, pf_k2])
                 out["dl_pfe_off"] = peh.off
@@ -1784,16 +2213,26 @@ def build_delta_arrays(
                         np.isin(pf_subj, fstate.wc_nodes).any()
                     ),
                 )
-            if T2_k1.shape[0]:
-                pth = floored_hash([T2_k1, T2_k2])
-                out["dl_pft_off"] = pth.off
-                out["dl_pftx"] = interleave_buckets(
-                    pth, [T2_k1, T2_k2, T2_d, T2_p],
-                    pad=dlpad(int(T2_k1.shape[0])),
+            if u_k1.shape[0]:
+                n_u = int(u_k1.shape[0])
+                pfu = build_range_hash(
+                    u_k1, min_size=max(2 * F, _q4(4 * n_u)), max_factor=1
                 )
-                kw.update(pf_ovl_t=True, pfo_t_cap=_round_cap(max(16, pth.cap)))
+                out["dl_pfu_off"] = pfu.index.off
+                out["dl_pfugx"] = interleave_buckets(
+                    pfu.index, [pfu.gk, pfu.glo, pfu.ghi], pad=dlpad(n_u)
+                )
+                fan = _round_fan(max(8, u_fan))
+                out["dl_pfux"] = interleave_rows(
+                    [u_gk, u_until], pad=max(dlpad(n_u), fan)
+                )
+                kw.update(
+                    pf_ovl_u=True,
+                    pfo_u_cap=_round_cap(max(16, pfu.index.cap)),
+                    pfo_u_fan=fan,
+                )
 
-    return out, DeltaMeta(**kw), acc
+    return out, DeltaMeta(**kw), acc, {"meta_up": meta_up, "closure_state": new_chs}
 
 
 # ---------------------------------------------------------------------------
@@ -2118,10 +2557,14 @@ def make_flat_fn(
         # fully folded dispatch is JUST the two pf probes)
         dyn_e = any(s in meta.e_slots for s in slots)
         dyn_us_fan = max((us_fans.get(s, 0) for s in slots), default=0)
-        t_cover = meta.has_tindex and all(
+        # sticky chain-level T disable (membership-closure deltas staled
+        # more baked T rows than the dirty budget): the KU path probes
+        # the live closure instead
+        t_on = meta.has_tindex and not (dm is not None and dm.t_off)
+        t_cover = t_on and all(
             s in meta.t_slots for s in slots if s in meta.us_slots
         )
-        dyn_t = meta.has_tindex and t_cover and any(
+        dyn_t = t_on and t_cover and any(
             s in meta.t_slots for s in slots
         )
 
@@ -2131,12 +2574,99 @@ def make_flat_fn(
             + (["until"] if meta.pf_hasuntil else [])
         )
 
+        # fold subject side: the query subject's (and wildcard node's)
+        # group-closure slices from the csr closure-by-source view,
+        # computed ONCE per dispatch — [B, S] key/plane-liveness tiles
+        # the pf_u sites intersect against in registers.  This is the
+        # sorted-key-column intersection (Leopard's skipping-list read)
+        # that replaces the dense (resource × member) fold T-join: no
+        # per-group hash probes, no product materialization.  Single-chip
+        # layouts slice SPLIT 1-wide columns with the range resolved from
+        # the csr_start offset array (two element gathers); the sharded
+        # layout keeps the packed bucket-sharded view
+        _pf_subj_cell: List = []
+
+        def pf_subj_slices():
+            if _pf_subj_cell:
+                return _pf_subj_cell[0]
+            fanS = max(meta.pf_s_fan, 1)
+
+            def csr_slice(k):
+                ok = k >= 0
+                if not SH and meta.pf_s_direct:
+                    kc = jnp.where(ok, k, 0)
+                    lo = tk(arrs["csr_start"], kc)
+                    hi = jnp.where(ok, tk(arrs["csr_start"], kc + 1), lo)
+                else:
+                    lo, hi = range_probe(
+                        "csr_off", "csrgx", meta.pf_s_cap, k
+                    )
+                valid = (
+                    jnp.arange(fanS, dtype=jnp.int32) < (hi - lo)[..., None]
+                ) & ok[..., None]
+                if SH:
+                    blk = slice_blocks(arrs["csrx"], lo, fanS)
+                    blk = vbcast(valid[..., None], blk)
+                    valid = por(valid)
+                    gk = jnp.where(valid, blk[..., 0], -1)
+                    dok = valid & (jnp.where(valid, blk[..., 1], 0) > now)
+                    pok = valid & (jnp.where(valid, blk[..., 2], 0) > now)
+                    return gk, dok, pok
+                gk = slice_blocks(arrs["csr_gk"], lo, fanS)[..., 0]
+                gk = jnp.where(valid, gk, -1)
+                if meta.pf_s_alllive:
+                    # None planes: containment alone grants both (the
+                    # intersection then runs ONE reduce with no plane
+                    # tiles — invalid lanes are already -1-masked)
+                    return gk, None, None
+                dv = slice_blocks(arrs["csr_d"], lo, fanS)[..., 0]
+                pv = slice_blocks(arrs["csr_p"], lo, fanS)[..., 0]
+                dok = valid & (jnp.where(valid, dv, 0) > now)
+                pok = valid & (jnp.where(valid, pv, 0) > now)
+                return gk, dok, pok
+
+            slices = [csr_slice(q_k2)]
+            if meta.has_wc_closure:
+                slices.append(csr_slice(wcl_k))
+            _pf_subj_cell.append(slices)
+            return slices
+
+        # fold-slot compact ids for the direct pfu_start lookup
+        if fold_on and meta.pf_has_u and meta.pf_direct:
+            _fm = np.full(max(plan.num_slots, 1), -1, np.int32)
+            for _i, _s in enumerate(sorted({s for _, s in meta.fold_pairs})):
+                _fm[_s] = _i
+            pf_fidx_t = jnp.asarray(_fm)
+        else:
+            pf_fidx_t = None
+
+        def pf_isect(gk, live):
+            """(d, p) of the folded userset rows ``gk``/``live``
+            ([..., fan], lattice-shaped) against the subject slices:
+            a broadcast [fan × S] compare, reduced over both axes."""
+            d = jnp.zeros(live.shape[:-1], bool)
+            p = jnp.zeros(live.shape[:-1], bool)
+            for (sgk, sdok, spok) in pf_subj_slices():
+                shp = (sgk.shape[0],) + (1,) * (gk.ndim - 2) + (1, sgk.shape[1])
+                m = live[..., None] & (gk[..., None] == sgk.reshape(shp))
+                if sdok is None:  # all-live closure: one containment reduce
+                    hit = jnp.any(m, axis=(-1, -2))
+                    d, p = d | hit, p | hit
+                else:
+                    d = d | jnp.any(m & sdok.reshape(shp), axis=(-1, -2))
+                    p = p | jnp.any(m & spok.reshape(shp), axis=(-1, -2))
+            return d, p
+
         def pf_probe(slot, nodes):
             """Folded-permission test at a [B, ...] node lattice: ONE
-            direct-identity probe (pf_e) + ONE membership probe (pf_t),
-            the whole rewrite pre-joined at prepare time (engine/fold.py).
-            ``slot=None`` = dynamic (q_perm is the slot).  Fold tables
-            are exact — no fan caps, so no overflow contributions."""
+            direct-identity probe (pf_e) + one bounded-fan userset slice
+            (pf_u) intersected with the member closure — the rewrite
+            pre-joined at prepare time (engine/fold.py), the membership
+            expansion factored out so the tables never materialize the
+            (resource × member) product and the closure can advance in
+            place under membership deltas.  ``slot=None`` = dynamic
+            (q_perm is the slot).  Fold tables are exact — the fan covers
+            the true max group count, so no overflow contributions."""
             nd = nodes.ndim
             zn = jnp.zeros(nodes.shape, bool)
             d = p = zn
@@ -2175,23 +2705,51 @@ def make_flat_fn(
                 if meta.pf_haswc:
                     wd, wp = pe_site(bq(w_k2, nd))
                     d, p = d | wd, p | wp
-            if meta.pf_has_t:
-                def pt_site(k2q):
-                    blk, mine = pblock(
-                        "pfth_off", "pftx", meta.pf_t_cap,
-                        (k1, k2q),
+            if meta.pf_has_u:
+                # folded userset groups: one contiguous fan slice, then
+                # the register intersection with the subject's closure
+                # slice (the Leopard skipping-list read — never the dense
+                # product, never per-group hash probes)
+                fanU = max(meta.pf_u_fan, 1)
+                if not SH and meta.pf_direct:
+                    fc = (
+                        tk(pf_fidx_t, jnp.clip(bq(q_perm, nd), 0, None))
+                        if slot is None
+                        else jnp.int32(
+                            sorted({s for _, s in meta.fold_pairs}).index(slot)
+                        )
                     )
-                    hit = blk_hit(blk, (k1, k2q), mine) & exists[..., None]
-                    return (
-                        por(jnp.any(hit & (blk[..., 2] > now), axis=-1)),
-                        por(jnp.any(hit & (blk[..., 3] > now), axis=-1)),
+                    ok = exists & (fc >= 0)
+                    base = jnp.where(ok, fc * Nc + nodes, 0)
+                    lo = tk(arrs["pfu_start"], base)
+                    hi = jnp.where(ok, tk(arrs["pfu_start"], base + 1), lo)
+                else:
+                    lo, hi = range_probe(
+                        "pfu_off", "pfugx", meta.pf_u_cap, k1
                     )
-
-                td, tp = pt_site(bq(q_k2, nd))
-                d, p = d | td, p | tp
-                if meta.has_wc_closure:
-                    wtd, wtp = pt_site(bq(wcl_k, nd))
-                    d, p = d | wtd, p | wtp
+                valid = (
+                    jnp.arange(fanU, dtype=jnp.int32) < (hi - lo)[..., None]
+                ) & exists[..., None]
+                if SH:
+                    ublk = slice_blocks(arrs["pfux"], lo, fanU)
+                    ublk = vbcast(valid[..., None], ublk)
+                    valid = por(valid)
+                    gk = jnp.where(valid, ublk[..., 0], -1)
+                    live = valid & (jnp.where(valid, ublk[..., 1], 0) > now)
+                else:
+                    gk = slice_blocks(arrs["pfu_gk"], lo, fanU)[..., 0]
+                    gk = jnp.where(valid, gk, -1)
+                    if meta.pf_u_alllive:
+                        live = valid
+                    else:
+                        uv = slice_blocks(arrs["pfu_u"], lo, fanU)[..., 0]
+                        live = valid & (jnp.where(valid, uv, 0) > now)
+                nd2 = nd + 1
+                ud, up = pf_isect(gk, live)
+                refl = (gk == bq(q_k2, nd2)) & (bq(q_k2, nd2) >= 0)
+                r_hit = jnp.any(live & refl, axis=-1)
+                d = d | ud | r_hit
+                p = p | up | r_hit
             # incremental maintenance: void base hits at DIRTY resources,
             # then OR in the recomputed replacement rows.  The overlay
             # tables are replicated (plain probes, identical on every
@@ -2239,23 +2797,25 @@ def make_flat_fn(
                 if dm.pf_ovl_haswc:
                     owd, owp = po_site(bq(w_k2, nd))
                     d, p = d | owd, p | owp
-            if dm is not None and dm.pf_ovl_t:
-                def pot_site(k2q):
-                    blk = probe_block(
-                        arrs["dl_pft_off"], arrs["dl_pftx"], dm.pfo_t_cap,
-                        (k1, k2q),
-                    )
-                    hit = blk_hit(blk, (k1, k2q)) & exists[..., None]
-                    return (
-                        jnp.any(hit & (blk[..., 2] > now), axis=-1),
-                        jnp.any(hit & (blk[..., 3] > now), axis=-1),
-                    )
-
-                otd, otp = pot_site(bq(q_k2, nd))
-                d, p = d | otd, p | otp
-                if meta.has_wc_closure:
-                    owtd, owtp = pot_site(bq(wcl_k, nd))
-                    d, p = d | owtd, p | owtp
+            if dm is not None and dm.pf_ovl_u:
+                # replacement folded-userset rows for dirty resources:
+                # replicated range view, same register intersection
+                fanO = max(dm.pfo_u_fan, 1)
+                lo, hi = range_probe(
+                    "dl_pfu_off", "dl_pfugx", dm.pfo_u_cap, k1, rep=True
+                )
+                valid = (
+                    jnp.arange(fanO, dtype=jnp.int32) < (hi - lo)[..., None]
+                ) & exists[..., None]
+                ublk = slice_blocks(arrs["dl_pfux"], lo, fanO)
+                gk = jnp.where(valid, ublk[..., 0], -1)
+                live = valid & (jnp.where(valid, ublk[..., 1], 0) > now)
+                nd2 = nd + 1
+                od, op_ = pf_isect(gk, live)
+                refl = (gk == bq(q_k2, nd2)) & (bq(q_k2, nd2) >= 0)
+                r_hit = jnp.any(live & refl, axis=-1)
+                d = d | od | r_hit
+                p = p | op_ | r_hit
             return d, p
 
         # Every eval function returns (definite, possible, ovf, used):
@@ -2338,7 +2898,7 @@ def make_flat_fn(
 
             # T-index fast path: one probe folds {userset edge × closure}
             use_t = dyn_t if dyn else (
-                meta.has_tindex and slot in meta.t_slots
+                t_on and slot in meta.t_slots
             )
             if use_t:
                 def t_site(k2q):

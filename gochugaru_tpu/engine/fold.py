@@ -7,11 +7,15 @@ check still walks the doc→folder→…→root lattice at trace time, paying an
 e-probe + T-probe + arrow-range per level — ~20 dependent gathers into
 multi-GB tables for BASELINE config 3's 5-hop world.  Folding joins the
 rewrite's arrow chains into the leaf rows once per revision, so the same
-check is ONE direct-identity probe (pf_e) plus ONE membership probe
-(pf_t), regardless of depth — the full Leopard construction: resource-
-side ancestor flattening ⋈ userset edges ⋈ the member closure
-(store/closure.py), with expiries folded along paths through the same
-max-min two-plane semiring.
+check is ONE direct-identity probe (pf_e) plus ONE bounded-fan userset
+slice (pf_u) intersected with the member closure at probe time,
+regardless of depth — the Leopard construction with the member
+expansion FACTORED OUT: resource-side ancestor flattening ⋈ userset
+edges stays precomputed, and the closure (store/closure.py) is probed
+per candidate group instead of being joined in (the round-5 dense
+T-join materialized resource × member and regressed config 3; see
+fold_userset_rows).  Expiries fold along paths through the same max-min
+two-plane semiring.
 
 Eligibility is per (type, permission): the program must be a union tree
 over relation leaves, same-type folded permissions, and arrows through
@@ -221,7 +225,6 @@ class FoldState:
     # attached by build_flat_arrays* after packing succeeds:
     maps: object = None  # flat.SlotMaps
     N: int = 0
-    cl: object = None  # store.closure.ClosureIndex
 
 
 @dataclass
@@ -883,7 +886,7 @@ def t_join_core(
     c_d: np.ndarray, c_p: np.ndarray, cap_rows: int,
 ) -> Optional[Tuple[np.ndarray, ...]]:
     """The T-index join shared by the base table (flat.py _tindex_join)
-    and the fold (fold_tindex_join): userset entries (k1, group-key pe,
+    and (historically) the fold: userset entries (k1, group-key pe,
     until w) ⋈ closure-by-target, plus the direct group-identity entries,
     deduped max-per-plane.  Sizes the join BEFORE materializing it;
     returns None past ``cap_rows`` (a popular group with a huge closure
@@ -915,37 +918,29 @@ def t_join_core(
     )
 
 
-def fold_tindex_join(fr: FoldResult, cl, N: int, maps,
-                     factor: int,
-                     max_rows: Optional[int] = None,
-                     ) -> Optional[Tuple[np.ndarray, ...]]:
-    """pf_t: folded userset rows ⋈ closure-by-target, plus the direct
-    group-identity entries — the T-index join over the FOLDED rows,
-    packed with the DENSE radices (``maps`` is flat.SlotMaps).  Returns
-    (k1, k2, d_until, p_until) or None when over budget (the caller then
-    drops folding; the walk still answers)."""
-    if fr.u_res.shape[0] == 0:
-        z = np.zeros(0, np.int32)
-        return z, z, z, z
-    from .flat import _m_srel1  # deferred: flat imports us lazily too
+def fold_userset_rows(fr: FoldResult, N: int, maps
+                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """pf_u: the folded userset rows packed with the DENSE radices
+    (``maps`` is flat.SlotMaps), sorted by their (slot·N + res) group key.
 
-    S1 = maps.S1
+    This is the REACHABILITY-PRUNED replacement for the round-5 dense
+    fold T-join (u rows ⋈ closure-by-target), which materialized the full
+    (resource × member) product — 268M rows at BASELINE config 3, where
+    every document repeats its ancestor chain's group closures.  The
+    factored form stores only the reachable (resource, group) pairs
+    (the Leapfrog-style key intersection: iterate the keys both sides
+    share, never the cross product) and the kernel intersects with the
+    member closure at probe time — one bounded-fan range slice plus one
+    closure probe per candidate group, independent of nesting depth.
+    Factoring through the closure also makes the fold's tables
+    independent of the membership closure, which is what lets membership
+    deltas advance the closure in place without re-folding anything
+    (store/closure.py advance_closure)."""
     k1 = (
         maps.k1[fr.u_slot].astype(np.int64) * N + fr.u_res
     ).astype(np.int32)
-    pe = (
-        fr.u_subj.astype(np.int64) * S1 + maps.k2[fr.u_srel] + 1
+    gk = (
+        fr.u_subj.astype(np.int64) * maps.S1 + maps.k2[fr.u_srel] + 1
     ).astype(np.int32)
-    cl_k1 = (
-        cl.c_src.astype(np.int64) * S1 + _m_srel1(maps, cl.c_srel1)
-    ).astype(np.int32)
-    cl_k2 = (
-        cl.c_g.astype(np.int64) * S1 + maps.k2[cl.c_grel] + 1
-    ).astype(np.int32)
-    budget = factor * max(int(pe.shape[0]), 1024)
-    if max_rows is not None:
-        budget = min(budget, max_rows)
-    return t_join_core(
-        k1, pe, fr.u_until, cl_k1, cl_k2, cl.c_d_until, cl.c_p_until,
-        budget,
-    )
+    order = np.argsort(k1, kind="stable")
+    return k1[order], gk[order], fr.u_until[order]

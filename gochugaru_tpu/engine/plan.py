@@ -30,11 +30,6 @@ from ..schema.ast import (
 )
 from ..schema.compiler import CompiledSchema, _expr_refs
 
-#: auto value for EngineConfig.flat_fold_tindex_max_rows (see its
-#: sizing note); one definition so the config doc and the resolver
-#: cannot drift
-FOLD_TINDEX_AUTO_MAX_ROWS = 320_000_000
-
 # Expression IR: nested tuples, all leaves static ints.
 #   ("ref", slot) ("arrow", ts_idx, right_slot) ("union", (c...))
 #   ("inter", (c...)) ("excl", base, sub) ("nil",)
@@ -103,25 +98,24 @@ class EngineConfig:
     #: folded row budget as a multiple of (E + US) row counts; pairs
     #: beyond it stay on the walked path
     flat_fold_factor: int = 16
-    #: fold T-side join budget as a multiple of the FOLDED userset row
-    #: count (engine/fold.py fold_tindex_join).  Separate from (and much
-    #: larger than) flat_tindex_factor: the fold's u rows are already
-    #: lifted to root resources, so their closure join is denser — at
-    #: BASELINE config 2 scale it runs ~100 members/team over ~40k rows
-    #: (~4M join rows, ~130MB of tables), which the shared factor's cap
-    #: silently rejected, throwing away the whole fold and the ~2x
-    #: kernel collapse that comes with it
-    flat_fold_tindex_factor: int = 256
-    #: ABSOLUTE row cap on the fold's T join, on top of the factor —
-    #: a guard against runaway joins (an over-budget join drops the
-    #: whole fold, and the walked path is far slower than even a
-    #: cache-hostile fold: config 3 measured fold-on 65ms/step vs
-    #: fold-off 914ms at 10M edges).  None = auto
-    #: (FOLD_TINDEX_AUTO_MAX_ROWS).  Sizing note: the final T table is
-    #: 16 B/row, but t_join_core's transient build peak (index arrays,
-    #: lexsort permutation, reindexed copies) is ~3x that — the auto
-    #: cap of 320M rows bounds the transient at ~15GB
-    flat_fold_tindex_max_rows: Optional[int] = None
+    #: max userset-group fan per folded (slot, resource) in the pf_u
+    #: range table (engine/fold.py fold_userset_rows — the factored
+    #: replacement for the round-5 dense fold T-join).  A resource whose
+    #: folded group list exceeds this would blow the kernel's per-query
+    #: slice width, so the fold declines and the walked path answers
+    flat_fold_u_fan_cap: int = 64
+    #: max closure rows per SOURCE in the fold's subject-side slice (the
+    #: csr closure-by-source view): the kernel intersects the resource's
+    #: pf_u group list with the subject's group closure as a pure
+    #: [u_fan × s_fan] register compare — no per-group gathers — so this
+    #: bounds that compare tile.  A world whose hottest subject belongs
+    #: to more groups declines the fold (walked path answers)
+    flat_fold_subj_fan_cap: int = 64
+    #: per-array entry budget for the fold's DIRECT offset arrays
+    #: (pfu_start: fold-slots·N entries; csr_start: N·S1 entries) —
+    #: two element gathers replace a hash probe per range lookup.  Key
+    #: spaces beyond it keep the hash group tables
+    flat_pf_direct_max_entries: int = 1 << 25
     #: incremental fold maintenance (engine/fold.py fold_delta_update):
     #: max total dirty resources per delta chain.  Past it the chain
     #: DOWNGRADES folded pairs to their walked programs (sticky pf_off
@@ -129,6 +123,20 @@ class EngineConfig:
     #: ancestor can dirty a whole subtree, and recomputing that each
     #: revision would cost more than walking
     flat_fold_delta_dirty_cap: int = 16_384
+    #: advance the flattened membership closure in place on membership-
+    #: subgraph deltas (store/closure.py advance_closure) instead of
+    #: bailing to a full prepare — the O(Δ·depth) write path
+    closure_delta: bool = True
+    #: max affected closure sources per advance; a delta whose reverse
+    #: reachability fans past this rebuilds instead (a hot group touched
+    #: near the nesting root can implicate everything below it)
+    closure_delta_affected_cap: int = 65_536
+    #: max accumulated T-index-dirty resource keys per delta chain.
+    #: Membership deltas stale the baked T rows of every resource whose
+    #: userset group changed; past this bound the chain flips the
+    #: T-index OFF (sticky, like pf_off) and the KU path — which probes
+    #: the live closure directly — answers those slots until compaction
+    flat_tindex_dirty_cap: int = 65_536
     #: bucket-ALIGNED probe tables (engine/hash.py build_aligned): each
     #: bucket is ONE table row fetched with a single row gather — on TPU
     #: ~48M probes/s vs 0.75M for the off+block layout (measured,

@@ -180,7 +180,7 @@ class ShardedEngine(DeviceEngine):
                 snap, self.config, self.model_size, plan=self.plan
             )
             if built is not None:
-                flat_arrays, flat_meta, fold_state = built
+                flat_arrays, flat_meta, fold_state, _cstate = built
                 host = dict(flat_arrays)
                 host["node_type"] = _pad_payload(
                     snap.node_type, _ceil_pow2(2 * snap.num_nodes), -1

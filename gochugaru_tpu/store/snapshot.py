@@ -265,32 +265,32 @@ class Snapshot:
         decode_columns so there is ONE definition of field decoding (the
         columnar path).  Progressive chunks: an early-exiting consumer
         (first-match reads) pays a 256-row decode; full exports amortize
-        at 64k."""
+        at 64k.  Rows materialize through the bulk-decode fast
+        constructor (rel/relationship.py decoded_relationship) with a
+        C-speed zip over the column lists — the frozen-dataclass
+        ``__init__`` was the export path's throughput ceiling."""
+        from ..rel.relationship import decoded_relationship
+
         ch, at = 256, 0
         while at < rows.shape[0]:
             blk = rows[at : at + ch]
             at += ch
             ch = min(ch * 4, 1 << 16)
             for cols in self.decode_columns(blk, chunk=int(blk.shape[0])):
-                rids = cols["resource_ids"]
-                for j in range(len(rids)):
-                    exp_us = cols["expirations_us"][j]
-                    yield Relationship(
-                        resource_type=cols["resource_types"][j],
-                        resource_id=rids[j],
-                        resource_relation=cols["resource_relations"][j],
-                        subject_type=cols["subject_types"][j],
-                        subject_id=cols["subject_ids"][j],
-                        subject_relation=cols["subject_relations"][j],
-                        caveat_name=cols["caveat_names"][j],
-                        caveat_context=cols["caveat_contexts"][j],
-                        expiration=(
-                            _dt.datetime.fromtimestamp(
-                                exp_us / 1_000_000, tz=_dt.timezone.utc
-                            )
-                            if exp_us
-                            else None
-                        ),
+                for (rt, rid, rr, st, sid, sr, cn, cc, exp_us) in zip(
+                    cols["resource_types"], cols["resource_ids"],
+                    cols["resource_relations"], cols["subject_types"],
+                    cols["subject_ids"], cols["subject_relations"],
+                    cols["caveat_names"], cols["caveat_contexts"],
+                    cols["expirations_us"],
+                ):
+                    yield decoded_relationship(
+                        rt, rid, rr, st, sid, sr, cn, cc,
+                        _dt.datetime.fromtimestamp(
+                            exp_us / 1_000_000, tz=_dt.timezone.utc
+                        )
+                        if exp_us
+                        else None,
                     )
 
 

@@ -51,3 +51,81 @@ def _reset_faults():
     faults.reset()
     yield
     faults.reset()
+
+
+# Multi-host capability probe: some container jaxlib builds cannot run
+# multiprocess collectives on the CPU backend at all ("Multiprocess
+# computations aren't implemented on the CPU backend") — an ENVIRONMENT
+# limitation, not a code defect.  Probe it once (two 1-device processes,
+# jax.distributed init + one cross-process broadcast) and skip the
+# multi-host tests with the detected reason instead of carrying known-red
+# failures in tier-1.
+_MULTIHOST_PROBE = []  # memo: [None] = supported, [reason str] = not
+
+_PROBE_SRC = """
+import os
+import numpy as np
+import jax
+jax.distributed.initialize(
+    os.environ["GOCHUGARU_PROBE_COORD"], 2,
+    int(os.environ["GOCHUGARU_PROBE_PID"]),
+)
+from jax.experimental import multihost_utils
+multihost_utils.broadcast_one_to_all(np.ones(1, np.int32))
+print("MULTIHOST-PROBE-OK")
+"""
+
+
+def _multihost_unavailable_reason():
+    """None when the environment can run multi-process CPU collectives,
+    else a one-line reason string (cached per session)."""
+    if _MULTIHOST_PROBE:
+        return _MULTIHOST_PROBE[0]
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+    procs = []
+    for pid in range(2):
+        env = dict(
+            os.environ,
+            GOCHUGARU_PROBE_COORD=coord,
+            GOCHUGARU_PROBE_PID=str(pid),
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _PROBE_SRC], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        ))
+    reason = None
+    for pr in procs:
+        try:
+            out, _ = pr.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            pr.kill()
+            out, _ = pr.communicate()
+            reason = reason or "probe timed out (collective hung)"
+            continue
+        if pr.returncode != 0 or "MULTIHOST-PROBE-OK" not in (out or ""):
+            tail = [
+                ln for ln in (out or "").splitlines()
+                if "Error" in ln or "error" in ln
+            ]
+            reason = reason or (
+                tail[-1].strip()[:160] if tail else "probe process failed"
+            )
+    _MULTIHOST_PROBE.append(reason)
+    return reason
+
+
+@pytest.fixture(autouse=True)
+def _skip_unsupported_multihost(request):
+    if request.module.__name__ == "test_multihost":
+        reason = _multihost_unavailable_reason()
+        if reason is not None:
+            pytest.skip(f"multi-host env unavailable: {reason}")
+    yield

@@ -308,3 +308,201 @@ def test_max_hops_exhaustion_overflows_not_silently_wrong():
             assert s in ovf, (s, dsts, v, got.get((s, dsts)))
     # and no overflowed source has partial rows
     assert not any(s in ovf for (s, _) in got)
+
+
+# ---------------------------------------------------------------------------
+# incremental maintenance: advance_closure ≡ build_closure, bitwise
+# ---------------------------------------------------------------------------
+
+from gochugaru_tpu.store.closure import advance_closure, build_closure_state
+from gochugaru_tpu.store.interner import Interner
+from gochugaru_tpu.store.snapshot import _exp_to_rel32
+from gochugaru_tpu.rel.relationship import expiration_micros
+
+
+def _random_member_edge(rng, n_users=12, n_groups=8):
+    g = rng.integers(0, n_groups)
+    rl = "member" if rng.random() < 0.7 else "other"
+    caveat = "c1" if (rl == "member" and rng.random() < 0.2) else ""
+    exp_s = int(rng.integers(1, 1000)) if rng.random() < 0.3 else 0
+    if rng.random() < 0.5:
+        subj, srel = f"user:u{rng.integers(0, n_users)}", ""
+    else:
+        srel = "member" if rng.random() < 0.7 else "other"
+        subj = f"group:g{rng.integers(0, n_groups)}"
+    r = rel(f"group:g{g}", rl, subj, srel, caveat, exp_s)
+    return {(f"group:g{g}", rl, subj, srel): r}
+
+
+def _pack_identity(snap, r):
+    """(packed src, packed dst, srel1) of one membership row."""
+    S1 = snap.num_slots + 1
+    slot = snap.compiled.slot_of_name
+    subj = snap.interner.lookup(r.subject_type, r.subject_id)
+    res = snap.interner.lookup(r.resource_type, r.resource_id)
+    srel1 = slot[r.subject_relation] + 1 if r.subject_relation else 0
+    return (
+        subj * S1 + srel1,
+        res * S1 + slot[r.resource_relation] + 1,
+        srel1,
+    )
+
+
+def _index_equal(a, b):
+    for f in ("c_src", "c_srel1", "c_g", "c_grel", "c_d_until", "c_p_until",
+              "ovf_src", "ovf_srel1"):
+        x, y = getattr(a, f), getattr(b, f)
+        if x.shape != y.shape or not np.array_equal(x, y):
+            return f
+    return None
+
+
+def _run_delta_sequence(seed, cap=4096, steps=10):
+    """Random membership-edge delta sequence: the incrementally-advanced
+    closure must equal a from-scratch rebuild BITWISE at every step."""
+    rng = np.random.default_rng(seed)
+    cs = compile_schema(parse_schema(SCHEMA))
+    interner = Interner()
+    rels = {}
+    # doc anchors keep every group userset "used" (deleting the last use
+    # shrinks the membership views — the engine bails there rather than
+    # advancing, so the property holds over a stable used set)
+    for g in range(8):
+        rels[("doc:d0", "reader", f"group:g{g}", "member")] = rel(
+            "doc:d0", "reader", f"group:g{g}", "member")
+        rels[("doc:d1", "reader", f"group:g{g}", "other")] = rel(
+            "doc:d1", "reader", f"group:g{g}", "other")
+    for _ in range(30):
+        rels.update(_random_member_edge(rng))
+    snap = build_snapshot(1, cs, interner, list(rels.values()),
+                          epoch_us=EPOCH_US)
+    st = build_closure_state(
+        snap, build_closure(snap, per_source_cap=cap), per_source_cap=cap
+    )
+    used = set(snap.us_used_keys.tolist())
+    num_slots = snap.num_slots
+    slot = cs.slot_of_name
+
+    for step in range(steps):
+        adds, dels = {}, {}
+        keys = [k for k in rels if not k[0].startswith("doc:")]
+        for _ in range(rng.integers(1, 5)):
+            if keys and rng.random() < 0.4:
+                k = keys[rng.integers(0, len(keys))]
+                dels[k] = rels[k]
+            else:
+                adds.update(_random_member_edge(rng))
+        for k in dels:
+            rels.pop(k, None)
+        prev_rels = dict(rels)
+        rels.update(adds)
+        nsnap = build_snapshot(step + 2, cs, interner, list(rels.values()),
+                               epoch_us=EPOCH_US)
+        pair_add, seed_add, pair_del, seed_del = [], [], [], []
+        for k, r in adds.items():
+            res = interner.lookup(r.resource_type, r.resource_id)
+            if res * num_slots + slot[r.resource_relation] not in used:
+                continue
+            src, dst, srel1 = _pack_identity(nsnap, r)
+            exp_us = expiration_micros(r.expiration) if r.has_expiration() else 0
+            exp32 = int(_exp_to_rel32(np.array([exp_us], np.int64), EPOCH_US)[0])
+            cav = cs.caveat_ids[r.caveat_name] if r.caveat_name else 0
+            (pair_add if srel1 > 0 else seed_add).append((src, dst, cav, exp32))
+            if k in prev_rels and k not in dels:  # upsert = delete + add
+                osrc, odst, osrel1 = _pack_identity(nsnap, prev_rels[k])
+                (pair_del if osrel1 > 0 else seed_del).append((osrc, odst))
+        for k, r in dels.items():
+            res = interner.lookup(r.resource_type, r.resource_id)
+            if res * num_slots + slot[r.resource_relation] not in used:
+                continue
+            src, dst, srel1 = _pack_identity(nsnap, r)
+            (pair_del if srel1 > 0 else seed_del).append((src, dst))
+
+        def c4(rows):
+            if not rows:
+                return None
+            a = np.array(rows, np.int64)
+            return (a[:, 0], a[:, 1], a[:, 2].astype(np.int32),
+                    a[:, 3].astype(np.int32))
+
+        def c2(rows):
+            if not rows:
+                return None
+            a = np.array(rows, np.int64)
+            return a[:, 0], a[:, 1]
+
+        got = advance_closure(
+            st, nsnap.revision,
+            pair_add=c4(pair_add), pair_del=c2(pair_del),
+            seed_add=c4(seed_add), seed_del=c2(seed_del),
+        )
+        assert got is not None, f"seed={seed} step={step}: advance bailed"
+        st = got.state
+        want = build_closure(nsnap, per_source_cap=cap)
+        bad = _index_equal(st.cl, want)
+        assert bad is None, f"seed={seed} step={step}: field {bad} differs"
+
+
+def test_advance_closure_bitwise_equal_property():
+    for seed in range(6):
+        _run_delta_sequence(seed)
+
+
+def test_advance_closure_bitwise_equal_under_overflow():
+    # per_source_cap=4 exercises overflow creation, propagation to user
+    # sources, and un-overflow on deletes — all must match the rebuild
+    for seed in range(4):
+        _run_delta_sequence(seed + 100, cap=4)
+
+
+def test_advance_closure_empty_delta_is_identity():
+    cs = compile_schema(parse_schema(SCHEMA))
+    interner = Interner()
+    rels = [
+        rel("group:g0", "member", "user:u0"),
+        rel("group:g1", "member", "group:g0", "member"),
+        rel("doc:d", "reader", "group:g1", "member"),
+        rel("doc:d", "reader", "group:g0", "member"),
+    ]
+    snap = build_snapshot(1, cs, interner, rels, epoch_us=EPOCH_US)
+    cl = build_closure(snap)
+    st = build_closure_state(snap, cl)
+    got = advance_closure(st, 2)
+    assert got is not None
+    assert got.state is st  # no work → same state object
+    assert got.changed_dsts.shape[0] == 0
+
+
+def test_advance_closure_value_change_reports_changed_group():
+    # replacing an expiring member edge with a longer-lived one changes
+    # the VALUE of existing closure rows: the touched groups must be
+    # reported (they drive the engine's T-index dirty set)
+    cs = compile_schema(parse_schema(SCHEMA))
+    interner = Interner()
+    rels = [
+        rel("group:g0", "member", "user:u0", exp_s=100),
+        rel("group:g1", "member", "group:g0", "member"),
+        rel("doc:d", "reader", "group:g1", "member"),
+        rel("doc:d", "reader", "group:g0", "member"),
+    ]
+    snap = build_snapshot(1, cs, interner, rels, epoch_us=EPOCH_US)
+    cl = build_closure(snap)
+    st = build_closure_state(snap, cl)
+    S1 = snap.num_slots + 1
+    member = cs.slot_of_name["member"]
+    u0 = interner.lookup("user", "u0")
+    g0 = interner.lookup("group", "g0")
+    g1 = interner.lookup("group", "g1")
+    # upsert: same identity, exp 100 → no expiration
+    got = advance_closure(
+        st, 2,
+        seed_add=(np.array([u0 * S1]), np.array([g0 * S1 + member + 1]),
+                  np.array([0], np.int32), np.array([0], np.int32)),
+        seed_del=(np.array([u0 * S1]), np.array([g0 * S1 + member + 1])),
+    )
+    assert got is not None
+    changed = set(got.changed_dsts.tolist())
+    assert g0 * S1 + member + 1 in changed
+    assert g1 * S1 + member + 1 in changed  # downstream value also moved
+    d = closure_dict(got.state.cl, snap.num_slots)
+    assert d[(u0 * S1, g1 * S1 + member + 1)] == (int(NO_EXP), int(NO_EXP))

@@ -165,11 +165,38 @@ def test_delta_level_base_userset_tombstone_t_dirty():
     _assert_parity(engine, ds_inc, ds_full, checks)
 
 
-def test_delta_level_membership_add_bails():
-    """A member edge into a group used as a subject changes the closure:
-    the incremental path must fall back to a FULL rebuild (and the full
-    rebuild must see the new membership)."""
+def test_delta_level_membership_add_advances_closure():
+    """A member edge into a group used as a subject changes the closure —
+    formerly the top bail class.  It now STAYS incremental: the flattened
+    closure advances in place (store/closure.py advance_closure) and the
+    new membership is immediately visible, with zero full rebuilds."""
+    from gochugaru_tpu.utils import metrics
+
     rng, rels, cs, interner, snap, engine, dsnap = _prep(seed=3)
+    used_group = next(
+        r.subject_id for r in rels
+        if r.subject_relation == "member" and r.subject_type == "group"
+    )
+    grant = rel.must_from_tuple(f"group:{used_group}#member", "user:u9")
+    rebuilds0 = metrics.default.counter("closure.rebuilds")
+    snap2 = apply_delta(snap, 2, [grant], [], interner=interner)
+    ds2 = engine.prepare(snap2, prev=dsnap)
+    assert ds2.flat_meta.delta is not None
+    assert metrics.default.counter("closure.rebuilds") == rebuilds0
+    d, p, ovf = engine.check_batch(ds2, [grant], now_us=NOW)
+    assert bool(d[0])
+    # the advance must still match a full rebuild exactly
+    _assert_parity(
+        engine, ds2, engine.prepare(snap2), make_checks(rng, 10, 10, n=40)
+    )
+
+
+def test_delta_level_membership_closure_delta_disabled_bails():
+    """With closure_delta off, the old contract holds: membership rows
+    force a full rebuild."""
+    rng, rels, cs, interner, snap, engine, dsnap = _prep(
+        seed=3, closure_delta=False
+    )
     used_group = next(
         r.subject_id for r in rels
         if r.subject_relation == "member" and r.subject_type == "group"

@@ -502,3 +502,27 @@ def test_sharded_sites_fire():
         with pytest.raises(UnavailableError):
             eng.check_batch(dsnap, queries, now_us=1_700_000_000_000_000)
     assert spec.fired == 1
+
+
+def test_injected_closure_delta_fault_is_retried_transparently():
+    """One transient during the incremental closure advance (the
+    membership-delta merge) must retry under the client envelope and land
+    on a CONSISTENT advanced closure — advance_closure is pure (no state
+    mutation before success), so the retry re-runs it from scratch."""
+    c = _client()
+    ctx = background()
+    assert c.check(ctx, consistency.full(), *CHECKS) == EXPECT
+    # a member-edge write: the next prepare advances the closure in place
+    txn = rel.Txn()
+    txn.touch(rel.must_from_triple("team:t1", "member", "user:u2"))
+    c.write(ctx, txn)
+    applies0 = _metrics.default.counter("closure.delta_applies")
+    rebuilds0 = _metrics.default.counter("closure.rebuilds")
+    with faults.armed("closure.delta", times=1) as spec:
+        assert c.check(ctx, consistency.full(), *CHECKS) == [
+            True, True, True, True,  # u2 now reaches doc:b via t1#member
+        ]
+    assert spec.fired == 1
+    # the retried advance applied exactly once and nothing rebuilt
+    assert _metrics.default.counter("closure.delta_applies") == applies0 + 1
+    assert _metrics.default.counter("closure.rebuilds") == rebuilds0

@@ -203,7 +203,7 @@ def test_fold_maintained_across_40_revision_chain():
         assert ds_inc.flat_meta.fold_pairs, "fold must stay armed"
         dm = ds_inc.flat_meta.delta
         saw_dirty += bool(dm.pf_dirty)
-        saw_ovl += bool(dm.pf_ovl_e or dm.pf_ovl_t)
+        saw_ovl += bool(dm.pf_ovl_e or dm.pf_ovl_u)
         ds_full = engine.prepare(snap)
         checks = _checks(py) + [
             rel.must_from_triple(
@@ -335,3 +335,249 @@ def test_fold_delta_dirty_cap_downgrades_to_walk():
     _assert_sound_vs_full(
         engine, ds3, engine.prepare(snap3), _checks(random.Random(3))
     )
+
+
+# ---------------------------------------------------------------------------
+# membership deltas: incremental closure maintenance keeps the chain alive
+# ---------------------------------------------------------------------------
+
+
+def _member_rows(rels):
+    return [
+        r for r in rels
+        if r.resource_type == "group" and r.subject_type == "user"
+        and not r.has_expiration() and not r.caveat_name
+    ]
+
+
+def test_membership_delta_stays_incremental_and_fold_armed():
+    """Member-edge writes (the closure's top bail class) now ride the
+    incremental path: the flattened closure advances in place
+    (store/closure.py advance_closure), the fold stays armed (its pf_u
+    side is closure-independent), and answers match a full prepare
+    exactly — adds, deletes, and nested-group (mp) edges alike."""
+    from gochugaru_tpu.utils import metrics
+
+    rng, rels, cs, interner, snap, engine, dsnap = _prep(seed=21)
+    py = random.Random(31)
+    live_members = _member_rows(rels)
+    rebuilds0 = metrics.default.counter("closure.rebuilds")
+    for revision in range(2, 14):
+        adds, deletes = [], []
+        kind = revision % 4
+        if kind == 0:  # new user into a group (fresh node)
+            adds.append(rel.must_from_tuple(
+                f"group:g{py.randrange(6)}#member", f"user:mnu{revision}"
+            ))
+        elif kind == 1:  # existing user into another group
+            adds.append(rel.must_from_tuple(
+                f"group:g{py.randrange(6)}#member",
+                f"user:u{py.randrange(20)}",
+            ))
+        elif kind == 2 and live_members:  # remove a member edge
+            deletes.append(live_members.pop(py.randrange(len(live_members))))
+        else:  # nested-group (mp) edge add
+            adds.append(rel.must_from_tuple(
+                f"group:g{py.randrange(6)}#member",
+                f"group:g{py.randrange(6)}#member",
+            ))
+        snap = apply_delta(snap, revision, adds, deletes, interner=interner)
+        ds_inc = engine.prepare(snap, prev=dsnap)
+        assert ds_inc.flat_meta.delta is not None, f"rev {revision} fell back"
+        assert ds_inc.flat_meta.fold_pairs, "fold must stay armed"
+        assert ds_inc.closure_state is not None
+        ds_full = engine.prepare(snap)
+        checks = _checks(py)
+        for a in adds:
+            if a.subject_type == "user":
+                checks += [
+                    rel.must_from_triple(
+                        f"document:d{d}", "view",
+                        f"user:{a.subject_id}",
+                    )
+                    for d in range(0, 30, 3)
+                ]
+        _assert_parity(engine, ds_inc, ds_full, checks)
+        dsnap = ds_inc  # chain
+    assert metrics.default.counter("closure.rebuilds") - rebuilds0 > 0, (
+        "the parity full-prepares above should count as rebuilds"
+    )
+
+
+def test_membership_delta_soak_30_rounds_zero_rebuilds():
+    """The acceptance soak: 30 consecutive member-edge write rounds on a
+    folded world advance the closure with closure.rebuilds == 0 — every
+    round incremental, every fresh edge immediately visible."""
+    from gochugaru_tpu.utils import metrics
+
+    rng, rels, cs, interner, snap, engine, dsnap = _prep(seed=23)
+    py = random.Random(41)
+    live_members = _member_rows(rels)
+    rebuilds0 = metrics.default.counter("closure.rebuilds")
+    applies0 = metrics.default.counter("closure.delta_applies")
+    for revision in range(2, 32):
+        # one fresh user + two existing per round: fresh nodes must stay
+        # inside the base radix's 2× headroom (outgrowing it is a
+        # by-design repack/rebuild, not what this soak measures)
+        adds = [rel.must_from_tuple(
+            f"group:g{py.randrange(6)}#member", f"user:soak{revision}"
+        )] + [
+            rel.must_from_tuple(
+                f"group:g{py.randrange(6)}#member",
+                f"user:u{py.randrange(20)}",
+            )
+            for _ in range(2)
+        ]
+        deletes = []
+        if live_members and revision % 3 == 0:
+            deletes.append(live_members.pop(py.randrange(len(live_members))))
+        snap = apply_delta(snap, revision, adds, deletes, interner=interner)
+        dsnap = engine.prepare(snap, prev=dsnap)
+        assert dsnap.flat_meta.delta is not None, f"rev {revision} fell back"
+        # freshness: a user just added to a group must see every document
+        # whose folder chain grants that group — probe one group viewer
+        d, p, ovf = engine.check_batch(dsnap, [rel.must_from_tuple(
+            f"group:{adds[0].resource_id}#member",
+            f"user:soak{revision}",
+        )], now_us=NOW)
+        # (direct member probe: definite via the delta e-level + closure)
+        assert bool(d[0]), f"rev {revision}: fresh member edge invisible"
+    assert metrics.default.counter("closure.rebuilds") == rebuilds0, (
+        "member-edge soak must not rebuild the closure"
+    )
+    assert (
+        metrics.default.counter("closure.delta_applies") - applies0 >= 30
+    )
+    # end-state correctness: the chained snapshot answers like a fresh one
+    _assert_parity(
+        engine, dsnap, engine.prepare(snap), _checks(random.Random(43))
+    )
+
+
+def test_membership_delta_tindex_dirty_cap_flips_t_off():
+    """With a zero T-dirty budget, the first membership delta flips the
+    chain's T-index off (sticky) — still incremental, still exact (the
+    KU path probes the live closure)."""
+    rng, rels, cs, interner, snap, engine, dsnap = _prep(
+        seed=25, flat_tindex_dirty_cap=0
+    )
+    if not dsnap.flat_meta.has_tindex:
+        import pytest as _pytest
+
+        _pytest.skip("world did not build a T-index")
+    adds = [rel.must_from_tuple("group:g1#member", "user:u3")]
+    snap2 = apply_delta(snap, 2, adds, [], interner=interner)
+    ds2 = engine.prepare(snap2, prev=dsnap)
+    assert ds2.flat_meta.delta is not None
+    assert ds2.flat_meta.delta.t_off
+    _assert_parity(engine, ds2, engine.prepare(snap2), _checks(random.Random(4)))
+    # sticky across the next (non-membership) revision
+    snap3 = apply_delta(
+        snap2, 3,
+        [rel.must_from_triple("document:d2", "viewer", "user:u2")], [],
+        interner=interner,
+    )
+    ds3 = engine.prepare(snap3, prev=ds2)
+    assert ds3.flat_meta.delta is not None and ds3.flat_meta.delta.t_off
+    _assert_parity(engine, ds3, engine.prepare(snap3), _checks(random.Random(5)))
+
+
+def test_membership_delta_dereference_and_revival_stay_exact():
+    """Deleting the LAST userset-subject row referencing a group leaves
+    the maintained closure a probe-equivalent SUPERSET (the dereferenced
+    group's rows are unreachable); re-referencing the group later must
+    find its membership still exact — all without leaving the
+    incremental path."""
+    rng, rels, cs, interner, snap, engine, dsnap = _prep(seed=27)
+    # rev 2: introduce a group userset referenced by exactly ONE row
+    # (a brand-new userset subject forces the expected full prepare)
+    only = rel.must_from_tuple("document:d5#viewer", "group:gonly#member")
+    member = rel.must_from_tuple("group:gonly#member", "user:nu2")
+    snap2 = apply_delta(snap, 2, [only, member], [], interner=interner)
+    ds2 = engine.prepare(snap2, prev=dsnap)
+    assert ds2.flat_meta.delta is None  # new userset subject: rebuild
+    probe = [rel.must_from_triple("document:d5", "view", "user:nu2")]
+    d, _, _ = engine.check_batch(ds2, probe, now_us=NOW)
+    assert bool(d[0])
+    # rev 3: delete the single referencing row — group dereferenced; the
+    # chain stays incremental (the stale superset rows are unreachable)
+    snap3 = apply_delta(snap2, 3, [], [only], interner=interner)
+    ds3 = engine.prepare(snap3, prev=ds2)
+    assert ds3.flat_meta.delta is not None, "us-row delete must not rebuild"
+    _assert_parity(engine, ds3, engine.prepare(snap3),
+                   _checks(random.Random(6)) + probe)
+    # rev 4: the group's membership keeps advancing while dereferenced
+    snap4 = apply_delta(
+        snap3, 4,
+        [rel.must_from_tuple("group:gonly#member", "user:u7")], [],
+        interner=interner,
+    )
+    ds4 = engine.prepare(snap4, prev=ds3)
+    assert ds4.flat_meta.delta is not None
+    # rev 5: re-reference the group — its (incrementally maintained)
+    # membership must answer exactly like a fresh build
+    snap5 = apply_delta(snap4, 5, [only], [], interner=interner)
+    ds5 = engine.prepare(snap5, prev=ds4)
+    assert ds5.flat_meta.delta is not None, "revival must stay incremental"
+    revived = probe + [
+        rel.must_from_triple("document:d5", "view", "user:u7"),
+    ]
+    _assert_parity(engine, ds5, engine.prepare(snap5),
+                   _checks(random.Random(8)) + revived)
+    d, _, _ = engine.check_batch(ds5, revived, now_us=NOW)
+    assert bool(d[0]) and bool(d[1])
+
+
+def test_membership_then_overlay_userset_sees_advanced_closure():
+    """Regression (review round 8): a fold armed with ZERO base userset
+    rows (pf_has_u=False) must still reship the csr subject view on
+    membership deltas — a later overlay userset row (dl_pfu) intersects
+    against it, and a stale view would silently deny a fresh member."""
+    cs = compile_schema(parse_schema("""
+    definition user {}
+    definition group { relation member: user }
+    definition anchor { relation keeper: user | group#member }
+    definition doc {
+        relation viewer: user
+        permission view = viewer
+    }
+    """))
+    interner = Interner()
+    base = [
+        # keeps group:g#member "used" without any folded userset row
+        rel.must_from_tuple("anchor:a#keeper", "group:g#member"),
+        rel.must_from_tuple("group:g#member", "user:original"),
+        rel.must_from_triple("doc:d1", "viewer", "user:direct"),
+    ]
+    snap = build_snapshot(1, cs, interner, base, epoch_us=NOW)
+    engine = DeviceEngine(cs)
+    dsnap = engine.prepare(snap)
+    meta = dsnap.flat_meta
+    if not (meta and any(s == cs.slot_of_name["view"] for _, s in meta.fold_pairs)):
+        pytest.skip("doc.view did not fold in this configuration")
+    assert not meta.pf_has_u  # no folded userset rows at base
+    # rev 2: membership write — closure advances, csr must reship
+    snap2 = apply_delta(
+        snap, 2, [rel.must_from_tuple("group:g#member", "user:newbie")], [],
+        interner=interner,
+    )
+    ds2 = engine.prepare(snap2, prev=dsnap)
+    assert ds2.flat_meta.delta is not None
+    # rev 3: a userset viewer lands on the folded pair → dl_pfu overlay
+    snap3 = apply_delta(
+        snap2, 3,
+        [rel.must_from_tuple("doc:d1#viewer", "group:g#member")], [],
+        interner=interner,
+    )
+    ds3 = engine.prepare(snap3, prev=ds2)
+    checks = [
+        rel.must_from_triple("doc:d1", "view", "user:newbie"),
+        rel.must_from_triple("doc:d1", "view", "user:original"),
+        rel.must_from_triple("doc:d1", "view", "user:direct"),
+        rel.must_from_triple("doc:d1", "view", "user:uninvolved"),
+    ]
+    if ds3.flat_meta.delta is not None and ds3.flat_meta.delta.pf_ovl_u:
+        d, p, ovf = engine.check_batch(ds3, checks, now_us=NOW)
+        assert list(map(bool, d[:3])) == [True, True, True], d[:3]
+        assert not bool(d[3])
+    _assert_parity(engine, ds3, engine.prepare(snap3), checks)
