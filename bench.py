@@ -416,9 +416,48 @@ def _run_child(mode: str, timeout_s: int, note: str | None):
     return None, f"{reason}: {tail}"
 
 
+_PROBE_VERDICT: "list[str | None]" = []  # memoized per process
+
+
 def _probe_backend() -> str | None:
     """Cheap bounded liveness probe of the default (TPU) backend; returns
-    a failure reason, or None when the backend is usable."""
+    a failure reason, or None when the backend is usable.
+
+    Respects the caller's platform pins — the probe exists only to guard
+    against a HUNG TPU init, so when the platform is already decided it
+    is pure waste (BENCH_r05 paid a 75 s probe timeout before every
+    degraded CPU stage):
+
+    - ``JAX_PLATFORMS`` set and TPU-free → no TPU init can hang; skip
+      the subprocess and go straight to the pinned platform.
+    - ``JAX_PLATFORMS`` includes tpu → the user pinned it; trust it.
+    - ``GOCHUGARU_FORCE_CPU=1`` / ``GOCHUGARU_BACKEND_PROBED`` (exported
+      by run_all.py after ITS probe) → reuse that verdict.
+
+    The verdict is memoized for the process so repeat stages never
+    re-pay the subprocess."""
+    if _PROBE_VERDICT:
+        return _PROBE_VERDICT[0]
+
+    def remember(v: "str | None") -> "str | None":
+        _PROBE_VERDICT.append(v)
+        return v
+
+    plats = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if plats:
+        if "tpu" in plats:
+            return remember(None)
+        return remember(
+            f"JAX_PLATFORMS={plats} pins a TPU-free platform (probe skipped)"
+        )
+    if os.environ.get("GOCHUGARU_FORCE_CPU") == "1":
+        return remember("GOCHUGARU_FORCE_CPU=1 (probe skipped)")
+    probed = os.environ.get("GOCHUGARU_BACKEND_PROBED", "").strip().lower()
+    if probed:
+        return remember(
+            None if probed == "tpu"
+            else f"parent probe found backend={probed} (probe skipped)"
+        )
     try:
         r = subprocess.run(
             [sys.executable, "-c",
@@ -426,11 +465,13 @@ def _probe_backend() -> str | None:
             capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
         )
     except subprocess.TimeoutExpired:
-        return f"backend probe timed out after {PROBE_TIMEOUT_S}s"
+        return remember(f"backend probe timed out after {PROBE_TIMEOUT_S}s")
     if r.returncode != 0:
         tail = (r.stderr or "").strip().splitlines()
-        return f"backend probe failed: {tail[-1][:200] if tail else r.returncode}"
-    return None
+        return remember(
+            f"backend probe failed: {tail[-1][:200] if tail else r.returncode}"
+        )
+    return remember(None)
 
 
 def main() -> int:

@@ -23,7 +23,15 @@ PROBE_TIMEOUT_S = int(os.environ.get("GOCHUGARU_BENCH_PROBE_TIMEOUT", "75"))
 
 
 def probe_backend() -> str:
-    """'tpu'/'cpu'/... from a bounded child, or 'cpu' when unusable."""
+    """'tpu'/'cpu'/... from a bounded child, or 'cpu' when unusable.
+
+    When ``JAX_PLATFORMS`` pins the platform the subprocess probe is
+    skipped entirely — the probe only guards against a hung TPU init,
+    and a pinned platform cannot hang (BENCH_r05 paid the 75 s timeout
+    before every degraded stage)."""
+    plats = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if plats:
+        return plats.split(",")[0]
     try:
         r = subprocess.run(
             [sys.executable, "-c", "import jax; print(jax.default_backend())"],
@@ -79,6 +87,9 @@ def main() -> int:
 
     backend = probe_backend()
     env = dict(os.environ)
+    # children (bench.py among them) reuse this verdict instead of
+    # re-paying their own probe subprocess per stage
+    env["GOCHUGARU_BACKEND_PROBED"] = backend
     if backend != "tpu":
         env["GOCHUGARU_FORCE_CPU"] = "1"
         backend = "cpu (TPU backend unusable at run time)"

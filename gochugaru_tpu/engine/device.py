@@ -49,7 +49,9 @@ from ..caveats.device import (
 from ..rel.relationship import Relationship, WILDCARD_ID
 from ..schema.compiler import CompiledSchema
 from ..store.snapshot import Snapshot
-from ..utils import faults
+import time as _time
+
+from ..utils import faults, metrics
 from ..utils.context import background as _background
 from ..utils.errors import classify_dispatch_exception
 from ..utils.retry import retry_retriable_errors
@@ -774,9 +776,11 @@ class DeviceEngine:
             out = self._prepare_delta(snap, prev)
             if out is not None:
                 return out
-        arrays = self._host_arrays(snap)
-        ectx, strings = self._ectx_tables(snap)
-        arrays.update(ectx)
+        _t0 = _time.perf_counter()
+        with metrics.default.timer("prepare.host_tables_s"):
+            arrays = self._host_arrays(snap)
+            ectx, strings = self._ectx_tables(snap)
+            arrays.update(ectx)
         flat_meta = None
         fold_state = None
         closure_state = None
@@ -787,7 +791,10 @@ class DeviceEngine:
             if built is not None:  # unpackable graphs use the legacy path
                 flat_arrays, flat_meta, fold_state, closure_state = built
                 arrays.update(flat_arrays)
-        arrays = {k: jnp.asarray(v) for k, v in arrays.items()}
+        with metrics.default.timer("prepare.h2d_s"):
+            # one batched transfer (the runtime can pipeline leaves)
+            # instead of per-array jnp.asarray round trips
+            arrays = jax.device_put(arrays)
         tid_map = np.full(max(self.plan.num_schema_types, 1), -1, dtype=np.int32)
         for tname, tid in self.compiled.type_ids.items():
             tid_map[tid] = snap.interner.type_lookup(tname)
@@ -810,6 +817,9 @@ class DeviceEngine:
                 kwargs={"mark_used": False},
                 name="gochugaru-lookup-prewarm", daemon=True,
             ).start()
+        metrics.default.observe(
+            "prepare.total_s", _time.perf_counter() - _t0
+        )
         return DeviceSnapshot(
             revision=snap.revision,
             arrays=arrays,

@@ -37,6 +37,7 @@ Replaces the evaluation behind the reference's CheckBulkPermissions
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from functools import lru_cache
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -372,7 +373,21 @@ def _pad(a: np.ndarray, size: int, fill) -> np.ndarray:
 
 
 def _pack(a: np.ndarray, radix: int, b) -> np.ndarray:
-    return (a.astype(np.int64) * radix + b).astype(np.int32)
+    from ..native.sort import pack32
+
+    return pack32(a, b, radix)
+
+
+def _uniq_small(parts, domain: int) -> np.ndarray:
+    """Sorted unique over int columns whose values live in [0, domain)
+    (slot ids): an occupancy scatter + flatnonzero instead of the
+    concatenate+sort np.unique pays — O(E) with no 30M-row sort.
+    Output is int64, matching np.unique of int64-cast inputs."""
+    occ = np.zeros(max(domain, 1), bool)
+    for p in parts:
+        if p.shape[0]:
+            occ[p] = True
+    return np.flatnonzero(occ)
 
 
 @dataclass(frozen=True)
@@ -398,22 +413,24 @@ class SlotMaps:
 
 
 def _active_maps(snap, cl, extra_k1) -> SlotMaps:
-    """The dense slot maps of one snapshot (+closure, + fold slots)."""
+    """The dense slot maps of one snapshot (+closure, + fold slots).
+    Slot values live in [0, num_slots): uniques come from an occupancy
+    scatter (_uniq_small) — no concatenated 30M-row sort."""
     ns = max(snap.num_slots, 1)
-    k1_raw = np.unique(np.concatenate([
+    k1_raw = _uniq_small([
         snap.e_rel, snap.us_rel, snap.ar_rel,
-        np.asarray(sorted(extra_k1), np.int32),
-    ]).astype(np.int64))
+        np.asarray(sorted(extra_k1), np.int64),
+    ], ns)
     # us_srel covers every stored subject-relation by construction (the
     # userset view IS the primary rows with srel1 > 0), so the k2 actives
     # need no O(E) pass over e_srel1
-    k2_raw = np.unique(np.concatenate([
+    k2_raw = _uniq_small([
         snap.us_srel,
         cl.c_srel1[cl.c_srel1 > 0] - 1,
         cl.c_grel,
         snap.pus_r,
         cl.ovf_srel1[cl.ovf_srel1 > 0] - 1,
-    ]).astype(np.int64))
+    ], ns)
     k1 = np.full(ns, -1, np.int32)
     k1[k1_raw] = np.arange(k1_raw.shape[0], dtype=np.int32)
     k2 = np.full(ns, -1, np.int32)
@@ -427,7 +444,25 @@ def _active_maps(snap, cl, extra_k1) -> SlotMaps:
 
 
 def _m_srel1(maps: SlotMaps, srel1: np.ndarray) -> np.ndarray:
-    """Raw srel1 column (0 = direct, else slot+1) → dense srel1."""
+    """Raw srel1 column (0 = direct, else slot+1) → dense srel1.  One
+    fused native pass when available (numpy chain fallback, identical
+    values)."""
+    from ..native import lib as _native_lib
+
+    L = _native_lib()
+    n = int(srel1.shape[0])
+    if L is not None and n >= (1 << 16):
+        import ctypes
+
+        s = np.ascontiguousarray(srel1, np.int32)
+        k2 = np.ascontiguousarray(maps.k2, np.int32)
+        out = np.empty(n, np.int32)
+        p32 = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        L.gi_msrel1(
+            p32(s), p32(k2), ctypes.c_int64(k2.shape[0]),
+            ctypes.c_int64(n), p32(out),
+        )
+        return out
     return np.where(
         srel1 == 0, 0, maps.k2[np.clip(srel1 - 1, 0, None)] + 1
     ).astype(np.int32)
@@ -712,7 +747,7 @@ def _tindex_join(
         return None
     return (
         *got,
-        tuple(int(s) for s in np.unique(snap.us_rel[elig])),
+        tuple(int(s) for s in _uniq_small([snap.us_rel[elig]], snap.num_slots)),
     )
 
 
@@ -820,8 +855,10 @@ def _closure_host_state(snap, cl, config: EngineConfig, us_gk, t_slots):
             snap.us_subj[elig].astype(np.int64) * (num_slots + 1)
             + snap.us_srel[elig] + 1
         )
-        order = np.argsort(pe, kind="stable")
-        t_pe, t_k1 = pe[order], us_gk[elig][order]
+        from ..native.sort import sortperm_words, take32, take64
+
+        order = sortperm_words([pe], (pe,))
+        t_pe, t_k1 = take64(pe, order), take32(us_gk[elig], order)
     else:
         t_pe = np.zeros(0, np.int64)
         t_k1 = np.zeros(0, np.int32)
@@ -934,8 +971,20 @@ def build_flat_arrays(
     FlatMeta, the fold maintenance state, and the closure advance state —
     or None when even the DENSE keys don't pack into int32
     (pow2(num_nodes) · max(active k1 slots, active srels+1) ≥ 2³¹; such
-    graphs use the legacy engine)."""
+    graphs use the legacy engine).
+
+    Every stage publishes a ``prepare.*`` sample-ring timer
+    (utils/metrics.py) so the cold-start wall clock decomposes in the
+    bench output: closure flatten, permission fold, dense key packing,
+    hash/interleave table builds, T-index join.  ``prepare.build`` is the
+    staged pipeline's fault-injection site (utils/faults.py): a transient
+    failure here surfaces as a classified retriable error to the client
+    envelope, like the round-7 dispatch sites."""
     from ..store.closure import NEVER, build_closure
+    from ..utils import faults, metrics
+
+    faults.fire("prepare.build")
+    _mt = metrics.default
 
     # cheap pre-bail for clearly-over-bound worlds, BEFORE the closure
     # and fold are paid for: distinct stored slots lower-bound the dense
@@ -955,7 +1004,8 @@ def build_flat_arrays(
         if Npre * width_lb >= 2**31:
             return None
 
-    cl = build_closure(snap, per_source_cap=config.closure_source_cap)
+    with _mt.timer("prepare.closure_s"):
+        cl = build_closure(snap, per_source_cap=config.closure_source_cap)
 
     # the permission fold runs BEFORE key packing: folded permission
     # slots join the k1 radix (engine/fold.py packs its internal keys in
@@ -965,27 +1015,30 @@ def build_flat_arrays(
     if BS and plan is not None:
         from .fold import fold_permissions
 
-        got_fold = fold_permissions(snap, config, plan, cl)
+        with _mt.timer("prepare.fold_s"):
+            got_fold = fold_permissions(snap, config, plan, cl)
         if got_fold is not None:
             fr, fstate = got_fold
 
-    maps = _active_maps(
-        snap, cl, {slot for _, slot in fr.pairs} if fr is not None else ()
-    )
-    N = _node_radix(snap, maps)
-    if N is None:
-        return None
-    S1 = maps.S1
+    with _mt.timer("prepare.pack_s"):
+        maps = _active_maps(
+            snap, cl, {slot for _, slot in fr.pairs} if fr is not None else ()
+        )
+        N = _node_radix(snap, maps)
+        if N is None:
+            return None
+        S1 = maps.S1
 
-    e_k1 = _pack(maps.k1[snap.e_rel], N, snap.e_res)
-    e_k2 = _pack(snap.e_subj, S1, _m_srel1(maps, snap.e_srel1))
-    us_gk = _pack(maps.k1[snap.us_rel], N, snap.us_res)
-    ar_gk = _pack(maps.k1[snap.ar_rel], N, snap.ar_res)
-    cl_k1 = _pack(cl.c_src, S1, _m_srel1(maps, cl.c_srel1))
-    cl_k2 = _pack(cl.c_g, S1, maps.k2[cl.c_grel] + 1)
-    pus_k = _pack(snap.pus_n, S1, maps.k2[snap.pus_r] + 1)
-    ovf_k = _pack(cl.ovf_src, S1, _m_srel1(maps, cl.ovf_srel1))
+        e_k1 = _pack(maps.k1[snap.e_rel], N, snap.e_res)
+        e_k2 = _pack(snap.e_subj, S1, _m_srel1(maps, snap.e_srel1))
+        us_gk = _pack(maps.k1[snap.us_rel], N, snap.us_res)
+        ar_gk = _pack(maps.k1[snap.ar_rel], N, snap.ar_res)
+        cl_k1 = _pack(cl.c_src, S1, _m_srel1(maps, cl.c_srel1))
+        cl_k2 = _pack(cl.c_g, S1, maps.k2[cl.c_grel] + 1)
+        pus_k = _pack(snap.pus_n, S1, maps.k2[snap.pus_r] + 1)
+        ovf_k = _pack(cl.ovf_src, S1, _m_srel1(maps, cl.ovf_srel1))
 
+    _t_hash = time.perf_counter()
     usr = build_range_hash(us_gk)
     arr = build_range_hash(ar_gk)
     push = build_hash([pus_k])
@@ -1117,8 +1170,10 @@ def build_flat_arrays(
         out["cl_p_until"] = _pad(cl.c_p_until, P, NEVER)
         out["pus_k"] = _pad(pus_k, _ceil_pow2(max(pus_k.shape[0], 1)), -1)
         out["ovf_k"] = _pad(ovf_k, _ceil_pow2(max(ovf_k.shape[0], 1)), -1)
+    _mt.observe("prepare.hash_s", time.perf_counter() - _t_hash)
 
     # ---- T-index: userset edges ⋈ closure-by-target (shared join) -------
+    _t_tindex = time.perf_counter()
     t_kw = dict(has_tindex=False, t_cap=4, t_n=8, t_slots=())
     tj = _tindex_join(snap, config, cl, us_gk, cl_k1, cl_k2, pus_k, maps)
     if tj is not None:
@@ -1143,6 +1198,7 @@ def build_flat_arrays(
             t_n=_ceil_pow2(max(th.n, 1)) if th is not None else 8,
             t_slots=t_slots,
         )
+    _mt.observe("prepare.tindex_s", time.perf_counter() - _t_tindex)
 
     # resource-side Leopard index: flattened ancestor closures for
     # self-recursive arrow hierarchies (block-slice layout only)
@@ -1167,6 +1223,7 @@ def build_flat_arrays(
     wc_nodes = snap.wildcard_node_of_type[snap.wildcard_node_of_type >= 0]
 
     # ---- permission fold (P-index): rewrites → root-level tables -------
+    _t_fold = time.perf_counter()
     fold_kw: Dict = {}
     got = _fold_packed(fr, snap, maps, N, config) if fr is not None else None
     if got is not None:
@@ -1208,6 +1265,7 @@ def build_flat_arrays(
         fstate.maps, fstate.N = maps, N
     else:
         fstate = None
+    _mt.observe("prepare.fold_s", time.perf_counter() - _t_fold)
 
     meta = FlatMeta(
         N=N, S1=S1,
@@ -1242,8 +1300,8 @@ def build_flat_arrays(
         blockslice=BS,
         aligned=tuple(al_meta),
         ar_data_depth=ar_dd,
-        e_slots=tuple(int(s) for s in np.unique(snap.e_rel)),
-        us_slots=tuple(int(s) for s in np.unique(snap.us_rel)),
+        e_slots=tuple(int(s) for s in _uniq_small([snap.e_rel], snap.num_slots)),
+        us_slots=tuple(int(s) for s in _uniq_small([snap.us_rel], snap.num_slots)),
         has_wc_edges=bool(np.isin(snap.e_subj, wc_nodes).any()),
         has_wc_closure=bool(
             np.isin(cl.c_src[cl.c_srel1 == 0], wc_nodes).any()
@@ -1275,23 +1333,34 @@ def build_flat_arrays(
 
 def _stack_point(h: HashIndex, cols: Sequence[np.ndarray], M: int, pad: int = 64):
     """Bucket-sharded point table: (off int32[M·(bpd+1)],
-    tbl int32[M·R_pad, w]) — shard_map splits both on the leading axis."""
+    tbl int32[M·R_pad, w]) — shard_map splits both on the leading axis.
+    Fully batched: one interleaved gather for the payload rows, one
+    advanced-index scatter placing every shard's slice, one broadcast
+    subtraction for the normalized local offsets (no per-shard loops)."""
+    from ..native.sort import fill_interleaved
+
     size, bpd = h.size, h.size // M
     assert bpd * M == h.size and bpd >= 1
     w = max(len(cols), 1)
     n = int(h.rows.shape[0]) if h.n else 0
-    perm = [np.ascontiguousarray(c, np.int32)[h.rows[:n]] for c in cols]
     off = h.off.astype(np.int64)
     starts = off[np.arange(M) * bpd]
     ends = off[(np.arange(M) + 1) * bpd]
     R_pad = _ceil_pow2(int((ends - starts).max() if M else 1) + max(pad, h.cap))
     tbl = np.full((M, R_pad, w), -1, np.int32)
-    offs = np.zeros((M, bpd + 1), np.int32)
-    for s in range(M):
-        g0, g1 = int(starts[s]), int(ends[s])
-        for j, c in enumerate(perm):
-            tbl[s, : g1 - g0, j] = c[g0:g1]
-        offs[s] = (h.off[s * bpd : (s + 1) * bpd + 1] - g0).astype(np.int32)
+    if n:
+        # rows [0, n) partition contiguously into shards [starts, ends):
+        # shard id + local position per global row, then one scatter
+        lens = ends - starts
+        sh = np.repeat(np.arange(M), lens)
+        loc = np.arange(n, dtype=np.int64) - np.repeat(starts, lens)
+        rows_mat = np.empty((n, w), np.int32)
+        if not fill_interleaved(rows_mat, cols, h.rows[:n]):
+            for j, c in enumerate(cols):
+                rows_mat[:, j] = np.ascontiguousarray(c, np.int32)[h.rows[:n]]
+        tbl[sh, loc] = rows_mat
+    bidx = np.arange(M)[:, None] * bpd + np.arange(bpd + 1)[None, :]
+    offs = (off[bidx] - starts[:, None]).astype(np.int32)
     return offs.reshape(-1), tbl.reshape(M * R_pad, w)
 
 
@@ -1325,30 +1394,46 @@ def _stack_range(ri, row_cols: Sequence[np.ndarray], M: int, fan_pad: int):
         if G
         else np.zeros(0, np.int64)
     )
+    # batched stacking: groups [0, G) and their rows [0, total) partition
+    # contiguously into shards — compute shard-row bases with a running
+    # max (empty shards carry the previous base), then place every
+    # shard's group and row slices with advanced-index scatters
     shard_row_base = np.zeros(M + 1, np.int64)
-    for s in range(M):
-        shard_row_base[s + 1] = (
-            ends_all[int(g_ends[s]) - 1] if g_ends[s] > g_starts[s]
-            else shard_row_base[s]
+    if G:
+        cand = np.where(
+            g_ends > g_starts, ends_all[np.clip(g_ends - 1, 0, None)], 0
         )
+        shard_row_base[1:] = np.maximum.accumulate(cand)
     row_counts = np.diff(shard_row_base)
     R_pad = _ceil_pow2(int(row_counts.max() if M else 1) + max(fan_pad, 64))
     G_pad = _ceil_pow2(int((g_ends - g_starts).max() if M else 1) + max(64, gh.cap))
     rows_tbl = np.full((M, R_pad, w), -1, np.int32)
     gtbl = np.full((M, G_pad, 3), -1, np.int32)
-    goffs = np.zeros((M, bpd + 1), np.int32)
     cols32 = [np.ascontiguousarray(c, np.int32) for c in row_cols]
-    for s in range(M):
-        gs0, gs1 = int(g_starts[s]), int(g_ends[s])
-        r0, r1 = int(shard_row_base[s]), int(shard_row_base[s + 1])
-        src = row_src[r0:r1]
-        for ci, c in enumerate(cols32):
-            rows_tbl[s, : r1 - r0, ci] = c[src]
-        ng = gs1 - gs0
-        gtbl[s, :ng, 0] = gk[order_groups[gs0:gs1]]
-        gtbl[s, :ng, 1] = (starts_all[gs0:gs1] - r0).astype(np.int32)
-        gtbl[s, :ng, 2] = (ends_all[gs0:gs1] - r0).astype(np.int32)
-        goffs[s] = (gh.off[s * bpd : (s + 1) * bpd + 1] - gs0).astype(np.int32)
+    if total:
+        from ..native.sort import fill_interleaved
+
+        sh_r = np.repeat(np.arange(M), row_counts)
+        loc_r = np.arange(total, dtype=np.int64) - np.repeat(
+            shard_row_base[:-1], row_counts
+        )
+        rows_mat = np.empty((total, w), np.int32)
+        if not fill_interleaved(rows_mat, cols32, row_src.astype(np.int32)):
+            for ci, c in enumerate(cols32):
+                rows_mat[:, ci] = c[row_src]
+        rows_tbl[sh_r, loc_r] = rows_mat
+    if G:
+        g_lens = g_ends - g_starts
+        sh_g = np.repeat(np.arange(M), g_lens)
+        loc_g = np.arange(G, dtype=np.int64) - np.repeat(g_starts, g_lens)
+        r0_of = np.repeat(shard_row_base[:-1], g_lens)
+        gtbl[sh_g, loc_g, 0] = gk[order_groups]
+        gtbl[sh_g, loc_g, 1] = (starts_all - r0_of).astype(np.int32)
+        gtbl[sh_g, loc_g, 2] = (ends_all - r0_of).astype(np.int32)
+    bidx = np.arange(M)[:, None] * bpd + np.arange(bpd + 1)[None, :]
+    goffs = (
+        gh.off.astype(np.int64)[bidx] - g_starts[:, None]
+    ).astype(np.int32)
     return (
         goffs.reshape(-1),
         gtbl.reshape(M * G_pad, 3),
@@ -1370,9 +1455,12 @@ def build_flat_arrays_sharded(
     with the matching ``axis``.  Returns None when keys don't pack (legacy
     sharded path)."""
     from ..store.closure import build_closure
+    from ..utils import faults, metrics
 
+    faults.fire("prepare.build")
     M = model_size
-    cl = build_closure(snap, per_source_cap=config.closure_source_cap)
+    with metrics.default.timer("prepare.closure_s"):
+        cl = build_closure(snap, per_source_cap=config.closure_source_cap)
 
     # the permission fold shards like every other table (stacked pf_e /
     # pf_t; the kernel's pf probes already mask bucket ownership and
@@ -1537,8 +1625,8 @@ def build_flat_arrays_sharded(
         blockslice=True,
         sharded=True,
         ar_data_depth=ar_dd,
-        e_slots=tuple(int(s) for s in np.unique(snap.e_rel)),
-        us_slots=tuple(int(s) for s in np.unique(snap.us_rel)),
+        e_slots=tuple(int(s) for s in _uniq_small([snap.e_rel], snap.num_slots)),
+        us_slots=tuple(int(s) for s in _uniq_small([snap.us_rel], snap.num_slots)),
         has_wc_edges=bool(np.isin(snap.e_subj, wc_nodes).any()),
         has_wc_closure=bool(
             np.isin(cl.c_src[cl.c_srel1 == 0], wc_nodes).any()

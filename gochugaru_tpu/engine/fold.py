@@ -91,20 +91,56 @@ def _concat_rows(parts: List[_Rows]) -> _Rows:
 
 
 def _until_of(exp: np.ndarray) -> np.ndarray:
-    return np.where(exp == 0, np.int64(NO_EXP), exp.astype(np.int64)).astype(
-        np.int32
-    )
+    # pure int32 (NO_EXP fits): no int64 round trip on the 30M-row pass
+    return np.where(exp == 0, NO_EXP, exp).astype(np.int32)
+
+
+def _strictly_inc2(a: np.ndarray, b: np.ndarray) -> bool:
+    """Rows strictly increasing by (a, b) — sorted AND unique."""
+    if a.shape[0] < 2:
+        return True
+    gt = a[1:] > a[:-1]
+    eq = a[1:] == a[:-1]
+    return bool((gt | (eq & (b[1:] > b[:-1]))).all())
+
+
+def _strictly_inc3(a: np.ndarray, b: np.ndarray, c: np.ndarray) -> bool:
+    if a.shape[0] < 2:
+        return True
+    gt = a[1:] > a[:-1]
+    eq = a[1:] == a[:-1]
+    gtb = eq & (b[1:] > b[:-1])
+    eqb = eq & (b[1:] == b[:-1])
+    return bool((gt | gtb | (eqb & (c[1:] > c[:-1]))).all())
 
 
 def _dedup_rows(r: _Rows) -> _Rows:
     """Max-until dedup per identity: folding through multiple paths keeps
     the most permissive admissibility, exactly like the closure's
-    group_max."""
-    if r.e_res.shape[0]:
-        o = np.lexsort((r.e_ctx, r.e_cav, r.e_k2, r.e_res))
-        er, ek, ec, ex, eu = (
-            r.e_res[o], r.e_k2[o], r.e_cav[o], r.e_ctx[o], r.e_until[o]
+    group_max.  Sort keys pack into uint64 words for the native parallel
+    radix (all components non-negative except ctx, biased by +1 — an
+    order-preserving transform, so the permutation is the np.lexsort
+    one); gathers apply in parallel."""
+    from ..native.sort import sortperm_words, take32, take64
+
+    if r.e_res.shape[0] and _strictly_inc2(
+        r.e_res, r.e_k2
+    ):
+        # identity rows arriving strictly (res, k2)-sorted (a single
+        # leaf's rows out of the unique-identity primary view) dedup to
+        # themselves: the stable sort is the identity permutation and
+        # every run has length 1 — passthrough, bit-identical
+        er, ek, ec, ex, eu = r.e_res, r.e_k2, r.e_cav, r.e_ctx, r.e_until
+    elif r.e_res.shape[0]:
+        w2 = (r.e_cav.astype(np.uint64) << np.uint64(32)) | (
+            r.e_ctx.astype(np.int64) + 1
+        ).astype(np.uint64)
+        o = sortperm_words(
+            [r.e_res.astype(np.int64), r.e_k2, w2],
+            (r.e_ctx, r.e_cav, r.e_k2, r.e_res),
         )
+        er, ek = take32(r.e_res, o), take64(r.e_k2, o)
+        ec, ex, eu = take32(r.e_cav, o), take32(r.e_ctx, o), take32(r.e_until, o)
         first = np.ones(er.shape[0], bool)
         first[1:] = (
             (er[1:] != er[:-1]) | (ek[1:] != ek[:-1])
@@ -115,9 +151,17 @@ def _dedup_rows(r: _Rows) -> _Rows:
         eu = np.maximum.reduceat(eu, st)
     else:
         er, ek, ec, ex, eu = (r.e_res,) * 5
-    if r.u_res.shape[0]:
-        o = np.lexsort((r.u_srel, r.u_subj, r.u_res))
-        ur, us, ul, uu = r.u_res[o], r.u_subj[o], r.u_srel[o], r.u_until[o]
+    if r.u_res.shape[0] and _strictly_inc3(r.u_res, r.u_subj, r.u_srel):
+        ur, us, ul, uu = r.u_res, r.u_subj, r.u_srel, r.u_until
+    elif r.u_res.shape[0]:
+        w1 = (r.u_subj.astype(np.uint64) << np.uint64(32)) | r.u_srel.astype(
+            np.uint64
+        )
+        o = sortperm_words(
+            [r.u_res.astype(np.int64), w1], (r.u_srel, r.u_subj, r.u_res)
+        )
+        ur, us = take32(r.u_res, o), take32(r.u_subj, o)
+        ul, uu = take32(r.u_srel, o), take32(r.u_until, o)
         first = np.ones(ur.shape[0], bool)
         first[1:] = (
             (ur[1:] != ur[:-1]) | (us[1:] != us[:-1]) | (ul[1:] != ul[:-1])
@@ -155,13 +199,35 @@ def _lift(rows: _Rows, src: np.ndarray, dst: np.ndarray,
     return _concat_rows(out_parts)
 
 
+def _is_sorted(a: np.ndarray) -> bool:
+    return a.shape[0] < 2 or bool((a[1:] >= a[:-1]).all())
+
+
 def _sorted_by_res(r: _Rows) -> _Rows:
-    oe = np.argsort(r.e_res, kind="stable")
-    ou = np.argsort(r.u_res, kind="stable")
-    return _Rows(
-        r.e_res[oe], r.e_k2[oe], r.e_cav[oe], r.e_ctx[oe], r.e_until[oe],
-        r.u_res[ou], r.u_subj[ou], r.u_srel[ou], r.u_until[ou],
-    )
+    from ..native.sort import argsort1, take32, take64
+
+    # leaf rows masked out of the (rel, res, ...)-sorted primary/userset
+    # views arrive already res-sorted: a stable sort is then the identity
+    # permutation, so returning the rows untouched is bit-identical and
+    # skips two 30M-row sorts on the trivial-union fold path
+    e_sorted = _is_sorted(r.e_res)
+    u_sorted = _is_sorted(r.u_res)
+    if e_sorted and u_sorted:
+        return r
+    if e_sorted:
+        er, ek, ec, ex, eu = r.e_res, r.e_k2, r.e_cav, r.e_ctx, r.e_until
+    else:
+        oe = argsort1(r.e_res)
+        er, ek = take32(r.e_res, oe), take64(r.e_k2, oe)
+        ec, ex = take32(r.e_cav, oe), take32(r.e_ctx, oe)
+        eu = take32(r.e_until, oe)
+    if u_sorted:
+        ur, us, ul, uu = r.u_res, r.u_subj, r.u_srel, r.u_until
+    else:
+        ou = argsort1(r.u_res)
+        ur, us = take32(r.u_res, ou), take32(r.u_subj, ou)
+        ul, uu = take32(r.u_srel, ou), take32(r.u_until, ou)
+    return _Rows(er, ek, ec, ex, eu, ur, us, ul, uu)
 
 
 @dataclass

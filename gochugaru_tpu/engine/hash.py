@@ -77,7 +77,16 @@ def build_hash(
     min_size: int = 8,
     max_factor: int = 8,
 ) -> HashIndex:
-    """Index the rows of lock-step int32 key columns by hash bucket."""
+    """Index the rows of lock-step int32 key columns by hash bucket.
+
+    The hot path is native (native/sort.py hash_index32): one fused
+    mask/histogram/prefix/stable-scatter pass replaces the
+    mask→astype→bincount→argsort→cumsum chain, producing bit-identical
+    ``rows``/``off`` (a stable counting sort by bucket IS
+    np.argsort(bucket, kind="stable")).  The numpy fallback below is the
+    reference implementation the parity test pins the native path to."""
+    from ..native.sort import hash_index32, mix32_native
+
     n = int(key_cols[0].shape[0]) if key_cols else 0
     if n == 0:
         size = min_size
@@ -89,7 +98,9 @@ def build_hash(
             n=0,
         )
     cols = [np.ascontiguousarray(c, np.int32) for c in key_cols]
-    h_full = mix32(cols, np)
+    h_full = mix32_native(cols)
+    if h_full is None:
+        h_full = mix32(cols, np)
     size = _ceil_pow2(2 * n, min_size)
     # growth chases a small max bucket, but the max of n Poisson draws
     # grows with log n: beyond ~16M rows target_cap=4 is statistically
@@ -97,6 +108,13 @@ def build_hash(
     # 100M-edge table would hit 2^31 buckets) — freeze size and accept
     # the larger probe cap instead
     limit = size if n > (1 << 24) else size * max_factor
+    got = hash_index32(h_full, size)
+    if got is not None:
+        rows, off, cap = got
+        while cap > target_cap and size < limit:
+            size <<= 1
+            rows, off, cap = hash_index32(h_full, size)
+        return HashIndex(off=off, rows=rows, size=size, cap=cap, n=n)
     while True:
         h = (h_full & np.uint32(size - 1)).astype(np.int64)
         counts = np.bincount(h, minlength=size)
@@ -131,14 +149,15 @@ class RangeIndex:
 
 
 def build_range_hash(k: np.ndarray, **kw) -> RangeIndex:
-    """Build a RangeIndex over a column already sorted ascending."""
+    """Build a RangeIndex over a column already sorted ascending (group
+    boundaries via the native sorted-runs pass; numpy mask fallback)."""
+    from ..native.sort import sorted_runs
+
     n = int(k.shape[0])
     if n == 0:
         z = np.zeros(0, np.int32)
         return RangeIndex(gk=z, glo=z, ghi=z, index=build_hash([], **kw))
-    first = np.ones(n, bool)
-    first[1:] = k[1:] != k[:-1]
-    starts = np.nonzero(first)[0]
+    starts = sorted_runs(k)
     ends = np.concatenate([starts[1:], np.asarray([n])])
     gk = np.ascontiguousarray(k[starts], np.int32)
     return RangeIndex(
@@ -240,13 +259,19 @@ def interleave_buckets(
     match nothing).  Callers slicing more than ``h.cap`` rows must pass
     their slice cap as ``pad`` — slice_blocks' clamp would otherwise SHIFT
     the block and break the lane↔row mapping."""
+    from ..native.sort import fill_interleaved
+
     w = max(len(cols), 1)
     n = int(h.rows.shape[0]) if h.n else 0
     n_pad = _ceil_pow2(max(n, 1) + max(pad, h.cap))
-    out = np.full((n_pad, w), -1, np.int32)
+    # pad rows get -1; data rows are fully overwritten below, so only the
+    # tail needs the fill (a 2-col 30M-row table skips a 256MB memset)
+    out = np.empty((n_pad, w), np.int32)
+    out[n:] = -1
     if h.n:
-        for j, c in enumerate(cols):
-            out[:n, j] = np.ascontiguousarray(c, np.int32)[h.rows]
+        if not fill_interleaved(out, cols, h.rows):
+            for j, c in enumerate(cols):
+                out[:n, j] = np.ascontiguousarray(c, np.int32)[h.rows]
     return out
 
 
@@ -258,12 +283,16 @@ def interleave_rows(
     Padded to pow2(n + pad) rows of ``pad_fill``; ``pad`` must be ≥ the
     largest row-slice cap any probe site uses (slice_blocks clamps starts,
     which would silently shift an undersized table's lane↔row mapping)."""
+    from ..native.sort import fill_interleaved
+
     w = max(len(cols), 1)
     n = int(cols[0].shape[0]) if cols else 0
     n_pad = _ceil_pow2(max(n, 1) + max(pad, 1))
-    out = np.full((n_pad, w), pad_fill, np.int32)
-    for j, c in enumerate(cols):
-        out[:n, j] = np.ascontiguousarray(c, np.int32)
+    out = np.empty((n_pad, w), np.int32)
+    out[n:] = pad_fill
+    if n and not fill_interleaved(out, cols, None):
+        for j, c in enumerate(cols):
+            out[:n, j] = np.ascontiguousarray(c, np.int32)
     return out
 
 
@@ -358,14 +387,25 @@ def _aligned_fill(
     (tbl, leftover_row_indices) where leftover rows did not fit their
     bucket's ``cap`` slots.  ``counts`` (bincount of ``h``) is reused
     when the caller already computed it."""
+    from ..native.sort import hash_index32
+
     w = len(cols)
     n = int(h.shape[0])
-    order = np.argsort(h, kind="stable")
-    hs = h[order]
-    if counts is None:
-        counts = np.bincount(hs, minlength=size)
-    off = np.zeros(size, np.int64)
-    np.cumsum(counts[:-1], out=off[1:])
+    got = hash_index32(h.astype(np.uint32), size) if size <= 2**31 else None
+    if got is not None:
+        # native stable counting sort == np.argsort(h, kind="stable"),
+        # with the exclusive bucket starts already materialized
+        order, off32, _cap = got
+        order = order.astype(np.int64)
+        hs = h[order]
+        off = off32[:-1].astype(np.int64)
+    else:
+        order = np.argsort(h, kind="stable")
+        hs = h[order]
+        if counts is None:
+            counts = np.bincount(hs, minlength=size)
+        off = np.zeros(size, np.int64)
+        np.cumsum(counts[:-1], out=off[1:])
     rank = np.arange(n, dtype=np.int64) - off[hs]
     fits = rank < cap
     tbl = np.full((size, cap * w), -1, np.int32)

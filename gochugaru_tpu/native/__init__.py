@@ -124,13 +124,73 @@ def _load() -> Optional[ctypes.CDLL]:
             c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.c_int64,
             c.POINTER(c.c_int64),
         ]
+        lib.gi_sortperm3.argtypes = [
+            c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),
+            c.POINTER(c.c_uint64), c.c_int64, c.POINTER(c.c_int64),
+        ]
+        lib.gi_hash_index32.argtypes = [
+            c.POINTER(c.c_uint32), c.c_int64, c.c_int64,
+            c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+        ]
+        lib.gi_hash_index32.restype = c.c_int64
+        lib.gi_mix32.argtypes = [
+            c.POINTER(c.c_int64), c.c_int64, c.c_int64, c.POINTER(c.c_uint32),
+        ]
+        lib.gi_take32.argtypes = [
+            c.POINTER(c.c_int32), c.POINTER(c.c_int64), c.c_int64,
+            c.POINTER(c.c_int32),
+        ]
+        lib.gi_take64.argtypes = [
+            c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.c_int64,
+            c.POINTER(c.c_int64),
+        ]
+        lib.gi_interleave32.argtypes = [
+            c.POINTER(c.c_int64), c.c_int64, c.POINTER(c.c_int32), c.c_int64,
+            c.POINTER(c.c_int32), c.c_int64,
+        ]
+        lib.gi_run_bounds64.argtypes = [
+            c.POINTER(c.c_int64), c.c_int64, c.POINTER(c.c_int64),
+        ]
+        lib.gi_run_bounds64.restype = c.c_int64
+        lib.gi_run_bounds32.argtypes = [
+            c.POINTER(c.c_int32), c.c_int64, c.POINTER(c.c_int64),
+        ]
+        lib.gi_run_bounds32.restype = c.c_int64
+        lib.gi_pack32.argtypes = [
+            c.POINTER(c.c_int32), c.POINTER(c.c_int32), c.c_int64, c.c_int64,
+            c.POINTER(c.c_int32),
+        ]
+        lib.gi_msrel1.argtypes = [
+            c.POINTER(c.c_int32), c.POINTER(c.c_int32), c.c_int64, c.c_int64,
+            c.POINTER(c.c_int32),
+        ]
         _lib = lib
         return _lib
 
 
+#: test hook + escape hatch: GOCHUGARU_NATIVE=0 (or set_enabled(False))
+#: forces every native-accelerated path onto its pure-numpy fallback —
+#: tests/test_prepare_parity.py builds both ways and asserts bitwise
+#: equality of every produced table.
+_forced_off = os.environ.get("GOCHUGARU_NATIVE", "").strip() == "0"
+
+
+def set_enabled(on: bool) -> None:
+    global _forced_off
+    _forced_off = not on
+
+
+def enabled() -> bool:
+    """Whether the native layer is currently allowed (it may still be
+    unavailable if the library failed to build)."""
+    return not _forced_off
+
+
 def available() -> bool:
-    return _load() is not None
+    return lib() is not None
 
 
 def lib() -> Optional[ctypes.CDLL]:
+    if _forced_off:
+        return None
     return _load()

@@ -224,19 +224,25 @@ def decoded_relationship(
     directly.  Semantics match ``Relationship(...)`` exactly, including
     the defensive caveat-context copy — fields arrive pre-validated from
     the snapshot's interned columns, so no parsing re-runs."""
-    r = object.__new__(Relationship)
-    r.__dict__.update(
-        resource_type=resource_type,
-        resource_id=resource_id,
-        resource_relation=resource_relation,
-        subject_type=subject_type,
-        subject_id=subject_id,
-        subject_relation=subject_relation,
-        caveat_name=caveat_name,
-        caveat_context=dict(caveat_context) if caveat_context else {},
-        expiration=expiration,
-    )
+    r = _obj_new(Relationship)
+    _obj_setattr(r, "__dict__", {
+        "resource_type": resource_type,
+        "resource_id": resource_id,
+        "resource_relation": resource_relation,
+        "subject_type": subject_type,
+        "subject_id": subject_id,
+        "subject_relation": subject_relation,
+        "caveat_name": caveat_name,
+        "caveat_context": dict(caveat_context) if caveat_context else {},
+        "expiration": expiration,
+    })
     return r
+
+
+#: bound once: the per-row constructor above runs millions of times per
+#: export, and global lookups of object.__new__/__setattr__ are ~8% of it
+_obj_new = object.__new__
+_obj_setattr = object.__setattr__
 
 
 def as_relationship(r: RelationshipLike) -> Relationship:

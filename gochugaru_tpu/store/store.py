@@ -1032,6 +1032,18 @@ class Store:
             live = seg.live
             if not live.any():
                 continue
+            if live.all():
+                # fully-live segment (the bulk-import common case): use
+                # the columns directly — no 7-column boolean gather
+                parts.append(
+                    {
+                        "res": seg.res, "rel": seg.rel,
+                        "subj": seg.subj, "srel1": seg.srel1,
+                        "caveat": seg.caveat, "ctx": seg.ctx,
+                        "exp_us": seg.exp_us,
+                    }
+                )
+                continue
             parts.append(
                 {
                     "res": seg.res[live], "rel": seg.rel[live],
@@ -1061,10 +1073,8 @@ class Store:
         }
         return build_snapshot_from_columns(
             rev, compiled, self.interner,
-            res=cat["res"].astype(np.int64),
-            rel=cat["rel"].astype(np.int64),
-            subj=cat["subj"].astype(np.int64),
-            srel=cat["srel1"].astype(np.int64) - 1,
+            res=cat["res"], rel=cat["rel"], subj=cat["subj"],
+            srel=cat["srel1"] - 1,  # int32 end-to-end; builder normalizes
             caveat=cat["caveat"], ctx=cat["ctx"],
             exp_us=cat["exp_us"], contexts=contexts,
         )

@@ -37,6 +37,7 @@ Injection sites threaded through the tree (grep ``faults.fire``):
     store.materialize        snapshot swap / rebuild (store/store.py)
     snapshot.finish          snapshot column finalization (store/snapshot.py)
     device.prepare           device-resident snapshot build (engine/device.py)
+    prepare.build            staged first-prepare pipeline (engine/flat.py)
     closure.delta            incremental closure advance (store/closure.py)
     device.dispatch          batched check dispatch (engine/device.py)
     latency.dispatch         pinned small-batch dispatch (engine/latency.py)

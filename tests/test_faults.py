@@ -140,6 +140,21 @@ def test_injected_dispatch_fault_is_retried_transparently():
     assert m.counter("faults.injected.device.dispatch") == before + 2
 
 
+def test_injected_prepare_build_fault_is_retried_transparently():
+    # the staged first-prepare pipeline (engine/flat.py build_flat_arrays)
+    # is on the dispatch path for a fresh snapshot: a transient fault
+    # there must classify + retry inside the client envelope, exactly
+    # like the round-7 dispatch sites
+    c = _client()
+    ctx = background()
+    m = _metrics.default
+    before = m.counter("faults.injected.prepare.build")
+    with faults.armed("prepare.build", times=1) as spec:
+        assert c.check(ctx, consistency.full(), *CHECKS) == EXPECT
+    assert spec.fired == 1
+    assert m.counter("faults.injected.prepare.build") == before + 1
+
+
 def test_injected_snapshot_fault_is_retried_transparently():
     c = _client()
     ctx = background()

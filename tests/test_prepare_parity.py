@@ -1,0 +1,312 @@
+"""Parity + budget tests for the vectorized cold-start prepare pipeline.
+
+The first-prepare rebuild (round 9) moved every hot loop onto fused
+native kernels (native/ingest.cpp: parallel radix, counting-sort hash
+index, interleaved gathers) with pure-numpy fallbacks.  The contract is
+the round-8 incremental-closure guarantee: the accelerated builder's
+output tables are BITWISE-identical to the reference (numpy) builder on
+randomized worlds — usersets, nested groups, caveats with contexts,
+expirations, wildcards, and closure overflow all exercised.
+
+Plus a CI-safe budget smoke: a fixed small world's first prepare must
+stay inside a generous wall-clock envelope, and the staged pipeline must
+publish its ``prepare.*`` stage timers (the bench-output decomposition
+contract of benchmarks/bench_import.py).
+"""
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+from gochugaru_tpu import native, rel
+from gochugaru_tpu.engine.device import DeviceEngine
+from gochugaru_tpu.engine.flat import (
+    build_flat_arrays,
+    build_flat_arrays_sharded,
+)
+from gochugaru_tpu.engine.plan import EngineConfig
+from gochugaru_tpu.schema import compile_schema, parse_schema
+from gochugaru_tpu.store.interner import Interner
+from gochugaru_tpu.store.snapshot import build_snapshot
+from gochugaru_tpu.utils import metrics
+
+NOW = 1_700_000_000_000_000
+
+SCHEMA = """
+caveat on_tuesday(day string) { day == "tuesday" }
+definition user {}
+definition team {
+    relation member: user | team#member | user:*
+    permission everyone = member
+}
+definition doc {
+    relation reader: user | user:* | team#member | team#everyone
+    relation writer: user | team#member
+    permission edit = writer
+    permission view = reader + edit
+}
+"""
+
+
+def _random_world(seed: int, n_edges: int):
+    """Randomized relationships hitting every table the builder emits:
+    direct / wildcard / userset / permission-valued-userset subjects,
+    caveats (with and without context), expirations, nested team chains
+    deep enough to overflow a small closure cap."""
+    rng = random.Random(seed)
+    n_docs = max(n_edges // 8, 8)
+    n_users = max(n_edges // 16, 8)
+    n_teams = 48
+    rels = []
+    # nested teams: a few long chains (closure overflow at small caps)
+    # plus random nesting
+    for t in range(1, n_teams):
+        parent = t - 1 if t % 7 else rng.randrange(t)
+        rels.append(rel.Relationship(
+            resource_type="team", resource_id=f"t{parent}",
+            resource_relation="member",
+            subject_type="team", subject_id=f"t{t}",
+            subject_relation="member",
+        ))
+    for t in range(n_teams):
+        for _ in range(rng.randrange(1, 4)):
+            r = rel.Relationship(
+                resource_type="team", resource_id=f"t{t}",
+                resource_relation="member",
+                subject_type="user", subject_id=f"u{rng.randrange(n_users)}",
+            )
+            if rng.random() < 0.2:
+                r = rel.Relationship(
+                    **{**r.__dict__, "caveat_name": "on_tuesday",
+                       "caveat_context": {"day": "tuesday"}},
+                )
+            rels.append(r)
+    # one wildcard team member + wildcard doc readers
+    rels.append(rel.Relationship(
+        resource_type="team", resource_id="t3", resource_relation="member",
+        subject_type="user", subject_id="*",
+    ))
+    for i in range(n_edges):
+        d = f"d{rng.randrange(n_docs)}"
+        kind = rng.random()
+        kw = dict(resource_type="doc", resource_id=d,
+                  resource_relation="reader" if rng.random() < 0.8 else "writer",
+                  subject_type="user", subject_id=f"u{rng.randrange(n_users)}")
+        if kind < 0.08:
+            kw.update(subject_type="team",
+                      subject_id=f"t{rng.randrange(n_teams)}",
+                      subject_relation="member")
+        elif kind < 0.11:
+            kw.update(subject_type="team",
+                      subject_id=f"t{rng.randrange(n_teams)}",
+                      subject_relation="everyone")
+            kw["resource_relation"] = "reader"
+        elif kind < 0.13:
+            kw.update(subject_id="*")
+            kw["resource_relation"] = "reader"
+        r = rel.Relationship(**kw)
+        if rng.random() < 0.1:
+            r = rel.Relationship(
+                **{**r.__dict__, "caveat_name": "on_tuesday",
+                   "caveat_context": {"day": "tuesday"} if rng.random() < 0.5
+                   else {}},
+            )
+        if rng.random() < 0.07:
+            import datetime as dt
+
+            r = rel.Relationship(
+                **{**r.__dict__,
+                   "expiration": dt.datetime.fromtimestamp(
+                       (NOW + rng.randrange(-10**9, 10**12)) / 1e6,
+                       tz=dt.timezone.utc,
+                   )},
+            )
+        rels.append(r)
+    return rels
+
+
+SNAP_COLS = [
+    "node_type", "wildcard_node_of_type",
+    "e_rel", "e_res", "e_subj", "e_srel1", "e_caveat", "e_ctx", "e_exp",
+    "e_exp_us",
+    "us_rel", "us_res", "us_subj", "us_srel", "us_caveat", "us_ctx",
+    "us_exp", "us_perm", "pus_n", "pus_r",
+    "ms_subj", "ms_res", "ms_rel", "ms_caveat", "ms_ctx", "ms_exp",
+    "mp_subj", "mp_srel", "mp_res", "mp_rel", "mp_caveat", "mp_ctx",
+    "mp_exp",
+    "ar_rel", "ar_res", "ar_child", "ar_caveat", "ar_ctx", "ar_exp",
+]
+
+
+def _build(rels, native_on: bool, *, sharded: bool = False, **cfg):
+    """One full pipeline run (snapshot + flat tables) with the native
+    layer forced on/off.  Fresh interner per run: the two runs must not
+    share any state.  Restores the PRIOR enabled state afterwards (a
+    GOCHUGARU_NATIVE=0 session must stay numpy-only past these tests)."""
+    prior = native.enabled()
+    native.set_enabled(native_on)
+    try:
+        cs = compile_schema(parse_schema(SCHEMA))
+        snap = build_snapshot(1, cs, Interner(), rels, epoch_us=NOW)
+        engine = DeviceEngine(cs, EngineConfig.for_schema(cs, **cfg))
+        if sharded:
+            built = build_flat_arrays_sharded(
+                snap, engine.config, 2, plan=engine.plan
+            )
+        else:
+            built = build_flat_arrays(snap, engine.config, plan=engine.plan)
+        assert built is not None
+        arrays, meta, _fstate, _cstate = built
+        return snap, arrays, meta
+    finally:
+        native.set_enabled(prior)
+
+
+def _assert_same(sa, aa, ma, sb, ab, mb):
+    for col in SNAP_COLS:
+        va, vb = getattr(sa, col), getattr(sb, col)
+        assert va.dtype == vb.dtype and np.array_equal(va, vb), (
+            f"snapshot column {col} differs"
+        )
+    assert sa.us_used_keys.shape == sb.us_used_keys.shape
+    assert np.array_equal(sa.us_used_keys, sb.us_used_keys)
+    assert set(aa) == set(ab), (
+        f"table sets differ: {set(aa) ^ set(ab)}"
+    )
+    for k in sorted(aa):
+        assert aa[k].shape == ab[k].shape, f"{k} shape differs"
+        assert np.array_equal(aa[k], ab[k]), f"table {k} differs"
+    assert ma == mb, "FlatMeta differs"
+
+
+@pytest.mark.skipif(not native.available(), reason="no native library")
+@pytest.mark.parametrize("seed", [7, 23])
+def test_vectorized_builder_bitwise_parity(seed):
+    """Native-accelerated build == reference numpy build, bitwise, on a
+    randomized world (the world is sized past the native engagement
+    threshold so the fused kernels actually run)."""
+    rels = _random_world(seed, 80_000)
+    sa, aa, ma = _build(rels, native_on=False)
+    sb, ab, mb = _build(rels, native_on=True)
+    _assert_same(sa, aa, ma, sb, ab, mb)
+
+
+@pytest.mark.skipif(not native.available(), reason="no native library")
+def test_parity_with_closure_overflow_and_small_caps():
+    """Small closure cap forces overflow sources; small fold/T budgets
+    flip the optional tables — the parity must hold on every layout."""
+    rels = _random_world(3, 70_000)
+    kw = dict(closure_source_cap=12)
+    sa, aa, ma = _build(rels, False, **kw)
+    sb, ab, mb = _build(rels, True, **kw)
+    _assert_same(sa, aa, ma, sb, ab, mb)
+
+
+@pytest.mark.skipif(not native.available(), reason="no native library")
+def test_parity_sharded_stacked_layout():
+    """The bucket-sharded (stacked) builder: batched stacking + native
+    kernels vs the pure-numpy reference, bitwise."""
+    rels = _random_world(11, 70_000)
+    sa, aa, ma = _build(rels, False, sharded=True)
+    sb, ab, mb = _build(rels, True, sharded=True)
+    _assert_same(sa, aa, ma, sb, ab, mb)
+
+
+# ---------------------------------------------------------------------------
+# piecewise parity of the pure-numpy rewrites (no native involvement):
+# the rewritten expressions must equal the idioms they replaced
+# ---------------------------------------------------------------------------
+
+
+def test_feeds_searchsorted_equals_isin():
+    rng = np.random.default_rng(5)
+    edge_key = rng.integers(0, 5000, 200_000)
+    used = np.unique(rng.integers(0, 5000, 300))
+    pos = np.clip(np.searchsorted(used, edge_key), 0, used.shape[0] - 1)
+    assert np.array_equal(used[pos] == edge_key, np.isin(edge_key, used))
+
+
+def test_uniq_small_equals_np_unique():
+    from gochugaru_tpu.engine.flat import _uniq_small
+
+    rng = np.random.default_rng(6)
+    parts = [rng.integers(0, 40, 10_000).astype(np.int32),
+             np.zeros(0, np.int32),
+             rng.integers(0, 40, 7).astype(np.int32)]
+    ref = np.unique(np.concatenate(parts).astype(np.int64))
+    got = _uniq_small(parts, 40)
+    assert got.dtype == ref.dtype and np.array_equal(got, ref)
+
+
+def test_dedup_rows_sorted_fast_path_is_exact():
+    """The strict-sorted passthrough of fold._dedup_rows must equal the
+    full sort+reduce on inputs that qualify AND on ones that don't."""
+    from gochugaru_tpu.engine.fold import _Rows, _dedup_rows
+
+    rng = np.random.default_rng(8)
+
+    def ref(r):
+        o = np.lexsort((r.e_ctx, r.e_cav, r.e_k2, r.e_res))
+        er, ek, ec, ex, eu = (
+            r.e_res[o], r.e_k2[o], r.e_cav[o], r.e_ctx[o], r.e_until[o]
+        )
+        first = np.ones(er.shape[0], bool)
+        first[1:] = (
+            (er[1:] != er[:-1]) | (ek[1:] != ek[:-1])
+            | (ec[1:] != ec[:-1]) | (ex[1:] != ex[:-1])
+        )
+        st = np.nonzero(first)[0]
+        return (er[first], ek[first], ec[first], ex[first],
+                np.maximum.reduceat(eu, st))
+
+    z = np.zeros(0, np.int32)
+    for case in ("sorted-unique", "random"):
+        n = 5_000
+        if case == "sorted-unique":
+            res = np.sort(rng.choice(100_000, n, replace=False)).astype(np.int32)
+            k2 = rng.integers(0, 2**40, n)
+        else:
+            res = rng.integers(0, 50, n).astype(np.int32)
+            k2 = rng.integers(0, 10, n)
+        r = _Rows(
+            res, k2.astype(np.int64),
+            rng.integers(0, 3, n).astype(np.int32),
+            rng.integers(-1, 5, n).astype(np.int32),
+            rng.integers(1, 100, n).astype(np.int32),
+            z, z, z, z,
+        )
+        got = _dedup_rows(r)
+        want = ref(r)
+        for g, w in zip((got.e_res, got.e_k2, got.e_cav, got.e_ctx,
+                         got.e_until), want):
+            assert np.array_equal(g, w), case
+
+
+# ---------------------------------------------------------------------------
+# budget smoke + stage-timer presence (CI-safe)
+# ---------------------------------------------------------------------------
+
+
+def test_first_prepare_budget_and_stage_timers():
+    """First prepare of a fixed 150k-edge world: generous wall-clock
+    envelope (regression tripwire, not a benchmark) and every pipeline
+    stage must have published its ``prepare.*`` timer — the decomposition
+    benchmarks/bench_import.py reports."""
+    rels = _random_world(1, 150_000)
+    cs = compile_schema(parse_schema(SCHEMA))
+    metrics.default.reset()
+    snap = build_snapshot(1, cs, Interner(), rels, epoch_us=NOW)
+    engine = DeviceEngine(cs, EngineConfig.for_schema(cs))
+    t0 = time.perf_counter()
+    dsnap = engine.prepare(snap)
+    wall = time.perf_counter() - t0
+    assert dsnap.flat_meta is not None
+    got = metrics.default.snapshot()
+    for stage in ("prepare.closure_s", "prepare.pack_s", "prepare.hash_s",
+                  "prepare.tindex_s", "prepare.h2d_s", "prepare.total_s",
+                  "prepare.snapshot_s"):
+        assert f"{stage}.count" in got, f"missing stage timer {stage}"
+    # ~1.5 s measured on a 2-core CI box; 20 s is the don't-regress bar
+    assert wall < 20.0, f"first prepare took {wall:.1f}s at 150k edges"
