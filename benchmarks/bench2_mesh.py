@@ -39,7 +39,7 @@ def main() -> int:
     import jax
     import numpy as np
 
-    from benchmarks.common import NORTH_STAR_RATE, emit, note
+    from benchmarks.common import NORTH_STAR_RATE, emit, note, peak_rss_mb
     from bench import build_world
     from gochugaru_tpu.engine.device import DeviceEngine
 
@@ -190,6 +190,7 @@ def main() -> int:
         breaker_trips=int(delta("breaker.trips")),
         edges=int(snap.num_edges),
         batch=int(B),
+        peak_rss_mb=peak_rss_mb(),
         platform=jax.default_backend(),
         note=(
             "CPU proxy (8 virtual devices); mesh = data x model;"

@@ -16,7 +16,7 @@ import sys as _sys
 
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
-from benchmarks.common import maybe_force_cpu, emit, note
+from benchmarks.common import maybe_force_cpu, emit, note, peak_rss_mb
 
 
 def main() -> None:
@@ -58,7 +58,7 @@ def main() -> None:
     dt = time.perf_counter() - t0
     rate = args.edges / dt
     emit("bulk_import_edges_per_sec", rate, "edges/sec", rate / 1_000_000,
-         edges=int(args.edges))
+         edges=int(args.edges), peak_rss_mb=peak_rss_mb())
     note(f"import: {dt:.1f}s for {args.edges:,} edges")
 
     # columnar path: same shape, fresh id space, no per-edge objects —
@@ -133,7 +133,7 @@ def main() -> None:
     }
     emit(
         "first_check_after_import_s", dt, "s", 30.0 / max(dt, 1e-9),
-        edges=int(3 * args.edges), **stages,
+        edges=int(3 * args.edges), peak_rss_mb=peak_rss_mb(), **stages,
     )
     note(f"first check after import (incl. device prepare): {dt:.1f}s | "
          + " ".join(f"{k}={v}" for k, v in stages.items()))

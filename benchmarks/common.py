@@ -346,6 +346,16 @@ def latency_percentiles(
     return float(np.percentile(a, 50)), float(np.percentile(a, 99)), float(a.mean())
 
 
+def peak_rss_mb() -> float:
+    """Per-process peak resident set in MiB (ru_maxrss ⊔ /proc VmHWM;
+    gochugaru_tpu/utils/metrics.py) — benches attach it as a
+    ``peak_rss_mb`` column so the host-sharded build's memory claim is a
+    measured number riding the trajectory, not a docstring."""
+    from gochugaru_tpu.utils.metrics import peak_rss_mb as _impl
+
+    return _impl()
+
+
 def maybe_force_cpu() -> str:
     """Benches honor GOCHUGARU_FORCE_CPU=1 (set by run_all.py when its
     bounded TPU probe fails) — the axon TPU backend can hang on init, and

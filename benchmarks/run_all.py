@@ -92,6 +92,12 @@ def main() -> int:
     env["GOCHUGARU_BACKEND_PROBED"] = backend
     if backend != "tpu":
         env["GOCHUGARU_FORCE_CPU"] = "1"
+        # pin the platform for the whole child TREE: processes the bench
+        # children themselves spawn (2-process dryruns, RSS workers —
+        # parallel/multihost.py) see a pinned platform and skip their
+        # own bounded probe instead of paying the 75 s degraded timeout
+        # per child (BENCH_r05 paid it before every degraded stage)
+        env.setdefault("JAX_PLATFORMS", "cpu")
         backend = "cpu (TPU backend unusable at run time)"
     py = sys.executable
 

@@ -92,6 +92,7 @@ class _Options:
         self.profile_dir: Optional[str] = None
         self.latency_mode = False
         self.admission: Optional[AdmissionConfig] = None
+        self.mesh = None  # jax.sharding.Mesh → sharded engine
 
 
 Option = Callable[[_Options], None]
@@ -152,6 +153,21 @@ def with_latency_mode() -> Option:
     return opt
 
 
+def with_mesh(mesh) -> Option:
+    """Evaluate checks over a (data × model) device mesh: the client
+    builds a ShardedEngine (parallel/sharded.py) — query batches split
+    along the data axis, the bucket-sharded tables along the model axis
+    — instead of the single-chip DeviceEngine.  The multichip serving
+    shape; dispatch faults and the partitioned-prepare fault site
+    (``prepare.partition``) retry under the same client envelope as the
+    single-chip sites."""
+
+    def opt(o: _Options) -> None:
+        o.mesh = mesh
+
+    return opt
+
+
 def with_admission_control(config: AdmissionConfig) -> Option:
     """Tune the dispatch admission controller (utils/admission.py): the
     bounded in-flight gate, the deadline-budget shed, and the latency-path
@@ -191,6 +207,7 @@ class Client:
         self._use_device = o.use_device
         self._profile_dir = o.profile_dir
         self._latency_mode = o.latency_mode
+        self._mesh = o.mesh
         # jax.profiler allows one active trace per process: profiled
         # dispatches serialize so concurrent check() calls don't collide
         self._profile_lock = threading.Lock()
@@ -224,7 +241,16 @@ class Client:
             return None
         with self._lock:
             if self._engine is None or self._engine_schema is not snap.compiled:
-                self._engine = DeviceEngine(snap.compiled, self._engine_config)
+                if self._mesh is not None:
+                    from .parallel.sharded import ShardedEngine
+
+                    self._engine = ShardedEngine(
+                        snap.compiled, self._mesh, self._engine_config
+                    )
+                else:
+                    self._engine = DeviceEngine(
+                        snap.compiled, self._engine_config
+                    )
                 self._engine_schema = snap.compiled
                 self._dsnap_cache.clear()
             return self._engine

@@ -1442,6 +1442,66 @@ def _stack_range(ri, row_cols: Sequence[np.ndarray], M: int, fan_pad: int):
     )
 
 
+def _groups_of(k: np.ndarray):
+    """(gk, glo, ghi) distinct-key groups of a sorted key column — the
+    group arrays build_range_hash materializes, shared by the partitioned
+    range stacking and the per-slot fanout meta."""
+    from ..native.sort import sorted_runs
+
+    n = int(k.shape[0])
+    if n == 0:
+        z64 = np.zeros(0, np.int64)
+        return np.zeros(0, np.int32), z64, z64
+    starts = sorted_runs(k)
+    ends = np.concatenate([starts[1:], np.asarray([n])])
+    return np.ascontiguousarray(k[starts], np.int32), starts, ends
+
+
+def _primary_hash_chunked(
+    rel: np.ndarray, res: np.ndarray, subj: np.ndarray, srel1: np.ndarray,
+    maps: SlotMaps, N: int, S1: int, chunk: int,
+):
+    """uint32 bucket hash of every primary row's dense (k1, k2) key,
+    computed in bounded row chunks: the partitioned build's ownership
+    pass never materializes a full-size packed key column (the chunk
+    bound is what tests/test_sharded_memory.py's allocation tracker
+    asserts).  Column-based so the stacked builder (sorted snapshot
+    columns) and the feed partition (raw unsorted columns) share ONE
+    definition of the key hash — the bitwise-parity-critical pass."""
+    from .partition import _hash_cols
+
+    n = int(rel.shape[0])
+    h = np.empty(n, np.uint32)
+    for at in range(0, n, max(chunk, 1)):
+        sl = slice(at, min(at + chunk, n))
+        k1 = _pack(maps.k1[rel[sl]], N, res[sl])
+        k2 = _pack(subj[sl], S1, _m_srel1(maps, srel1[sl]))
+        h[sl] = _hash_cols([k1, k2])
+    return h
+
+
+def _e_cols_at(snap, maps: SlotMaps, N: int, S1: int, gates):
+    """Partition-local primary-table columns: the dense key packs are
+    recomputed per shard over just that shard's rows (matching the
+    chunked hash pass — no O(E) pack scratch)."""
+    from ..native.sort import take32
+
+    def at(rows: np.ndarray):
+        idx = np.ascontiguousarray(rows, np.int64)
+        rel = take32(snap.e_rel, idx)
+        res = take32(snap.e_res, idx)
+        subj = take32(snap.e_subj, idx)
+        srel1 = take32(snap.e_srel1, idx)
+        cols = [
+            _pack(maps.k1[rel], N, res),
+            _pack(subj, S1, _m_srel1(maps, srel1)),
+        ]
+        cols.extend(take32(g, idx) for g in gates)
+        return cols
+
+    return at
+
+
 def build_flat_arrays_sharded(
     snap, config: EngineConfig, model_size: int,
     plan: Optional[DevicePlan] = None,
@@ -1480,8 +1540,6 @@ def build_flat_arrays_sharded(
         return None
     S1 = maps.S1
 
-    e_k1 = _pack(maps.k1[snap.e_rel], N, snap.e_res)
-    e_k2 = _pack(snap.e_subj, S1, _m_srel1(maps, snap.e_srel1))
     us_gk = _pack(maps.k1[snap.us_rel], N, snap.us_res)
     ar_gk = _pack(maps.k1[snap.ar_rel], N, snap.ar_res)
     cl_k1 = _pack(cl.c_src, S1, _m_srel1(maps, cl.c_srel1))
@@ -1492,54 +1550,129 @@ def build_flat_arrays_sharded(
     flags = _view_flags_of(snap)
 
     ms = max(8, M)
-    eh = build_hash([e_k1, e_k2], min_size=ms)
+    # partition-first mode (engine/partition.py; config.flat_partition_
+    # build, the default): the O(E) tables — primary hash, userset/arrow
+    # range views, T-index, fold pf_e — are hashed to bucket shards
+    # FIRST and each shard's slice of the stacked arrays is built
+    # independently, so the sort/hash/interleave scratch peaks at
+    # O(E/M), never O(E).  Output is BITWISE-identical to the legacy
+    # build-full-then-stack path below (tests/test_prepare_parity.py).
+    # Globally-small derived tables (closure, pus/ovf, fold pf_u/csr,
+    # rc) keep the full build: they are sized by the group structure and
+    # every process derives them from the replicated membership subgraph
+    PART = bool(config.flat_partition_build)
+    if PART:
+        faults.fire("prepare.partition")
+        from .partition import (
+            _hash_cols, gather_cols, point_geom, range_geom,
+            stack_point, stack_range,
+        )
+    _t_part = time.perf_counter()
+
     clh = build_hash([cl_k1, cl_k2], min_size=ms)
     push = build_hash([pus_k], min_size=ms)
     ovfh = build_hash([ovf_k], min_size=ms)
 
     out: Dict[str, np.ndarray] = {}
-    out["eh_off"], out["ehx"] = _stack_point(
-        eh,
-        [e_k1, e_k2]
-        + ([snap.e_caveat, snap.e_ctx] if flags["e_hascav"] else [])
-        + ([snap.e_exp] if flags["e_hasexp"] else []),
-        M,
+    e_gates = (
+        ([snap.e_caveat, snap.e_ctx] if flags["e_hascav"] else [])
+        + ([snap.e_exp] if flags["e_hasexp"] else [])
     )
+    if PART:
+        h_e = _primary_hash_chunked(
+            snap.e_rel, snap.e_res, snap.e_subj, snap.e_srel1,
+            maps, N, S1, config.flat_partition_chunk,
+        )
+        ge, e_ord = point_geom(h_e, M, min_size=ms, return_order=True)
+        out["eh_off"], out["ehx"] = stack_point(
+            h_e, _e_cols_at(snap, maps, N, S1, e_gates), ge,
+            2 + len(e_gates), order=e_ord,
+        )
+        del h_e, e_ord
+        eh_cap, eh_n = ge.cap, ge.n
+    else:
+        e_k1 = _pack(maps.k1[snap.e_rel], N, snap.e_res)
+        e_k2 = _pack(snap.e_subj, S1, _m_srel1(maps, snap.e_srel1))
+        eh = build_hash([e_k1, e_k2], min_size=ms)
+        out["eh_off"], out["ehx"] = _stack_point(eh, [e_k1, e_k2] + e_gates, M)
+        eh_cap, eh_n = eh.cap, eh.n
     out["clh_off"], out["clx"] = _stack_point(
         clh, [cl_k1, cl_k2, cl.c_d_until, cl.c_p_until], M
     )
     out["push_off"], out["pusx"] = _stack_point(push, [pus_k], M)
     out["ovfh_off"], out["ovfx"] = _stack_point(ovfh, [ovf_k], M)
 
-    usr = build_range_hash(us_gk, min_size=ms)
-    arr = build_range_hash(ar_gk, min_size=ms)
-    out["usr_off"], out["usgx"], out["usx"], usr_cap = _stack_range(
-        usr,
-        # srel rides DENSE, matching the dense closure/T keys
+    # srel rides DENSE, matching the dense closure/T keys
+    us_cols = (
         [snap.us_subj, maps.k2[snap.us_srel]]
         + ([snap.us_caveat, snap.us_ctx] if flags["us_hascav"] else [])
         + ([snap.us_exp] if flags["us_hasexp"] else [])
-        + ([snap.us_perm] if flags["us_hasperm"] else []),
-        M, max(64, config.us_leaf_cap),
+        + ([snap.us_perm] if flags["us_hasperm"] else [])
     )
-    out["arr_off"], out["argx"], out["arx"], arr_cap = _stack_range(
-        arr,
+    ar_cols = (
         [snap.ar_child]
         + ([snap.ar_caveat, snap.ar_ctx] if flags["ar_hascav"] else [])
-        + ([snap.ar_exp] if flags["ar_hasexp"] else []),
-        M, max(64, config.arrow_fanout),
+        + ([snap.ar_exp] if flags["ar_hasexp"] else [])
     )
+    if PART:
+        us_gkg, us_glo, us_ghi = _groups_of(us_gk)
+        ar_gkg, ar_glo, ar_ghi = _groups_of(ar_gk)
+        h_usg = _hash_cols([us_gkg])
+        gus = range_geom(
+            us_gkg, us_ghi - us_glo, h_usg, M, min_size=ms,
+            fan_pad=max(64, config.us_leaf_cap),
+        )
+        out["usr_off"], out["usgx"], out["usx"] = stack_range(
+            us_gkg, us_glo, us_ghi - us_glo, h_usg,
+            gather_cols(us_cols), gus, len(us_cols),
+        )
+        usr_cap = gus.cap
+        h_arg = _hash_cols([ar_gkg])
+        gar = range_geom(
+            ar_gkg, ar_ghi - ar_glo, h_arg, M, min_size=ms,
+            fan_pad=max(64, config.arrow_fanout),
+        )
+        out["arr_off"], out["argx"], out["arx"] = stack_range(
+            ar_gkg, ar_glo, ar_ghi - ar_glo, h_arg,
+            gather_cols(ar_cols), gar, len(ar_cols),
+        )
+        arr_cap = gar.cap
+    else:
+        usr = build_range_hash(us_gk, min_size=ms)
+        arr = build_range_hash(ar_gk, min_size=ms)
+        out["usr_off"], out["usgx"], out["usx"], usr_cap = _stack_range(
+            usr, us_cols, M, max(64, config.us_leaf_cap),
+        )
+        out["arr_off"], out["argx"], out["arx"], arr_cap = _stack_range(
+            arr, ar_cols, M, max(64, config.arrow_fanout),
+        )
+        # the RangeIndexes already hold the group arrays: reuse them for
+        # the per-slot fanout meta instead of a second sorted-runs pass
+        us_gkg, us_glo, us_ghi = usr.gk, usr.glo, usr.ghi
+        ar_gkg, ar_glo, ar_ghi = arr.gk, arr.glo, arr.ghi
 
     t_kw = dict(has_tindex=False, t_cap=4, t_n=8, t_slots=())
     tj = _tindex_join(snap, config, cl, us_gk, cl_k1, cl_k2, pus_k, maps)
     if tj is not None:
         T_k1, T_k2, T_d, T_p, t_slots = tj
-        th = build_hash([T_k1, T_k2], min_size=ms)
-        out["th_off"], out["tx"] = _stack_point(th, [T_k1, T_k2, T_d, T_p], M)
+        if PART:
+            h_T = _hash_cols([T_k1, T_k2])
+            gT, t_ord = point_geom(h_T, M, min_size=ms, return_order=True)
+            out["th_off"], out["tx"] = stack_point(
+                h_T, gather_cols([T_k1, T_k2, T_d, T_p]), gT, 4,
+                order=t_ord,
+            )
+            th_cap, th_n = gT.cap, gT.n
+        else:
+            th = build_hash([T_k1, T_k2], min_size=ms)
+            out["th_off"], out["tx"] = _stack_point(
+                th, [T_k1, T_k2, T_d, T_p], M
+            )
+            th_cap, th_n = th.cap, th.n
         t_kw = dict(
             has_tindex=True,
-            t_cap=_round_cap(th.cap),
-            t_n=_ceil_pow2(max(th.n, 1)),
+            t_cap=_round_cap(th_cap),
+            t_n=_ceil_pow2(max(th_n, 1)),
             t_slots=t_slots,
         )
 
@@ -1552,14 +1685,23 @@ def build_flat_arrays_sharded(
             got = None
     if got is not None:
         pf_k1, pf_k2, pf_subj, (u_k1, u_gk, u_until, u_fan), pff = got
-        pfh = build_hash([pf_k1, pf_k2], min_size=ms)
-        out["pfh_off"], out["pfx"] = _stack_point(
-            pfh,
+        pf_cols = (
             [pf_k1, pf_k2]
             + ([fr.e_cav, fr.e_ctx] if pff["pf_hascav"] else [])
-            + ([fr.e_until] if pff["pf_hasuntil"] else []),
-            M,
+            + ([fr.e_until] if pff["pf_hasuntil"] else [])
         )
+        if PART:
+            h_pf = _hash_cols([pf_k1, pf_k2])
+            gpf, pf_ord = point_geom(h_pf, M, min_size=ms, return_order=True)
+            out["pfh_off"], out["pfx"] = stack_point(
+                h_pf, gather_cols(pf_cols), gpf, len(pf_cols),
+                order=pf_ord,
+            )
+            pfh_cap = gpf.cap
+        else:
+            pfh = build_hash([pf_k1, pf_k2], min_size=ms)
+            out["pfh_off"], out["pfx"] = _stack_point(pfh, pf_cols, M)
+            pfh_cap = pfh.cap
         pfu = build_range_hash(u_k1, min_size=ms)
         out["pfu_off"], out["pfugx"], out["pfux"], pfu_cap = _stack_range(
             pfu, [u_gk, u_until], M, max(64, u_fan)
@@ -1570,7 +1712,7 @@ def build_flat_arrays_sharded(
         )
         fold_kw = dict(
             fold_pairs=fr.pairs,
-            pf_e_cap=_round_cap(pfh.cap),
+            pf_e_cap=_round_cap(pfh_cap),
             pf_u_cap=_round_cap(pfu_cap),
             pf_u_fan=u_fan,
             pf_s_cap=_round_cap(csr_cap),
@@ -1600,13 +1742,17 @@ def build_flat_arrays_sharded(
         ) = _stack_range(ri, [anc, d_u, p_u], M, max(64, fan))
         rc_list.append((int(ts_slot), _round_cap(gcap), fan))
 
+    if PART:
+        metrics.default.observe(
+            "prepare.partition_s", time.perf_counter() - _t_part
+        )
     meta = FlatMeta(
         N=N, S1=S1,
         k1_dense=tuple(int(x) for x in maps.k1),
         k2_dense=tuple(int(x) for x in maps.k2),
         **fold_kw,
         rc_slots=tuple(sorted(rc_list)),
-        e_cap=_round_cap(eh.cap), e_n=_ceil_pow2(max(eh.n, 1)),
+        e_cap=_round_cap(eh_cap), e_n=_ceil_pow2(max(eh_n, 1)),
         usr_cap=_round_cap(usr_cap),
         usr_gn=8,  # legacy-probe geometry: unused (local shapes rule)
         us_rows=8,
@@ -1618,8 +1764,8 @@ def build_flat_arrays_sharded(
         pus_cap=_round_cap(push.cap), pus_n=_ceil_pow2(max(push.n, 1)),
         ovf_cap=_round_cap(ovfh.cap), ovf_n=_ceil_pow2(max(ovfh.n, 1)),
         has_ovf=ovfh.n > 0,
-        ar_fanout_by_slot=_run_maxes(arr.gk, arr.glo, arr.ghi, N, maps.k1_raw),
-        us_fanout_by_slot=_run_maxes(usr.gk, usr.glo, usr.ghi, N, maps.k1_raw),
+        ar_fanout_by_slot=_run_maxes(ar_gkg, ar_glo, ar_ghi, N, maps.k1_raw),
+        us_fanout_by_slot=_run_maxes(us_gkg, us_glo, us_ghi, N, maps.k1_raw),
         **t_kw,
         **flags,
         blockslice=True,

@@ -146,6 +146,18 @@ class EngineConfig:
     #: per-table byte budget for the aligned layout; tables whose aligned
     #: form exceeds it keep the off+interleave layout
     flat_aligned_max_bytes: int = 3 << 30
+    #: partition-first stacked builds (engine/partition.py): hash keys to
+    #: bucket shards FIRST, then build each model shard's slice of the
+    #: stacked tables independently — bitwise-identical output with
+    #: O(E/M) sort/hash/interleave scratch per shard instead of O(E)
+    #: (ROADMAP "Host-sharded table build").  False keeps the reference
+    #: build-full-then-stack path (the parity tests' oracle)
+    flat_partition_build: bool = True
+    #: row-chunk size of the partitioned build's primary-key hash pass:
+    #: the dense (k1, k2) packs are computed per chunk, so no full-size
+    #: O(E) packed key column is ever materialized (the bound
+    #: tests/test_sharded_memory.py's allocation tracker asserts)
+    flat_partition_chunk: int = 1 << 22
     #: bulk-check batches beyond this split into sub-dispatches queued
     #: back-to-back (jax async dispatch): device compute overlaps the
     #: next chunk's host lowering/transfer and per-sub-batch results

@@ -209,6 +209,53 @@ class ShardedEngine(DeviceEngine):
                 )
         return self._prepare_legacy(snap)
 
+    def prepare_partitioned(self, part) -> DeviceSnapshot:
+        """DeviceSnapshot from a bucket-partitioned feed
+        (engine/partition.py partition_feed): the O(E) stacked tables
+        exist host-side ONLY for this process's owned shards
+        (ShardSlices); ``jax.make_array_from_callback`` asks for exactly
+        the addressable blocks, so assembling the global arrays never
+        materializes the full table on any host.  Replicated tables
+        (node types, contexts, dl_* — and the closure-derived stacks,
+        which every process builds whole from the replicated membership
+        subgraph) ship via the ordinary replicated device_put."""
+        from ..engine.partition import ShardSlices
+
+        snap = part.snapshot
+        host = dict(part.arrays)
+        host["node_type"] = _pad_payload(
+            snap.node_type, _ceil_pow2(2 * snap.num_nodes), -1
+        )
+        ectx, strings = self._ectx_tables(snap)
+        host.update(ectx)
+        arrays = {}
+        for k, v in host.items():
+            sh = NamedSharding(self.mesh, self._flat_spec_of(k))
+            if isinstance(v, ShardSlices):
+                cb = v.block_for
+            else:
+                # replicated / full tables place via the same callback
+                # API: device_put of a replicated array onto a process-
+                # spanning mesh runs a consistency-assert COLLECTIVE
+                # (multihost_utils.assert_equal), which some CPU jaxlib
+                # builds cannot execute — the callback path places local
+                # buffers directly and is collective-free by design
+                cb = (lambda v: lambda index: v[index])(v)
+            arrays[k] = jax.make_array_from_callback(v.shape, sh, cb)
+        tid_map = np.full(
+            max(self.plan.num_schema_types, 1), -1, dtype=np.int32
+        )
+        for tname, tid in self.compiled.type_ids.items():
+            tid_map[tid] = snap.interner.type_lookup(tname)
+        return DeviceSnapshot(
+            revision=snap.revision,
+            arrays=arrays,
+            tid_map=jnp.asarray(tid_map),
+            snapshot=snap,
+            strings=strings,
+            flat_meta=part.meta,
+        )
+
     def _delta_prev_ok(self, prev: DeviceSnapshot) -> bool:
         # the sharded incremental prepare rides bucket-sharded base tables
         return prev.flat_meta is not None and prev.flat_meta.sharded

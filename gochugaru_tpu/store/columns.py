@@ -39,6 +39,24 @@ def pack_keys(
     return out
 
 
+def filter_columns(
+    cols: Mapping[str, np.ndarray], rows: np.ndarray
+) -> Dict[str, np.ndarray]:
+    """Bucket-filtered column view: one vectorized (native-parallel) take
+    per column, shared by the feed-partition path (engine/partition.py)
+    — a multihost process keeps only the store-feed rows whose bucket
+    shard it owns, as a gather over the feed columns, never a row-wise
+    copy of the world.  int64 columns (exact expiry micros, packed keys)
+    keep their width; everything else is int32 by construction."""
+    from ..native.sort import take32, take64
+
+    idx = np.ascontiguousarray(rows, np.int64)
+    return {
+        k: take64(v, idx) if v.dtype == np.int64 else take32(v, idx)
+        for k, v in cols.items()
+    }
+
+
 class ColumnSegment:
     """One immutable bulk-imported block of edges with a mutable liveness
     mask (TOUCH/DELETE of an imported edge marks its row dead; the

@@ -300,20 +300,16 @@ class Snapshot:
                 )
 
 
-def build_snapshot(
-    revision: int,
+def relationships_to_raw_columns(
     compiled: CompiledSchema,
     interner: Interner,
     relationships: Sequence[Relationship],
-    *,
-    epoch_us: Optional[int] = None,
-) -> Snapshot:
-    """Materialize sorted columnar arrays from live relationships."""
-    import time as _time
-
-    if epoch_us is None:
-        epoch_us = int(_time.time() * 1_000_000)
-
+):
+    """Intern live relationships into UNSORTED raw columns + contexts —
+    the store-feed form ``build_snapshot`` sorts into a Snapshot and the
+    feed-partition path (engine/partition.py partition_feed) buckets by
+    shard ownership instead.  Row order is the input order, which is
+    what makes both paths' stable sorts break ties identically."""
     E = len(relationships)
     res = np.empty(E, dtype=np.int64)
     rel_s = np.empty(E, dtype=np.int64)
@@ -338,11 +334,32 @@ def build_snapshot(
                 contexts.append(r.caveat_context)
         exp_us[i] = expiration_micros(r.expiration) if r.has_expiration() else 0
 
+    return (
+        dict(res=res, rel=rel_s, subj=subj, srel=srel, caveat=cav,
+             ctx=ctx, exp_us=exp_us),
+        contexts,
+    )
+
+
+def build_snapshot(
+    revision: int,
+    compiled: CompiledSchema,
+    interner: Interner,
+    relationships: Sequence[Relationship],
+    *,
+    epoch_us: Optional[int] = None,
+) -> Snapshot:
+    """Materialize sorted columnar arrays from live relationships."""
+    import time as _time
+
+    if epoch_us is None:
+        epoch_us = int(_time.time() * 1_000_000)
+    raw, contexts = relationships_to_raw_columns(
+        compiled, interner, relationships
+    )
     return build_snapshot_from_columns(
         revision, compiled, interner,
-        res=res, rel=rel_s, subj=subj, srel=srel,
-        caveat=cav, ctx=ctx, exp_us=exp_us,
-        contexts=contexts, epoch_us=epoch_us,
+        contexts=contexts, epoch_us=epoch_us, **raw,
     )
 
 
@@ -597,4 +614,71 @@ def finish_snapshot(
     metrics.default.observe(
         "prepare.snapshot_s", _time.perf_counter() - _t0
     )
+    return snap
+
+
+def partitioned_snapshot(
+    mem_snap: Snapshot,
+    *,
+    e_cols: Mapping[str, np.ndarray],
+    us_rows: np.ndarray,
+    ar_cols: Mapping[str, np.ndarray],
+    owned,
+) -> Snapshot:
+    """Bucket-filtered Snapshot: the process-local view of one feed
+    partition (engine/partition.py partition_feed).
+
+    The big per-edge views hold ONLY shard-owned rows — primary rows by
+    their (k1, k2) bucket, userset/arrow rows by their (rel, res) group
+    bucket — each in global sort order restricted to the owned set
+    (equal keys co-locate per shard, so local stable sorts reproduce the
+    global tie-breaks).  The membership subgraph (``ms_*``/``mp_*``),
+    the used-userset key set, ``pus_*``, node types, and contexts come
+    whole from ``mem_snap`` (the replicated membership snapshot): the
+    flattened closure must be derivable on every process.  NOT a full
+    snapshot: host-oracle fallbacks and exports over it see only the
+    local partition — the sharded dispatch path never consults those
+    for in-cap queries."""
+    from .columns import filter_columns
+
+    us = filter_columns(
+        {
+            "rel": mem_snap.us_rel, "res": mem_snap.us_res,
+            "subj": mem_snap.us_subj, "srel": mem_snap.us_srel,
+            "caveat": mem_snap.us_caveat, "ctx": mem_snap.us_ctx,
+            "exp": mem_snap.us_exp, "perm": mem_snap.us_perm,
+        },
+        us_rows,
+    )
+    snap = Snapshot(
+        revision=mem_snap.revision,
+        compiled=mem_snap.compiled,
+        interner=mem_snap.interner,
+        num_nodes=mem_snap.num_nodes,
+        num_slots=mem_snap.num_slots,
+        epoch_us=mem_snap.epoch_us,
+        node_type=mem_snap.node_type,
+        wildcard_node_of_type=mem_snap.wildcard_node_of_type,
+        e_rel=e_cols["rel"], e_res=e_cols["res"], e_subj=e_cols["subj"],
+        e_srel1=e_cols["srel1"], e_caveat=e_cols["caveat"],
+        e_ctx=e_cols["ctx"], e_exp=e_cols["exp"],
+        e_exp_us=e_cols["exp_us"],
+        us_rel=us["rel"], us_res=us["res"], us_subj=us["subj"],
+        us_srel=us["srel"], us_caveat=us["caveat"], us_ctx=us["ctx"],
+        us_exp=us["exp"], us_perm=us["perm"],
+        pus_n=mem_snap.pus_n, pus_r=mem_snap.pus_r,
+        ms_subj=mem_snap.ms_subj, ms_res=mem_snap.ms_res,
+        ms_rel=mem_snap.ms_rel, ms_caveat=mem_snap.ms_caveat,
+        ms_ctx=mem_snap.ms_ctx, ms_exp=mem_snap.ms_exp,
+        mp_subj=mem_snap.mp_subj, mp_srel=mem_snap.mp_srel,
+        mp_res=mem_snap.mp_res, mp_rel=mem_snap.mp_rel,
+        mp_caveat=mem_snap.mp_caveat, mp_ctx=mem_snap.mp_ctx,
+        mp_exp=mem_snap.mp_exp,
+        ar_rel=ar_cols["rel"], ar_res=ar_cols["res"],
+        ar_child=ar_cols["child"], ar_caveat=ar_cols["caveat"],
+        ar_ctx=ar_cols["ctx"], ar_exp=ar_cols["exp"],
+        contexts=mem_snap.contexts,
+    )
+    snap.us_used_keys = mem_snap.us_used_keys
+    snap.partition_owned = tuple(owned)  # marker: bucket-filtered view
     return snap

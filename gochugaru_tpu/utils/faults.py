@@ -38,6 +38,9 @@ Injection sites threaded through the tree (grep ``faults.fire``):
     snapshot.finish          snapshot column finalization (store/snapshot.py)
     device.prepare           device-resident snapshot build (engine/device.py)
     prepare.build            staged first-prepare pipeline (engine/flat.py)
+    prepare.partition        partition-first stacked/feed build
+                             (engine/flat.py sharded builder,
+                             engine/partition.py partition_feed)
     closure.delta            incremental closure advance (store/closure.py)
     device.dispatch          batched check dispatch (engine/device.py)
     latency.dispatch         pinned small-batch dispatch (engine/latency.py)

@@ -113,3 +113,25 @@ class Metrics:
 
 #: Process-global default registry.
 default = Metrics()
+
+
+def peak_rss_mb() -> float:
+    """Process peak resident set size in MiB: the max of
+    ``getrusage(RUSAGE_SELF).ru_maxrss`` (KiB on Linux) and
+    ``/proc/self/status`` VmHWM.  The host-sharded build's memory claim
+    is a MEASURED per-process number (benchmarks emit it as a
+    ``peak_rss_mb`` column; parallel/multihost.py's RSS dryrun compares
+    it across process counts) — a high-water mark, so capture readings
+    at phase boundaries and difference them."""
+    import resource
+
+    peak_kib = float(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmHWM:"):
+                    peak_kib = max(peak_kib, float(line.split()[1]))
+                    break
+    except OSError:
+        pass
+    return round(peak_kib / 1024.0, 1)

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Host-sharded build memory gate: the 2-process CPU dryrun must build
+# its feed-partitioned tables in <= 60% of the single-process
+# build-full-then-stack RSS at the same world, with the partitioned
+# tables bitwise-identical to the pre-PR builder (parity child).
+#
+# Usage: scripts/rss_dryrun.sh [edges] [processes] [max_ratio]
+#
+# Prints RSS-BASELINE / PARITY-OK / RSS-OK / RSS-SUMMARY lines
+# (parallel/multihost.py rss_dryrun); exits non-zero when the ratio
+# bar is missed or any child fails.  Wired as a slow-marked test
+# (tests/test_rss_dryrun.py) so tier-1 stays fast.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+EDGES="${1:-1000000}"
+PROCS="${2:-2}"
+MAX_RATIO="${3:-0.6}"
+
+exec env JAX_PLATFORMS=cpu python -m gochugaru_tpu.parallel.multihost \
+    --rss --edges "$EDGES" --processes "$PROCS" --max-ratio "$MAX_RATIO"

@@ -44,6 +44,13 @@ if _env_aligned is not None:
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running harnesses excluded from tier-1 (-m 'not slow')",
+    )
+
+
 @pytest.fixture(autouse=True)
 def _reset_faults():
     from gochugaru_tpu.utils import faults

@@ -155,6 +155,24 @@ def test_injected_prepare_build_fault_is_retried_transparently():
     assert m.counter("faults.injected.prepare.build") == before + 1
 
 
+def test_injected_partition_fault_is_retried_transparently():
+    # the partition-first prepare (engine/partition.py; fired from the
+    # sharded builder's partition phase) sits on the dispatch path of a
+    # mesh-backed client: a transient fault there must classify + retry
+    # inside the same envelope as prepare.build
+    from gochugaru_tpu.client import with_mesh
+    from gochugaru_tpu.parallel import make_mesh
+
+    c = _client(with_mesh(make_mesh(1, 2)))
+    ctx = background()
+    m = _metrics.default
+    before = m.counter("faults.injected.prepare.partition")
+    with faults.armed("prepare.partition", times=1) as spec:
+        assert c.check(ctx, consistency.full(), *CHECKS) == EXPECT
+    assert spec.fired == 1
+    assert m.counter("faults.injected.prepare.partition") == before + 1
+
+
 def test_injected_snapshot_fault_is_retried_transparently():
     c = _client()
     ctx = background()

@@ -1,0 +1,174 @@
+"""Feed-partition parity (the multihost O(E/M) host-RSS path): the
+stacked tables ``engine/partition.py partition_feed`` prepares from a
+RAW bucket-partitioned store feed must be BITWISE-identical — array for
+array, plus FlatMeta equality — to the pre-PR build-full-then-stack
+reference (``build_flat_arrays_sharded`` over the fully-sorted
+snapshot) at the same feed, on randomized worlds exercising usersets,
+caveats with contexts, expirations, wildcards, and closure overflow.
+The reference passes ``plan=None``: the feed path declines the
+permission fold / rc flattening (their inputs are the full per-edge
+views), so the walked kernel evaluates — parity is against the same
+contract.
+
+Owned-subset runs must produce exactly the owned slices of the full
+arrays, and the bucket-filtered Snapshot must hold only the owned rows
+of each O(E) view while keeping the membership subgraph whole."""
+
+import numpy as np
+import pytest
+
+from test_prepare_parity import NOW, SCHEMA, _random_world
+
+from gochugaru_tpu.engine.flat import build_flat_arrays_sharded
+from gochugaru_tpu.engine.partition import ShardSlices, partition_feed
+from gochugaru_tpu.engine.plan import EngineConfig
+from gochugaru_tpu.schema import compile_schema, parse_schema
+from gochugaru_tpu.store.interner import Interner
+from gochugaru_tpu.store.snapshot import (
+    build_snapshot_from_columns,
+    relationships_to_raw_columns,
+)
+
+
+def _world(seed: int, n_edges: int):
+    rels = _random_world(seed, n_edges)
+    cs = compile_schema(parse_schema(SCHEMA))
+    itn = Interner()
+    raw, contexts = relationships_to_raw_columns(cs, itn, rels)
+    return cs, itn, raw, contexts
+
+
+def _reference(cs, itn, raw, contexts, M):
+    snap = build_snapshot_from_columns(
+        1, cs, itn, contexts=contexts, epoch_us=NOW,
+        **{k: v.copy() for k, v in raw.items()},
+    )
+    cfg = EngineConfig.for_schema(cs)
+    # the reference is the PRE-PR build-full-then-stack path: with the
+    # partition-first default both sides would share engine/partition.py
+    # and a shared bug would cancel out of the parity comparison
+    legacy = EngineConfig.for_schema(cs, flat_partition_build=False)
+    built = build_flat_arrays_sharded(snap, legacy, M, plan=None)
+    assert built is not None
+    arrays, meta, _f, _c = built
+    return snap, arrays, meta, cfg
+
+
+def _as_full(v):
+    return v.to_full() if isinstance(v, ShardSlices) else v
+
+
+@pytest.mark.parametrize("seed,M", [(7, 2), (23, 4)])
+def test_feed_partition_bitwise_parity(seed, M):
+    cs, itn, raw, contexts = _world(seed, 60_000)
+    ref_snap, ref_arrays, ref_meta, cfg = _reference(cs, itn, raw, contexts, M)
+
+    part = partition_feed(
+        1, cs, itn, {k: v.copy() for k, v in raw.items()}, cfg, M,
+        contexts=contexts, epoch_us=NOW,
+    )
+    assert part is not None
+    assert set(part.arrays) == set(ref_arrays), (
+        set(part.arrays) ^ set(ref_arrays)
+    )
+    for k in sorted(ref_arrays):
+        got = _as_full(part.arrays[k])
+        assert got.shape == ref_arrays[k].shape, k
+        assert np.array_equal(got, ref_arrays[k]), f"table {k} differs"
+    assert part.meta == ref_meta, "FlatMeta differs"
+
+    # full ownership reproduces the full per-edge views too
+    assert np.array_equal(np.sort(part.snapshot.e_res), np.sort(ref_snap.e_res))
+    assert part.snapshot.us_rel.shape == ref_snap.us_rel.shape
+
+
+def test_feed_partition_owned_subset_slices():
+    M = 4
+    cs, itn, raw, contexts = _world(3, 40_000)
+    _snap, ref_arrays, ref_meta, cfg = _reference(cs, itn, raw, contexts, M)
+
+    owned = (1, 3)
+    part = partition_feed(
+        1, cs, itn, {k: v.copy() for k, v in raw.items()}, cfg, M,
+        owned=owned, contexts=contexts, epoch_us=NOW,
+    )
+    assert part is not None
+    assert part.meta == ref_meta  # geometry is global: identical everywhere
+    for k, v in part.arrays.items():
+        if not isinstance(v, ShardSlices):
+            # globally-small tables build whole on every process
+            assert np.array_equal(v, ref_arrays[k]), k
+            continue
+        assert sorted(v.blocks) == list(owned), k
+        for s in owned:
+            ref_blk = ref_arrays[k][s * v.per : (s + 1) * v.per]
+            assert np.array_equal(v.blocks[s], ref_blk), (k, s)
+
+    # the bucket-filtered snapshot holds only the owned partitions of the
+    # O(E) views, and the membership subgraph whole
+    full = partition_feed(
+        1, cs, itn, {k: v.copy() for k, v in raw.items()}, cfg, M,
+        contexts=contexts, epoch_us=NOW,
+    )
+    assert part.snapshot.e_rel.shape[0] < full.snapshot.e_rel.shape[0]
+    assert part.snapshot.us_rel.shape[0] < full.snapshot.us_rel.shape[0]
+    assert np.array_equal(full.snapshot.ms_subj, part.snapshot.ms_subj)
+    assert np.array_equal(full.snapshot.mp_subj, part.snapshot.mp_subj)
+    assert part.snapshot.partition_owned == owned
+
+
+def test_prepare_partitioned_dispatch_matches_oracle():
+    """End-to-end: a FeedPartition through ShardedEngine.prepare_
+    partitioned (ShardSlices → jax.make_array_from_callback) must serve
+    real sharded check dispatches that agree with the host oracle."""
+    import random
+
+    from gochugaru_tpu import rel as relmod
+    from gochugaru_tpu.caveats import compile_cel
+    from gochugaru_tpu.engine.oracle import Oracle, T
+    from gochugaru_tpu.parallel import ShardedEngine, make_mesh
+
+    rels = _random_world(9, 4_000)
+    cs = compile_schema(parse_schema(SCHEMA))
+    itn = Interner()
+    raw, contexts = relationships_to_raw_columns(cs, itn, rels)
+    cfg = EngineConfig.for_schema(cs)
+    part = partition_feed(
+        1, cs, itn, raw, cfg, 4, contexts=contexts, epoch_us=NOW
+    )
+    assert part is not None
+    eng = ShardedEngine(cs, make_mesh(2, 4), cfg)
+    dsnap = eng.prepare_partitioned(part)
+    assert dsnap.flat_meta is not None and dsnap.flat_meta.sharded
+
+    rng = random.Random(1)
+    checks = [
+        relmod.must_from_triple(
+            f"doc:d{rng.randrange(500)}",
+            rng.choice(["view", "edit"]),
+            f"user:u{rng.randrange(250)}",
+        )
+        for _ in range(64)
+    ]
+    progs = {
+        name: compile_cel(name, decl.params, decl.expression)
+        for name, decl in cs.schema.caveats.items()
+    }
+    oracle = Oracle(cs, rels, progs, now_us=NOW)
+    d, p, ovf = eng.check_batch(dsnap, checks, now_us=NOW)
+    verified = 0
+    for i, q in enumerate(checks):
+        want = oracle.check_relationship(q)
+        if ovf[i]:
+            continue
+        if d[i]:
+            # definite device grant must be a true grant
+            assert want == T, q
+            verified += 1
+        elif not p[i]:
+            # definite device no: the oracle must not grant
+            assert want != T, q
+            verified += 1
+        # else possible-only (caveats without query context, permission-
+        # valued usersets): the client resolves these on the host
+    assert verified >= len(checks) // 2

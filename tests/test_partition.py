@@ -1,0 +1,194 @@
+"""Unit parity of the partition-first stacking primitives
+(engine/partition.py) against the build-full-then-stack reference
+(engine/flat.py _stack_point/_stack_range over engine/hash.py
+build_hash/build_range_hash): the partitioned build must be BITWISE
+identical — offsets, group tables, row tables, pads — across empty /
+tiny / duplicate-heavy / native-threshold-crossing inputs, and the
+owned-subset (ShardSlices) form must equal the corresponding slices of
+the full arrays."""
+
+import numpy as np
+import pytest
+
+from gochugaru_tpu.engine.flat import _stack_point, _stack_range
+from gochugaru_tpu.engine.hash import build_hash, build_range_hash
+from gochugaru_tpu.engine.partition import (
+    _hash_cols,
+    gather_cols,
+    point_geom,
+    range_geom,
+    shard_order,
+    stack_point,
+    stack_range,
+)
+from gochugaru_tpu.native.sort import sorted_runs
+
+
+def _keys(rng, n, dup_frac):
+    if n == 0:
+        return np.zeros(0, np.int32), np.zeros(0, np.int32)
+    k1 = rng.integers(0, max(int(n * (1 - dup_frac)), 2), n).astype(np.int32)
+    k2 = rng.integers(0, 1 << 20, n).astype(np.int32)
+    return k1, k2
+
+
+@pytest.mark.parametrize("n", [0, 1, 37, 5_000, 80_000])
+@pytest.mark.parametrize("M", [1, 2, 8])
+def test_stack_point_bitwise(n, M):
+    rng = np.random.default_rng(n * 31 + M)
+    k1, k2 = _keys(rng, n, dup_frac=0.3)
+    pay = rng.integers(-1, 1 << 15, n).astype(np.int32)
+    cols = [k1, k2, pay]
+    ms = max(8, M)
+
+    h = build_hash([k1, k2], min_size=ms)
+    ref_off, ref_tbl = _stack_point(h, cols, M)
+
+    h_full = _hash_cols([k1, k2])
+    geom = point_geom(h_full, M, min_size=ms)
+    assert (geom.size, geom.cap, geom.n) == (h.size, h.cap, h.n)
+    got_off, got_tbl = stack_point(h_full, gather_cols(cols), geom, len(cols))
+    assert got_off.dtype == ref_off.dtype and np.array_equal(got_off, ref_off)
+    assert got_tbl.shape == ref_tbl.shape
+    assert np.array_equal(got_tbl, ref_tbl)
+
+    # owned-subset slices == the full arrays' corresponding blocks
+    owned = [0, M - 1] if M > 1 else [0]
+    so, st = stack_point(h_full, gather_cols(cols), geom, len(cols), owned=owned)
+    for s in owned:
+        assert np.array_equal(
+            so.blocks[s], ref_off[s * (geom.bpd + 1) : (s + 1) * (geom.bpd + 1)]
+        )
+        assert np.array_equal(
+            st.blocks[s], ref_tbl[s * geom.R_pad : (s + 1) * geom.R_pad]
+        )
+
+
+@pytest.mark.parametrize("n", [0, 1, 53, 7_000, 80_000])
+@pytest.mark.parametrize("M", [2, 4])
+def test_stack_range_bitwise(n, M):
+    rng = np.random.default_rng(n * 13 + M)
+    # a sorted group-key column with skewed run lengths + payload rows
+    k = np.sort(rng.integers(0, max(n // 6, 2), n)).astype(np.int32)
+    r1 = rng.integers(0, 1 << 20, n).astype(np.int32)
+    r2 = rng.integers(-1, 9, n).astype(np.int32)
+    ms = max(8, M)
+    fan_pad = 64
+
+    ri = build_range_hash(k, min_size=ms)
+    ref_goff, ref_gtbl, ref_rows, ref_cap = _stack_range(ri, [r1, r2], M, fan_pad)
+
+    if n:
+        starts = sorted_runs(k)
+        ends = np.concatenate([starts[1:], np.asarray([n])])
+        gk = np.ascontiguousarray(k[starts], np.int32)
+        glo, lens = starts, ends - starts
+    else:
+        gk = np.zeros(0, np.int32)
+        glo = lens = np.zeros(0, np.int64)
+    h_g = _hash_cols([gk])
+    geom = range_geom(gk, lens, h_g, M, min_size=ms, fan_pad=fan_pad)
+    assert geom.cap == ref_cap
+    assert geom.max_run == ri.max_run
+    got_goff, got_gtbl, got_rows = stack_range(
+        gk, glo, lens, h_g, gather_cols([r1, r2]), geom, 2
+    )
+    assert np.array_equal(got_goff, ref_goff)
+    assert got_gtbl.shape == ref_gtbl.shape
+    assert np.array_equal(got_gtbl, ref_gtbl)
+    assert got_rows.shape == ref_rows.shape
+    assert np.array_equal(got_rows, ref_rows)
+
+    owned = [1]
+    so, sg, sr = stack_range(
+        gk, glo, lens, h_g, gather_cols([r1, r2]), geom, 2, owned=owned
+    )
+    bpd = geom.gh.bpd
+    for s in owned:
+        assert np.array_equal(
+            so.blocks[s], ref_goff[s * (bpd + 1) : (s + 1) * (bpd + 1)]
+        )
+        assert np.array_equal(
+            sg.blocks[s], ref_gtbl[s * geom.G_pad : (s + 1) * geom.G_pad]
+        )
+        assert np.array_equal(
+            sr.blocks[s], ref_rows[s * geom.R_pad : (s + 1) * geom.R_pad]
+        )
+
+
+def test_point_geom_frozen_growth_branch():
+    """Past 2^24 entries build_hash freezes table growth and point_geom
+    switches to per-shard histograms (no O(size) int64 histogram): the
+    geometry must equal the direct global-histogram computation."""
+    from gochugaru_tpu.engine.hash import _ceil_pow2
+
+    n = (1 << 24) + 11
+    h = np.random.default_rng(0).integers(0, 1 << 32, n, dtype=np.uint32)
+    M = 8
+    g = point_geom(h, M, min_size=8)
+    assert g.size == _ceil_pow2(2 * n, 8)  # frozen: no growth
+    counts = np.bincount(
+        (h & np.uint32(g.size - 1)).astype(np.int64), minlength=g.size
+    )
+    assert g.cap == int(counts.max())
+    shard_rows = counts.reshape(M, g.size // M).sum(axis=1)
+    assert g.R_pad == _ceil_pow2(int(shard_rows.max()) + max(64, g.cap))
+
+
+def test_stack_point_precomputed_order_bitwise():
+    """stack_point(order=...) — the frozen-geometry reuse path (>16M
+    rows hands point_geom's own (order, starts) back in) — must equal
+    the self-computed partition bitwise, full and owned-subset."""
+    rng = np.random.default_rng(5)
+    k1, k2 = _keys(rng, 20_000, dup_frac=0.4)
+    pay = rng.integers(-1, 1 << 15, 20_000).astype(np.int32)
+    cols = [k1, k2, pay]
+    M = 8
+    h_full = _hash_cols([k1, k2])
+    geom = point_geom(h_full, M, min_size=M)
+    ord_starts = shard_order(h_full, geom.size, M)
+    ref_off, ref_tbl = stack_point(h_full, gather_cols(cols), geom, len(cols))
+    got_off, got_tbl = stack_point(
+        h_full, gather_cols(cols), geom, len(cols), order=ord_starts
+    )
+    assert np.array_equal(got_off, ref_off)
+    assert np.array_equal(got_tbl, ref_tbl)
+    so, st = stack_point(
+        h_full, gather_cols(cols), geom, len(cols),
+        owned=[1, 6], order=ord_starts,
+    )
+    for s in (1, 6):
+        assert np.array_equal(
+            st.blocks[s], ref_tbl[s * geom.R_pad : (s + 1) * geom.R_pad]
+        )
+
+
+def test_point_geom_return_order_matches_shard_order():
+    """return_order=True: None on the histogram branch; on the frozen
+    branch (>2^24 rows) exactly shard_order's (order, starts)."""
+    rng = np.random.default_rng(6)
+    h_small = rng.integers(0, 1 << 32, 4_096, dtype=np.uint32)
+    g, os_ = point_geom(h_small, 4, min_size=8, return_order=True)
+    assert os_ is None and g.n == 4_096
+
+    n = (1 << 24) + 7
+    h_big = rng.integers(0, 1 << 32, n, dtype=np.uint32)
+    g, os_ = point_geom(h_big, 8, min_size=8, return_order=True)
+    assert os_ is not None
+    order, starts = os_
+    ref_order, ref_starts = shard_order(h_big, g.size, 8)
+    assert np.array_equal(order, ref_order)
+    assert np.array_equal(starts, ref_starts)
+
+
+def test_shard_order_is_stable_partition():
+    rng = np.random.default_rng(2)
+    h = rng.integers(0, 1 << 32, 10_000, dtype=np.uint32)
+    size, M = 1 << 12, 8
+    order, starts = shard_order(h, size, M)
+    bpd = size // M
+    for s in range(M):
+        rows = order[starts[s] : starts[s + 1]]
+        assert np.all(np.diff(rows) > 0)  # original order preserved
+        assert np.all((h[rows] & (size - 1)) // bpd == s)
+    assert starts[-1] == h.shape[0]

@@ -140,7 +140,7 @@ SNAP_COLS = [
 ]
 
 
-def _build(rels, native_on: bool, *, sharded: bool = False, **cfg):
+def _build(rels, native_on: bool, *, sharded: bool = False, M: int = 2, **cfg):
     """One full pipeline run (snapshot + flat tables) with the native
     layer forced on/off.  Fresh interner per run: the two runs must not
     share any state.  Restores the PRIOR enabled state afterwards (a
@@ -153,7 +153,7 @@ def _build(rels, native_on: bool, *, sharded: bool = False, **cfg):
         engine = DeviceEngine(cs, EngineConfig.for_schema(cs, **cfg))
         if sharded:
             built = build_flat_arrays_sharded(
-                snap, engine.config, 2, plan=engine.plan
+                snap, engine.config, M, plan=engine.plan
             )
         else:
             built = build_flat_arrays(snap, engine.config, plan=engine.plan)
@@ -211,6 +211,24 @@ def test_parity_sharded_stacked_layout():
     rels = _random_world(11, 70_000)
     sa, aa, ma = _build(rels, False, sharded=True)
     sb, ab, mb = _build(rels, True, sharded=True)
+    _assert_same(sa, aa, ma, sb, ab, mb)
+
+
+@pytest.mark.parametrize("M", [2, 4])
+def test_partition_first_equals_build_full_then_stack(M):
+    """The partition-first stacked build (engine/partition.py, the
+    default) vs the legacy build-full-then-stack path, bitwise — on a
+    randomized world with usersets, caveats, wildcards, expirations,
+    closure overflow, folds, and the T-index all engaged."""
+    rels = _random_world(5, 70_000)
+    sa, aa, ma = _build(
+        rels, native.available(), sharded=True, M=M,
+        flat_partition_build=True, flat_partition_chunk=1 << 14,
+    )
+    sb, ab, mb = _build(
+        rels, native.available(), sharded=True, M=M,
+        flat_partition_build=False,
+    )
     _assert_same(sa, aa, ma, sb, ab, mb)
 
 

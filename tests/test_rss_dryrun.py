@@ -1,0 +1,23 @@
+"""The host-sharded build's memory acceptance bar, as a slow-marked
+test (scripts/rss_dryrun.sh is the CLI form): the 2-process CPU dryrun
+must build its feed-partitioned tables in ≤ 60% of the single-process
+build-full-then-stack RSS at the same world, with the partitioned
+tables bitwise-identical to the pre-PR builder (the harness's parity
+child).  Deltas are measured against the post-worldgen baseline of each
+process, so the comparison isolates feed→tables memory from the fixed
+interpreter/jax footprint.  Excluded from tier-1 (``-m 'not slow'``):
+it spawns four python+jax processes over a ~1M-edge world."""
+
+import pytest
+
+from gochugaru_tpu.parallel.multihost import rss_dryrun
+
+
+@pytest.mark.slow
+def test_two_process_build_rss_within_60_percent():
+    summary = rss_dryrun(
+        edges=1_000_000, n_processes=2, n_devices=8, max_ratio=0.6
+    )
+    assert summary["ratio"] <= 0.6
+    # every worker owns a proper shard subset (disjoint on the 1×8 mesh)
+    assert summary["n_processes"] == 2

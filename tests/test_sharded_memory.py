@@ -10,6 +10,7 @@ everywhere.
 """
 
 import numpy as np
+import pytest
 
 import jax
 
@@ -75,3 +76,96 @@ def test_sharded_tables_split_memory_per_device():
     )
     assert d.shape[0] == B and not ovf.any()
     assert 0 < int(d.sum()) < B
+
+
+# ---------------------------------------------------------------------------
+# partition-first build scratch: no full-size O(E) sort/gather/interleave
+# ---------------------------------------------------------------------------
+
+#: sort-layer entry points whose call SIZES the shim records — the
+#: sort/gather/interleave scratch the partition-first build promises to
+#: keep shard-local.  Key/geometry passes (pack32/mix32/sorted_runs: one
+#: flat O(E) value column each, no permutation scratch) and the single
+#: stable owner-partition pass (hash_index32 with bucket count == M) are
+#: the documented exemptions.
+_TRACKED = (
+    "hash_index32", "fill_interleaved", "take32", "take64",
+    "lexsort4", "lexsort2", "argsort1", "sortperm_words",
+)
+
+
+def _shim_sizes(monkeypatch, calls):
+    import gochugaru_tpu.native.sort as nsort
+
+    def size_of(name, args):
+        if name == "hash_index32":
+            n, size = int(args[0].shape[0]), int(args[1])
+            return None if size <= 8 else n  # owner partition exempt
+        if name == "fill_interleaved":
+            return int(args[1][0].shape[0]) if args[1] else 0
+        if name in ("take32", "take64"):
+            return int(args[1].shape[0])
+        if name == "sortperm_words":
+            return int(args[0][0].shape[0])
+        return int(args[0].shape[0])
+
+    for name in _TRACKED:
+        orig = getattr(nsort, name)
+
+        def wrapper(*args, _orig=orig, _name=name, **kw):
+            n = size_of(_name, args)
+            if n is not None:
+                calls.append((_name, n))
+            return _orig(*args, **kw)
+
+        monkeypatch.setattr(nsort, name, wrapper)
+
+
+def test_partitioned_build_scratch_is_shard_local():
+    """The partition-first sharded prepare must never run a full-size
+    O(E) sort/gather/interleave: every tracked sort-layer call stays
+    bounded by ~E/M (+ pad slack).  The legacy build-full-then-stack
+    path trips the same tracker (sanity: the assertion discriminates).
+    Fold derivation is out of scope (its join is global by design —
+    ISSUE: only the hash/range/T tables and their sort scratch become
+    shard-local), so the fold is off here."""
+    import sys
+
+    sys.path.insert(0, ".")
+    from bench import build_world
+
+    from gochugaru_tpu.engine.plan import EngineConfig
+
+    # big enough that E/M + slack < E (the bound must discriminate)
+    cs, snap, users, repos, slot = build_world(
+        n_repos=40_000, n_users=1_000, n_teams=100, n_orgs=10
+    )
+    E = snap.num_edges
+    M = 4
+    bound = E // M + 70_000  # shard skew + pow2 pads + T-join fan slack
+    assert bound < E
+
+    def prepare_with(partition: bool):
+        calls = []
+        with pytest.MonkeyPatch.context() as mp:
+            _shim_sizes(mp, calls)
+            cfg = EngineConfig.for_schema(
+                cs, flat_fold=False, flat_partition_build=partition,
+                flat_partition_chunk=1 << 15,
+            )
+            eng = ShardedEngine(cs, make_mesh(2, M), cfg)
+            dsnap = eng.prepare(snap)
+        assert dsnap.flat_meta is not None and dsnap.flat_meta.sharded
+        return calls
+
+    calls = prepare_with(partition=True)
+    assert calls, "tracker saw no sort-layer calls"
+    worst = max(calls, key=lambda c: c[1])
+    assert worst[1] <= bound, (
+        f"full-size scratch: {worst[0]} over {worst[1]} rows (E={E})"
+    )
+
+    legacy = prepare_with(partition=False)
+    assert max(n for _, n in legacy) >= E, (
+        "tracker failed to see the legacy path's full-size build"
+    )
