@@ -328,29 +328,43 @@ def run_bench(batches, world_kw, budget_s, note=None):
 
 
 def child_main(mode: str, note: str | None) -> None:
-    if mode == "cpu":
-        from gochugaru_tpu.utils.platform import force_cpu_platform
+    try:
+        if mode == "cpu":
+            _child_body_cpu(note)
+        else:
+            _child_body_accel(note)
+    finally:
+        # --metrics rides up through the parent's metric-line relay
+        from benchmarks.common import maybe_emit_metrics_snapshot
 
-        force_cpu_platform()
-        # SPEC world even on the CPU fallback (10k repos × 1k users,
-        # ramp to the 100k-class batch): a degraded run must measure the
-        # config it names, just slower — never a silently smaller graph
-        run_bench(
-            batches=(8_192, 32_768, 131_072),
-            world_kw={},
-            budget_s=CPU_CHILD_TIMEOUT_S,
-            note=note or "degraded: cpu fallback",
-        )
-    else:
-        # ramp past 131k: with the aligned-table kernel the dispatch is
-        # ~6 row gathers, so bigger batches keep amortizing the tunnel
-        # round trip (budget gating skips the tail on a short window)
-        run_bench(
-            batches=(8_192, 32_768, 131_072, 262_144),
-            world_kw={},
-            budget_s=TPU_CHILD_TIMEOUT_S,
-            note=note,
-        )
+        maybe_emit_metrics_snapshot()
+
+
+def _child_body_cpu(note: str | None) -> None:
+    from gochugaru_tpu.utils.platform import force_cpu_platform
+
+    force_cpu_platform()
+    # SPEC world even on the CPU fallback (10k repos × 1k users,
+    # ramp to the 100k-class batch): a degraded run must measure the
+    # config it names, just slower — never a silently smaller graph
+    run_bench(
+        batches=(8_192, 32_768, 131_072),
+        world_kw={},
+        budget_s=CPU_CHILD_TIMEOUT_S,
+        note=note or "degraded: cpu fallback",
+    )
+
+
+def _child_body_accel(note: str | None) -> None:
+    # ramp past 131k: with the aligned-table kernel the dispatch is
+    # ~6 row gathers, so bigger batches keep amortizing the tunnel
+    # round trip (budget gating skips the tail on a short window)
+    run_bench(
+        batches=(8_192, 32_768, 131_072, 262_144),
+        world_kw={},
+        budget_s=TPU_CHILD_TIMEOUT_S,
+        note=note,
+    )
 
 
 HEADLINE_METRIC = "rbac_2hop_bulk_check_throughput"

@@ -202,4 +202,6 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    from benchmarks.common import bench_main
+
+    bench_main(main)

@@ -255,4 +255,6 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    from benchmarks.common import bench_main
+
+    bench_main(main)

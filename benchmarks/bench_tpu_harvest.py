@@ -85,6 +85,8 @@ def _blocked_rate(fn, args, B, reps=10):
 def do_trace(trace_dir: str) -> None:
     import jax
 
+    from gochugaru_tpu.utils import trace as _trace
+
     engine, dsnap, snap, users, repos, slot = _world()
     note(f"trace: world prepared, backend={jax.default_backend()}")
     B = 32_768
@@ -95,17 +97,38 @@ def do_trace(trace_dir: str) -> None:
     lp = engine.latency_path(dsnap)
     q_res, q_perm, q_subj = _queries(users, repos, slot, 1024, seed=9)
     lp.dispatch_columns(q_res, q_perm, q_subj)  # pin outside the trace
-    with jax.profiler.trace(trace_dir):
+    # request attribution: a 100%-sampled tracer + an active profiler
+    # session (GOCHUGARU_TRACE_DIR) make every latency dispatch inside
+    # the window carry a jax.profiler.TraceAnnotation named by its trace
+    # id, and the matching request spans dump as JSONL next to the
+    # profiler capture — the TensorBoard timeline and the request view
+    # join on `gochugaru:<trace_id>`
+    tracer = _trace.configure(sample_rate=1.0, slow_threshold_s=None)
+    spans = []
+    with _trace.profiler_session(trace_dir), jax.profiler.trace(trace_dir):
         for _ in range(10):
             out = fn(*args)
         jax.block_until_ready(out)
         for i in range(10):
-            lp.dispatch_columns(np.roll(q_res, i), q_perm, q_subj)
+            sp = _trace.root_span("harvest.latency_dispatch", batch=1024, i=i)
+            try:
+                lp.dispatch_columns(
+                    np.roll(q_res, i), q_perm, q_subj, span=sp
+                )
+            finally:
+                sp.end()
+                spans.append(sp.trace_id)
+    jsonl_path = _os.path.join(trace_dir, "request_traces.jsonl")
+    tracer.dump_jsonl(jsonl_path)
+    _trace.disable()
     print(json.dumps({
         "metric": "tpu_profile_trace", "value": 1.0, "unit": "capture",
         "vs_baseline": 0.0, "trace_dir": trace_dir,
         "platform": jax.default_backend(),
-        "contents": "10x B=32768 aligned dispatches + 10x B=1024 latency-mode",
+        "request_traces": jsonl_path,
+        "annotated_dispatches": len(spans),
+        "contents": "10x B=32768 aligned dispatches + 10x B=1024 latency-mode"
+                    " (request-annotated)",
     }), flush=True)
 
 

@@ -246,24 +246,48 @@ def small_batch_latency(
     engine, dsnap, q_res, q_perm, q_subj, *,
     q_ctx=None, qctx_rows=None, now_us=None,
     warmup: int = 30, reps: int = 600,
+    interleave_tracer=None,
 ) -> dict:
     """Warm latency-mode p50/p99 + mean per-stage budget for one small
     batch (engine/latency.py).  Every rep is a full dispatch — host
     lowering, H2D, pinned kernel, D2H — individually timed; the subject
     column rotates per rep so a platform cannot cache the answer.
-    Returns a dict ready to splat into ``emit`` extra fields."""
+    Returns a dict ready to splat into ``emit`` extra fields.
+
+    Each rep roots a request-scoped trace span (utils/trace.py) exactly
+    the way ``client.check`` does: with tracing disabled that is one
+    branch returning the NOOP singleton, and with a tracer installed
+    the rep pays full per-request span bookkeeping — so this helper is
+    the honest subject for the tracing-overhead budget assertion
+    (tests/test_trace_overhead.py).
+
+    ``interleave_tracer`` (a ``trace.Tracer``) alternates that tracer
+    in/out PER REP — adjacent reps see near-identical host conditions,
+    so the off/on quantile differences measure tracing cost with the
+    scheduler noise paired away (window-level A/B on a shared box
+    drowns a <5% effect in drift).  Adds ``p50_ms_off``/``p50_ms_on``/
+    ``p90_ms_off``/``p90_ms_on``/``p99_ms_off``/``p99_ms_on`` and
+    ``delta_p50_ms``/``delta_p90_ms`` to the result; the headline
+    quantiles then cover the mixed stream."""
     import jax  # noqa: F401  (ensures backend selection happened)
+
+    from gochugaru_tpu.utils import trace as _trace
 
     lp = engine.latency_path(dsnap)
     B = q_res.shape[0]
 
     def once(i: int):
-        out = lp.dispatch_columns(
-            np.roll(q_res, i), q_perm, np.roll(q_subj, 2 * i),
-            q_ctx=q_ctx, qctx_rows=qctx_rows, now_us=now_us,
-        )
-        assert out is not None, "latency path unavailable for this world"
-        return out
+        sp = _trace.root_span("check", batch=B)
+        try:
+            out = lp.dispatch_columns(
+                np.roll(q_res, i), q_perm, np.roll(q_subj, 2 * i),
+                q_ctx=q_ctx, qctx_rows=qctx_rows, now_us=now_us,
+                span=sp,
+            )
+            assert out is not None, "latency path unavailable for this world"
+            return out
+        finally:
+            sp.end()
 
     for i in range(warmup):
         once(i)
@@ -277,22 +301,32 @@ def small_batch_latency(
     gc.freeze()
     compiles_before = lp.compile_count
     ts = []
+    by_mode = ([], [])  # interleave_tracer: (off reps, on reps)
+    prev_tracer = _trace.get()
     stages = {"host_lower_s": 0.0, "h2d_s": 0.0, "kernel_s": 0.0, "d2h_s": 0.0}
     try:
         for i in range(reps):
+            mode = i & 1
+            if interleave_tracer is not None:
+                _trace.install(interleave_tracer if mode else None)
             t0 = time.perf_counter()
             once(i)
-            ts.append((time.perf_counter() - t0) * 1000)
+            dt = (time.perf_counter() - t0) * 1000
+            ts.append(dt)
+            if interleave_tracer is not None:
+                by_mode[mode].append(dt)
             b = lp.last_budget
             for k in stages:
                 stages[k] += getattr(b, k)
     finally:
+        if interleave_tracer is not None:
+            _trace.install(prev_tracer)
         gc.unfreeze()
     assert lp.compile_count == compiles_before, (
         "latency path recompiled during the warm measurement window"
     )
     a = np.asarray(ts)
-    return {
+    out = {
         "p50_ms": round(float(np.percentile(a, 50)), 3),
         "p99_ms": round(float(np.percentile(a, 99)), 3),
         "mean_ms": round(float(a.mean()), 3),
@@ -304,6 +338,14 @@ def small_batch_latency(
         "tier": int(lp.last_budget.tier),
         "n": int(reps),
     }
+    if interleave_tracer is not None:
+        off, on = np.asarray(by_mode[0]), np.asarray(by_mode[1])
+        for q in (50, 90, 99):
+            out[f"p{q}_ms_off"] = round(float(np.percentile(off, q)), 3)
+            out[f"p{q}_ms_on"] = round(float(np.percentile(on, q)), 3)
+        out["delta_p50_ms"] = round(out["p50_ms_on"] - out["p50_ms_off"], 3)
+        out["delta_p90_ms"] = round(out["p90_ms_on"] - out["p90_ms_off"], 3)
+    return out
 
 
 def emit_small_batch_row(
@@ -354,6 +396,40 @@ def peak_rss_mb() -> float:
     from gochugaru_tpu.utils.metrics import peak_rss_mb as _impl
 
     return _impl()
+
+
+def maybe_emit_metrics_snapshot() -> None:
+    """Gated by GOCHUGARU_BENCH_METRICS=1 (run_all.py --metrics sets
+    it): append one ``metrics_snapshot`` JSON line carrying the child's
+    final ``metrics.default.snapshot()`` — so a bench regression row
+    arrives WITH the counters that explain it (shed/retry/fallback/
+    breaker activity, stage p99s), not just the headline number.
+    Call as the last line of every bench main()."""
+    import os
+
+    if os.environ.get("GOCHUGARU_BENCH_METRICS") != "1":
+        return
+    from gochugaru_tpu.utils import metrics as _metrics
+
+    snap = _metrics.default.snapshot()
+    emit(
+        "metrics_snapshot", len(snap), "keys", 0.0,
+        snapshot={k: round(float(v), 9) for k, v in sorted(snap.items())},
+    )
+
+
+def bench_main(main) -> None:
+    """Standard bench ``__main__`` tail: run ``main()`` and ALWAYS append
+    the --metrics snapshot — a bench that dies mid-run would otherwise
+    lose exactly the counter dump that explains the failure.  Exits with
+    main's return code when it returns one (bench2's degraded-mesh rc)."""
+    rc = None
+    try:
+        rc = main()
+    finally:
+        maybe_emit_metrics_snapshot()
+    if isinstance(rc, int):
+        raise SystemExit(rc)
 
 
 def maybe_force_cpu() -> str:

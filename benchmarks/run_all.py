@@ -6,9 +6,14 @@ decides the platform once: if the default (TPU) backend is unusable,
 children run with GOCHUGARU_FORCE_CPU=1 and the report says so per row.
 
 Usage:  python benchmarks/run_all.py [--out BENCHMARKS.md] [--quick]
+                                     [--metrics]
 
 ``--quick`` shrinks configs 3/4/5 (CI-sized smoke run); the committed
-BENCHMARKS.md should come from a full run.
+BENCHMARKS.md should come from a full run.  ``--metrics`` asks every
+bench child to append its final ``metrics.snapshot()`` blob
+(GOCHUGARU_BENCH_METRICS=1 → common.maybe_emit_metrics_snapshot), which
+lands in a "Metrics snapshots" appendix — a regression row then ships
+WITH the counters that explain it.
 """
 
 import argparse
@@ -83,10 +88,14 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(ROOT, "BENCHMARKS.md"))
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--metrics", action="store_true",
+                    help="children append a final metrics.snapshot() blob")
     args = ap.parse_args()
 
     backend = probe_backend()
     env = dict(os.environ)
+    if args.metrics:
+        env["GOCHUGARU_BENCH_METRICS"] = "1"
     # children (bench.py among them) reuse this verdict instead of
     # re-paying their own probe subprocess per stage
     env["GOCHUGARU_BACKEND_PROBED"] = backend
@@ -155,6 +164,7 @@ def main() -> int:
 
     rows = []
     all_notes = []
+    snapshots = []  # (config name, metrics.snapshot() dict) from --metrics
     for name, cmd, timeout_s in configs:
         lines, notes, reason = run_config(name, cmd, timeout_s, env)
         all_notes.append((name, notes))
@@ -162,6 +172,10 @@ def main() -> int:
             rows.append((name, "—", "failed", "—", "—", "—", "—", reason or "no output"))
             continue
         for parsed in lines:
+            if parsed.get("metric") == "metrics_snapshot":
+                # child's final counter dump: appendix, not a table row
+                snapshots.append((name, parsed.get("snapshot") or {}))
+                continue
             vs = parsed.get("vs_baseline")
             rows.append((
                 name,
@@ -198,6 +212,17 @@ def main() -> int:
             for n in notes:
                 f.write(f"- {n}\n")
             f.write("\n")
+        if snapshots:
+            f.write("## Metrics snapshots (--metrics)\n\n")
+            f.write(
+                "Each bench child's final `metrics.snapshot()` — the"
+                " counters/gauges/timer percentiles behind the rows"
+                " above.\n\n"
+            )
+            for name, snap in snapshots:
+                f.write(f"### {name}\n\n```json\n")
+                f.write(json.dumps(snap, indent=1, sort_keys=True))
+                f.write("\n```\n\n")
     print(f"wrote {args.out}", file=sys.stderr)
     return 0
 

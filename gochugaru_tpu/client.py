@@ -53,6 +53,7 @@ from .store.snapshot import Snapshot
 from .store.store import Store, parse_revision
 from .utils import faults
 from .utils import metrics as _metrics
+from .utils import trace as _trace
 from .utils.admission import AdmissionConfig, AdmissionController
 from .utils.context import Context
 from .utils.errors import (
@@ -93,6 +94,10 @@ class _Options:
         self.latency_mode = False
         self.admission: Optional[AdmissionConfig] = None
         self.mesh = None  # jax.sharding.Mesh → sharded engine
+        self.telemetry_port: Optional[int] = None
+        self.telemetry_host = "127.0.0.1"
+        self.trace_sample_rate: Optional[float] = None
+        self.trace_slow_ms: Optional[float] = 100.0
 
 
 Option = Callable[[_Options], None]
@@ -182,6 +187,36 @@ def with_admission_control(config: AdmissionConfig) -> Option:
     return opt
 
 
+def with_telemetry(
+    port: int = 0,
+    *,
+    host: str = "127.0.0.1",
+    trace_sample_rate: Optional[float] = None,
+    trace_slow_ms: Optional[float] = 100.0,
+) -> Option:
+    """Serve live telemetry from this client's process: a stdlib HTTP
+    daemon thread (utils/telemetry.py) with ``/metrics`` (Prometheus
+    text — counters, gauges, and every timer ring as p50/p90/p99/p999
+    quantiles), ``/traces`` (JSONL dump of sampled request traces), and
+    ``/healthz``.  ``port=0`` picks an ephemeral port; read it back from
+    ``client.telemetry.port``.
+
+    ``trace_sample_rate`` additionally installs the process-global
+    request tracer (utils/trace.py) at that head-sampling rate with a
+    ``trace_slow_ms`` keep-slow tail rule (None disables the tail
+    rule).  Left at None, whatever tracer the process already has (or
+    none) stays in force — telemetry export and trace capture compose
+    but don't require each other."""
+
+    def opt(o: _Options) -> None:
+        o.telemetry_port = port
+        o.telemetry_host = host
+        o.trace_sample_rate = trace_sample_rate
+        o.trace_slow_ms = trace_slow_ms
+
+    return opt
+
+
 def with_profiling(trace_dir: str) -> Option:
     """Capture a ``jax.profiler`` trace around every check dispatch into
     ``trace_dir`` and publish a ``checks.device_time_s`` timer — the deep
@@ -220,6 +255,23 @@ class Client:
         #: dispatch admission: bounded in-flight gate + deadline budget +
         #: latency-path circuit breaker (utils/admission.py)
         self._admission = AdmissionController(o.admission)
+        #: telemetry endpoint (utils/telemetry.py), via with_telemetry()
+        self.telemetry = None
+        if o.telemetry_port is not None:
+            if o.trace_sample_rate is not None:
+                _trace.configure(
+                    sample_rate=o.trace_sample_rate,
+                    slow_threshold_s=(
+                        None if o.trace_slow_ms is None
+                        else o.trace_slow_ms / 1000.0
+                    ),
+                )
+            from .utils.telemetry import TelemetryServer
+
+            self.telemetry = TelemetryServer(
+                port=o.telemetry_port, host=o.telemetry_host,
+                registry=self._metrics,
+            )
 
     # -- store access (shared by watch etc.) -----------------------------
     @property
@@ -359,109 +411,151 @@ class Client:
         if not rels:
             return []
         self._metrics.inc("checks.requested", len(rels))
+        # request-scoped tracing (utils/trace.py): head-sampled root
+        # span riding the context chain.  The unsampled/disabled path is
+        # the NOOP singleton — same context object back, no span
+        # allocation anywhere below (tests assert the identity)
+        root = _trace.root_span("check", batch=len(rels))
+        ctx = _trace.ctx_with_span(ctx, root)
 
         def dispatch() -> List[bool]:
             import time as _time
 
             adm = self._admission
+            sp = _trace.span_of(ctx)
             # deadline budget: a dispatch that cannot finish inside the
             # context deadline sheds BEFORE any snapshot/device work
-            adm.check_deadline(ctx)
+            adm.check_deadline(ctx, span=sp)
             t_disp = _time.perf_counter()
-            with adm.gate.admit():
-                out = self._dispatch_admitted(ctx, cs, rels)
+            with adm.gate.admit(span=sp):
+                out = self._dispatch_admitted(ctx, cs, rels, span=sp)
             adm.observe_cost(_time.perf_counter() - t_disp)
             return out
 
-        return retry_retriable_errors(ctx, dispatch)
+        if root is _trace.NOOP:
+            # keep-slow tail rule: even unsampled requests leave a
+            # root-only trace behind when they blow the slow threshold
+            t0 = _trace.tail_clock()
+            try:
+                return retry_retriable_errors(ctx, dispatch)
+            finally:
+                _trace.maybe_keep_slow("check", t0, batch=len(rels))
+        # Span.__exit__ records the exception type as the `error` attr
+        with root:  # activates the thread-local current span + ends it
+            return retry_retriable_errors(ctx, dispatch)
 
     def _dispatch_admitted(
-        self, ctx: Context, cs: Strategy, rels: List[Relationship]
+        self,
+        ctx: Context,
+        cs: Strategy,
+        rels: List[Relationship],
+        span=_trace.NOOP,
     ) -> List[bool]:
         """One admitted check dispatch (inside the gate, one retry
         attempt): snapshot selection, device dispatch with classified
-        failures feeding the circuit breaker, host-oracle resolution."""
+        failures feeding the circuit breaker, host-oracle resolution.
+        A sampled ``span`` grows a ``dispatch`` child per attempt whose
+        subtree covers snapshot selection, the device/latency stage
+        spans, and host-oracle fallbacks; ``with dsp`` also activates
+        the thread-local current span so deep write-path work reached
+        from here (incremental closure advance during a delta prepare)
+        attaches its events to this request."""
         adm = self._admission
-        snap = self._store.snapshot_for(cs)
-        engine = self._engine_for(snap)
-        with self._metrics.timer("checks.dispatch"):
-            if engine is None:
-                self._metrics.inc("checks.oracle", len(rels))
-                oracle = self._oracle_for(snap)
-                return [oracle.check_relationship(r) == T for r in rels]
-            dsnap = self._dsnap_for(engine, snap)
-            if self._profile_dir is not None:
-                import jax
+        dsp = span.child("dispatch")
+        with dsp:
+            snap = self._store.snapshot_for(cs)
+            dsp.set_attr("revision", int(snap.revision))
+            engine = self._engine_for(snap)
+            with self._metrics.timer("checks.dispatch"):
+                if engine is None:
+                    self._metrics.inc("checks.oracle", len(rels))
+                    with dsp.child("oracle.check", items=len(rels)):
+                        oracle = self._oracle_for(snap)
+                        return [
+                            oracle.check_relationship(r) == T for r in rels
+                        ]
+                dsnap = self._dsnap_for(engine, snap)
+                dsp.event("snapshot.prepared")
+                if self._profile_dir is not None:
+                    import jax
 
-                self._profile_lock.acquire()
-                prof = jax.profiler.trace(self._profile_dir)
-                unlock = self._profile_lock.release
-            else:
-                prof = contextlib.nullcontext()
-                unlock = lambda: None
-            # circuit breaker: after consecutive transient dispatch
-            # failures, latency-mode traffic reroutes onto the batch
-            # path until the breaker half-opens a probe
-            use_latency = self._latency_mode and adm.breaker.allow_latency()
-            if self._latency_mode and not use_latency:
-                self._metrics.inc("breaker.latency_rerouted")
-            # a latency-mode call may silently fall back to the batch path
-            # (batch beyond the top tier, no flat tables, ...): the probe
-            # flag fed to the breaker must reflect whether the latency
-            # path actually SERVED, so read its dispatch counter around
-            # the call (per-snapshot counter; a concurrent same-snapshot
-            # dispatch can inflate it, which at worst closes the breaker
-            # on that other dispatch's success — still a latency success)
-            lp = dsnap.latency_path if use_latency else None
-            lp_n = lp.dispatch_count if lp is not None else 0
-            try:
-                with prof, self._metrics.timer("checks.device_time_s"):
-                    d, p, ovf = engine.check_batch(
-                        dsnap, rels, latency=use_latency
-                    )
-            except Exception as e:  # classify device dispatch failures
-                classified = classify_dispatch_exception(e)
-                if isinstance(classified, UnavailableError):
-                    adm.breaker.record_failure()
-                    if classified is e:
-                        raise
-                    raise classified
-                raise
-            else:
-                lp2 = dsnap.latency_path
-                served_latency = (
-                    use_latency
-                    and lp2 is not None
-                    and lp2.dispatch_count > lp_n
-                )
-                adm.breaker.record_success(probe=served_latency)
-            finally:
-                unlock()
-            needs_host = (p & ~d) | ovf
-            if not needs_host.any():
-                self._metrics.inc("checks.device_definite", len(rels))
-                return [bool(x) for x in d]
-            oracle = self._oracle_for(snap)
-            out = []
-            for i, r in enumerate(rels):
-                if needs_host[i]:
-                    self._metrics.inc(
-                        "checks.fallback_overflow"
-                        if ovf[i]
-                        else "checks.fallback_conditional"
-                    )
-                    try:
-                        out.append(oracle.check_relationship(r) == T)
-                    except Exception as e:
-                        # per-item error: abort with partial results,
-                        # mirroring the reference's bulk mapping loop
-                        # (client/client.go:279-283).  Not retriable —
-                        # the reference retries the RPC, not the
-                        # per-item mapping
-                        raise BulkCheckItemError(i, out, e) from e
+                    self._profile_lock.acquire()
+                    prof = jax.profiler.trace(self._profile_dir)
+                    unlock = self._profile_lock.release
                 else:
-                    out.append(bool(d[i]))
-            return out
+                    prof = contextlib.nullcontext()
+                    unlock = lambda: None
+                # circuit breaker: after consecutive transient dispatch
+                # failures, latency-mode traffic reroutes onto the batch
+                # path until the breaker half-opens a probe
+                use_latency = self._latency_mode and adm.breaker.allow_latency()
+                if self._latency_mode and not use_latency:
+                    self._metrics.inc("breaker.latency_rerouted")
+                    dsp.event("breaker.latency_rerouted")
+                # a latency-mode call may silently fall back to the batch path
+                # (batch beyond the top tier, no flat tables, ...): the probe
+                # flag fed to the breaker must reflect whether the latency
+                # path actually SERVED, so read its dispatch counter around
+                # the call (per-snapshot counter; a concurrent same-snapshot
+                # dispatch can inflate it, which at worst closes the breaker
+                # on that other dispatch's success — still a latency success)
+                lp = dsnap.latency_path if use_latency else None
+                lp_n = lp.dispatch_count if lp is not None else 0
+                try:
+                    with prof, self._metrics.timer("checks.device_time_s"):
+                        d, p, ovf = engine.check_batch(
+                            dsnap, rels, latency=use_latency, span=dsp
+                        )
+                except Exception as e:  # classify device dispatch failures
+                    classified = classify_dispatch_exception(e)
+                    if isinstance(classified, UnavailableError):
+                        adm.breaker.record_failure()
+                        if classified is e:
+                            raise
+                        raise classified
+                    raise
+                else:
+                    lp2 = dsnap.latency_path
+                    served_latency = (
+                        use_latency
+                        and lp2 is not None
+                        and lp2.dispatch_count > lp_n
+                    )
+                    adm.breaker.record_success(probe=served_latency)
+                finally:
+                    unlock()
+                needs_host = (p & ~d) | ovf
+                if not needs_host.any():
+                    self._metrics.inc("checks.device_definite", len(rels))
+                    return [bool(x) for x in d]
+                osp = dsp.child(
+                    "oracle.fallback", items=int(needs_host.sum()),
+                    overflow=int(ovf.sum()),
+                )
+                try:
+                    oracle = self._oracle_for(snap)
+                    out = []
+                    for i, r in enumerate(rels):
+                        if needs_host[i]:
+                            self._metrics.inc(
+                                "checks.fallback_overflow"
+                                if ovf[i]
+                                else "checks.fallback_conditional"
+                            )
+                            try:
+                                out.append(oracle.check_relationship(r) == T)
+                            except Exception as e:
+                                # per-item error: abort with partial results,
+                                # mirroring the reference's bulk mapping loop
+                                # (client/client.go:279-283).  Not retriable —
+                                # the reference retries the RPC, not the
+                                # per-item mapping
+                                raise BulkCheckItemError(i, out, e) from e
+                        else:
+                            out.append(bool(d[i]))
+                    return out
+                finally:
+                    osp.end()
 
     # ------------------------------------------------------------------
     # Reads (client/client.go:286-315)
@@ -564,10 +658,17 @@ class Client:
         stop = threading.Event()
 
         def gen() -> Iterator[Update]:
+            # one sampled span per subscription (not per update): resumes
+            # are events, delivery volume is an attribute at close —
+            # bounded trace weight however long the stream lives.  Started
+            # lazily on first iteration so a subscription that is never
+            # consumed records no span (gen()'s finally is its only end)
+            wsp = _trace.root_span("watch", since=int(since))
             base = since  # every revision ≤ base fully delivered
             part_rev: Optional[int] = None  # revision partially delivered
             part_n = 0  # raw updates of part_rev already delivered
             no_progress = 0
+            delivered = 0
             try:
                 while True:
                     if ctx.done():
@@ -594,10 +695,18 @@ class Client:
                             part_n += 1
                             no_progress = 0
                             if f.admits(u):
+                                delivered += 1
                                 yield u
                         return  # stream ended: stop set or ctx cancelled
                     except UnavailableError:
                         self._metrics.inc("watch.resumes")
+                        wsp.event(
+                            "watch.resume",
+                            error="UnavailableError",
+                            no_progress=no_progress + 1,
+                            cursor_rev=int(base),
+                            cursor_offset=part_n,
+                        )
                         no_progress += 1
                         if no_progress > self.WATCH_MAX_RESUMES:
                             raise
@@ -606,6 +715,8 @@ class Client:
                         ctx.wait(min(0.002 * no_progress, 0.05))
             finally:
                 stop.set()
+                wsp.set_attr("delivered", delivered)
+                wsp.end()
 
         return gen()
 

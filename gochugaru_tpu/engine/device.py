@@ -52,6 +52,7 @@ from ..store.snapshot import Snapshot
 import time as _time
 
 from ..utils import faults, metrics
+from ..utils import trace as _trace
 from ..utils.context import background as _background
 from ..utils.errors import classify_dispatch_exception
 from ..utils.retry import retry_retriable_errors
@@ -1085,11 +1086,14 @@ class DeviceEngine:
         given, else by ``LATENCY_RETRY_TRIES`` so a deadline-less bench
         caller cannot hang on a persistent fault."""
 
+        span = _trace.span_of(ctx) if ctx is not None else _trace.NOOP
+
         def dispatch():
             try:
                 out = self.latency_path(dsnap).dispatch_columns(
                     q_res, q_perm, q_subj, q_srel=q_srel, q_wc=q_wc,
                     q_ctx=q_ctx, qctx_rows=qctx_rows, now_us=now_us,
+                    span=span,
                 )
                 if out is not None:
                     return out
@@ -1199,6 +1203,7 @@ class DeviceEngine:
         *,
         now_us: Optional[int] = None,
         latency: bool = False,
+        span=_trace.NOOP,
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Returns (definite, possible, overflow) bool arrays of len(rels).
 
@@ -1210,7 +1215,10 @@ class DeviceEngine:
         With ``latency``, small batches route through the latency-mode
         path (engine/latency.py: pinned kernel at a fixed tier, staged
         budget metrics); batches it cannot serve fall through to the
-        ordinary dispatch below, same contract."""
+        ordinary dispatch below, same contract.  ``span`` is the
+        request's trace span (utils/trace.py): sampled dispatches record
+        a ``device.check_batch`` child with lower/kernel/fetch stage
+        boundaries as events; the NOOP span costs one branch."""
         if not rels:
             z = np.zeros(0, bool)
             return z, z, z
@@ -1218,79 +1226,94 @@ class DeviceEngine:
         import time as _time
 
         t_lower = _time.perf_counter()
-        snap = dsnap.snapshot
-        queries, uniq, qctx = self._lower_queries(snap, rels, dsnap.strings)
-        B = len(rels)
-        if latency:
-            out = self.latency_path(dsnap).dispatch(
-                queries, qctx, B, snap.now_rel32(now_us), t_start=t_lower
-            )
+        dsp = span.child("device.check_batch", t=t_lower, batch=len(rels))
+        try:
+            snap = dsnap.snapshot
+            queries, uniq, qctx = self._lower_queries(snap, rels, dsnap.strings)
+            dsp.event("stage.lower")
+            B = len(rels)
+            if latency:
+                out = self.latency_path(dsnap).dispatch(
+                    queries, qctx, B, snap.now_rel32(now_us),
+                    t_start=t_lower, span=dsp,
+                )
+                if out is not None:
+                    return out
+            now_flat = jnp.int32(snap.now_rel32(now_us))
+            PB = self._pipeline_batch()
+            if PB and B > PB and dsnap.flat_meta is not None:
+                # sub-batch pipeline: dispatch every chunk before fetching
+                # any (the async queue overlaps lowering with compute); one
+                # shared compiled program per PB bucket
+                subs = []
+                with _trace.annotate_dispatch(span):
+                    for lo in range(0, B, PB):
+                        sub = {k: v[lo:lo + PB] for k, v in queries.items()}
+                        o = self._flat_call(
+                            dsnap, sub, qctx, now_flat, min(PB, B - lo),
+                            bucket_min=PB,
+                        )
+                        if o is None:
+                            subs = None
+                            break
+                        subs.append((min(PB, B - lo), o))
+                if subs is not None:
+                    dsp.event("stage.dispatch", pipelined=len(subs))
+                    ds, ps, os_ = [], [], []
+                    for n, o in subs:
+                        d, p, ovf = jax.device_get(o)
+                        ds.append(d[:n]); ps.append(p[:n]); os_.append(ovf[:n])
+                    dsp.event("stage.fetch")
+                    return (
+                        np.concatenate(ds), np.concatenate(ps),
+                        np.concatenate(os_),
+                    )
+            with _trace.annotate_dispatch(span):
+                out = self._flat_call(dsnap, queries, qctx, now_flat, B)
             if out is not None:
-                return out
-        now_flat = jnp.int32(snap.now_rel32(now_us))
-        PB = self._pipeline_batch()
-        if PB and B > PB and dsnap.flat_meta is not None:
-            # sub-batch pipeline: dispatch every chunk before fetching
-            # any (the async queue overlaps lowering with compute); one
-            # shared compiled program per PB bucket
-            subs = []
-            for lo in range(0, B, PB):
-                sub = {k: v[lo:lo + PB] for k, v in queries.items()}
-                o = self._flat_call(
-                    dsnap, sub, qctx, now_flat, min(PB, B - lo),
-                    bucket_min=PB,
+                dsp.event("stage.dispatch")
+                d, p, ovf = jax.device_get(out)
+                dsp.event("stage.fetch")
+                return d[:B], p[:B], ovf[:B]
+            BP = _ceil_pow2(B, self.config.batch_bucket_min)
+            U = uniq.shape[0]
+            UP = _ceil_pow2(U, self.config.batch_bucket_min)
+
+            def padq(a, fill):
+                out = np.full(BP, fill, a.dtype)
+                out[:B] = a
+                return jnp.asarray(out)
+
+            u_subj = np.full(UP, -1, np.int32)
+            u_srel = np.full(UP, -1, np.int32)
+            u_wc = np.full(UP, -1, np.int32)
+            u_qctx = np.full(UP, -1, np.int32)
+            u_subj[:U] = uniq[:, 0]
+            u_srel[:U] = uniq[:, 1]
+            u_wc[:U] = uniq[:, 2]
+            u_qctx[:U] = uniq[:, 3]
+
+            now = jnp.int32(snap.now_rel32(now_us))
+            with _trace.annotate_dispatch(span):
+                d, p, ovf = self._fn(
+                    dsnap.arrays, dsnap.tid_map, now,
+                    jnp.asarray(u_subj), jnp.asarray(u_srel), jnp.asarray(u_wc),
+                    jnp.asarray(u_qctx),
+                    padq(queries["q_res"], -1), padq(queries["q_perm"], -1),
+                    padq(queries["q_subj"], -1), padq(queries["q_srel"], -1),
+                    padq(queries["q_wc"], -1), padq(queries["q_row"], 0),
+                    padq(queries["q_self"], False), padq(queries["q_ctx"], -1),
+                    self._qctx_device(qctx),
                 )
-                if o is None:
-                    subs = None
-                    break
-                subs.append((min(PB, B - lo), o))
-            if subs is not None:
-                ds, ps, os_ = [], [], []
-                for n, o in subs:
-                    d, p, ovf = jax.device_get(o)
-                    ds.append(d[:n]); ps.append(p[:n]); os_.append(ovf[:n])
-                return (
-                    np.concatenate(ds), np.concatenate(ps),
-                    np.concatenate(os_),
-                )
-        out = self._flat_call(dsnap, queries, qctx, now_flat, B)
-        if out is not None:
-            d, p, ovf = jax.device_get(out)
+            dsp.event("stage.dispatch", legacy=True)
+            # one device→host fetch for all three planes: separate np.asarray
+            # calls round-trip the dispatch boundary once each, which dominates
+            # small-batch latency on remote-attached TPUs
+            d, p, ovf = jax.device_get((d, p, ovf))
+            dsp.event("stage.fetch")
             return d[:B], p[:B], ovf[:B]
-        BP = _ceil_pow2(B, self.config.batch_bucket_min)
-        U = uniq.shape[0]
-        UP = _ceil_pow2(U, self.config.batch_bucket_min)
-
-        def padq(a, fill):
-            out = np.full(BP, fill, a.dtype)
-            out[:B] = a
-            return jnp.asarray(out)
-
-        u_subj = np.full(UP, -1, np.int32)
-        u_srel = np.full(UP, -1, np.int32)
-        u_wc = np.full(UP, -1, np.int32)
-        u_qctx = np.full(UP, -1, np.int32)
-        u_subj[:U] = uniq[:, 0]
-        u_srel[:U] = uniq[:, 1]
-        u_wc[:U] = uniq[:, 2]
-        u_qctx[:U] = uniq[:, 3]
-
-        now = jnp.int32(snap.now_rel32(now_us))
-        d, p, ovf = self._fn(
-            dsnap.arrays, dsnap.tid_map, now,
-            jnp.asarray(u_subj), jnp.asarray(u_srel), jnp.asarray(u_wc),
-            jnp.asarray(u_qctx),
-            padq(queries["q_res"], -1), padq(queries["q_perm"], -1),
-            padq(queries["q_subj"], -1), padq(queries["q_srel"], -1),
-            padq(queries["q_wc"], -1), padq(queries["q_row"], 0),
-            padq(queries["q_self"], False), padq(queries["q_ctx"], -1),
-            self._qctx_device(qctx),
-        )
-        # one device→host fetch for all three planes: separate np.asarray
-        # calls round-trip the dispatch boundary once each, which dominates
-        # small-batch latency on remote-attached TPUs
-        d, p, ovf = jax.device_get((d, p, ovf))
-        return d[:B], p[:B], ovf[:B]
+        finally:
+            dsp.end()
 
     # -- columnar bulk check ---------------------------------------------
     def _columns_preamble(

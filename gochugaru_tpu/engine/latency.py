@@ -56,6 +56,7 @@ import numpy as np
 
 from ..utils import faults
 from ..utils import metrics as _metrics
+from ..utils import trace as _trace
 from .flat import QM_ROWS, fill_qm
 
 
@@ -236,13 +237,19 @@ class LatencyPath:
         B: int,
         now,
         t_start: Optional[float] = None,
+        span=_trace.NOOP,
     ):
         """One warm small-batch dispatch from already-lowered query
         columns.  ``now`` is the snapshot-relative int32 clock
         (snap.now_rel32).  ``t_start`` backdates the host-lowering stage
         to when the caller began lowering (so the budget charges query
-        interning/packing honestly).  Returns trimmed (d, p, ovf) numpy
-        arrays, or None when this path cannot serve the batch."""
+        interning/packing honestly).  ``span`` is the request's trace
+        span (utils/trace.py): a sampled dispatch records stage child
+        spans rebuilt from the SAME perf_counter stamps the budget uses,
+        so span durations and the ``latency.*`` stage timers agree
+        exactly; the NOOP span allocates nothing.  Returns trimmed
+        (d, p, ovf) numpy arrays, or None when this path cannot serve
+        the batch."""
         import jax
 
         t0 = t_start if t_start is not None else time.perf_counter()
@@ -304,8 +311,12 @@ class LatencyPath:
         # ---- stage 3: pinned kernel (blocked) --------------------------
         args = (self.dsnap.arrays, self.dsnap.tid_map, now_dev, qm_dev, qctx_dev)
         fn, fresh = self._pinned_for(slots, tier, qctx_key, args)
-        out = fn(*args)
-        jax.block_until_ready(out)
+        # profiler correlation: inside a GOCHUGARU_TRACE_DIR session the
+        # kernel window is annotated with the request's trace id, so the
+        # harvested device trace attributes back to this dispatch
+        with _trace.annotate_dispatch(span):
+            out = fn(*args)
+            jax.block_until_ready(out)
         t3 = time.perf_counter()
 
         # ---- stage 4: D2H readback -------------------------------------
@@ -332,6 +343,18 @@ class LatencyPath:
             m.observe("latency.kernel_s", budget.kernel_s)
             m.observe("latency.d2h_s", budget.d2h_s)
             m.observe("latency.dispatch_s", budget.total_s)
+        if span.sampled:
+            # stage spans from the SAME t0..t4 stamps the budget (and so
+            # the latency.* timers) subtracted — durations agree exactly
+            lsp = span.child(
+                "latency.dispatch", t=t0,
+                batch=B, tier=tier, compiled=fresh,
+            )
+            lsp.child_at("stage.host_lower", t0).end(t=t1)
+            lsp.child_at("stage.h2d", t1).end(t=t2)
+            lsp.child_at("stage.kernel", t2).end(t=t3)
+            lsp.child_at("stage.d2h", t3).end(t=t4)
+            lsp.end(t=t4)
         return d[:B], p[:B], ovf[:B]
 
     def dispatch_columns(
@@ -345,6 +368,7 @@ class LatencyPath:
         q_ctx: Optional[np.ndarray] = None,
         qctx_rows=None,
         now_us: Optional[int] = None,
+        span=_trace.NOOP,
     ):
         """Latency-path bulk check from pre-interned int32 columns (the
         columnar mirror of the Relationship path; benches and tests call
@@ -354,4 +378,6 @@ class LatencyPath:
             self.dsnap, q_res, q_perm, q_subj, q_srel, q_wc, q_ctx, qctx_rows
         )
         now = self.dsnap.snapshot.now_rel32(now_us)
-        return self.dispatch(queries, qctx, q_res.shape[0], now, t_start=t0)
+        return self.dispatch(
+            queries, qctx, q_res.shape[0], now, t_start=t0, span=span
+        )

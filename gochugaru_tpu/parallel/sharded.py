@@ -54,6 +54,7 @@ from ..rel.relationship import Relationship
 from ..schema.compiler import CompiledSchema
 from ..store.snapshot import Snapshot
 from ..utils import faults
+from ..utils import trace as _trace
 from .mesh import DATA_AXIS, MODEL_AXIS
 
 
@@ -400,6 +401,7 @@ class ShardedEngine(DeviceEngine):
         now_us: Optional[int],
         fetch: bool = True,
         bucket_min: int = 0,
+        span=_trace.NOOP,
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Partition query columns across the data axis, compute per-shard
         unique (subject, context) closure rows, and dispatch the
@@ -407,70 +409,86 @@ class ShardedEngine(DeviceEngine):
         q_perm, q_subj, q_srel, q_wc, q_ctx, q_self); q_row is derived
         here per shard.  With ``fetch=False`` the raw padded sharded
         device outputs (length BP ≥ B) are returned for pipelined
-        dispatch, mirroring DeviceEngine.check_columns."""
+        dispatch, mirroring DeviceEngine.check_columns.  A sampled
+        ``span`` records a ``sharded.dispatch`` child (partition /
+        collective / fetch stage events)."""
         faults.fire("sharded.dispatch")
-        if dsnap.flat_meta is not None:
-            return self._dispatch_flat(
-                dsnap, queries, qctx, now_us, fetch, bucket_min=bucket_min
+        ssp = span.child(
+            "sharded.dispatch",
+            batch=int(queries["q_res"].shape[0]),
+            data=self.data_size, model=self.model_size,
+        )
+        try:
+            if dsnap.flat_meta is not None:
+                with _trace.annotate_dispatch(span):
+                    return self._dispatch_flat(
+                        dsnap, queries, qctx, now_us, fetch,
+                        bucket_min=bucket_min,
+                    )
+            snap = dsnap.snapshot
+            D = self.data_size
+            B = queries["q_res"].shape[0]
+            per = _ceil_pow2(-(-B // D), self.config.batch_bucket_min)
+            BP = per * D
+
+            q = {
+                k: np.full(BP, -1 if v.dtype != bool else 0, v.dtype)
+                for k, v in queries.items()
+                if k != "q_row"
+            }
+            for k in q:
+                q[k][:B] = queries[k]
+            # per-data-shard unique subjects (each shard computes closures only
+            # for its own slice of the batch)
+            subj_key = np.stack(
+                [q["q_subj"], q["q_srel"], q["q_wc"], q["q_ctx"]], axis=1
             )
-        snap = dsnap.snapshot
-        D = self.data_size
-        B = queries["q_res"].shape[0]
-        per = _ceil_pow2(-(-B // D), self.config.batch_bucket_min)
-        BP = per * D
+            ulists = []
+            rows = np.zeros(BP, np.int32)
+            for s in range(D):
+                blk = slice(s * per, (s + 1) * per)
+                uniq, inv = np.unique(subj_key[blk], axis=0, return_inverse=True)
+                ulists.append(uniq)
+                rows[blk] = inv.astype(np.int32)
+            UP = _ceil_pow2(max(u.shape[0] for u in ulists), self.config.batch_bucket_min)
+            u_subj = np.full(D * UP, -1, np.int32)
+            u_srel = np.full(D * UP, -1, np.int32)
+            u_wc = np.full(D * UP, -1, np.int32)
+            u_qctx = np.full(D * UP, -1, np.int32)
+            for s, uniq in enumerate(ulists):
+                n = uniq.shape[0]
+                u_subj[s * UP : s * UP + n] = uniq[:, 0]
+                u_srel[s * UP : s * UP + n] = uniq[:, 1]
+                u_wc[s * UP : s * UP + n] = uniq[:, 2]
+                u_qctx[s * UP : s * UP + n] = uniq[:, 3]
+            q["q_row"] = rows
+            ssp.event("stage.partition")
 
-        q = {
-            k: np.full(BP, -1 if v.dtype != bool else 0, v.dtype)
-            for k, v in queries.items()
-            if k != "q_row"
-        }
-        for k in q:
-            q[k][:B] = queries[k]
-        # per-data-shard unique subjects (each shard computes closures only
-        # for its own slice of the batch)
-        subj_key = np.stack(
-            [q["q_subj"], q["q_srel"], q["q_wc"], q["q_ctx"]], axis=1
-        )
-        ulists = []
-        rows = np.zeros(BP, np.int32)
-        for s in range(D):
-            blk = slice(s * per, (s + 1) * per)
-            uniq, inv = np.unique(subj_key[blk], axis=0, return_inverse=True)
-            ulists.append(uniq)
-            rows[blk] = inv.astype(np.int32)
-        UP = _ceil_pow2(max(u.shape[0] for u in ulists), self.config.batch_bucket_min)
-        u_subj = np.full(D * UP, -1, np.int32)
-        u_srel = np.full(D * UP, -1, np.int32)
-        u_wc = np.full(D * UP, -1, np.int32)
-        u_qctx = np.full(D * UP, -1, np.int32)
-        for s, uniq in enumerate(ulists):
-            n = uniq.shape[0]
-            u_subj[s * UP : s * UP + n] = uniq[:, 0]
-            u_srel[s * UP : s * UP + n] = uniq[:, 1]
-            u_wc[s * UP : s * UP + n] = uniq[:, 2]
-            u_qctx[s * UP : s * UP + n] = uniq[:, 3]
-        q["q_row"] = rows
+            faults.fire("sharded.collective")
+            now = jnp.int32(snap.now_rel32(now_us))
+            dsh = NamedSharding(self.mesh, P(DATA_AXIS))
+            rep = NamedSharding(self.mesh, P())
 
-        faults.fire("sharded.collective")
-        now = jnp.int32(snap.now_rel32(now_us))
-        dsh = NamedSharding(self.mesh, P(DATA_AXIS))
-        rep = NamedSharding(self.mesh, P())
+            def put(a):
+                return jax.device_put(a, dsh)
 
-        def put(a):
-            return jax.device_put(a, dsh)
-
-        d, p, ovf = self._fn(
-            dsnap.arrays, dsnap.tid_map, now,
-            put(u_subj), put(u_srel), put(u_wc), put(u_qctx),
-            put(q["q_res"]), put(q["q_perm"]), put(q["q_subj"]),
-            put(q["q_srel"]), put(q["q_wc"]), put(q["q_row"]), put(q["q_self"]),
-            put(q["q_ctx"]),
-            {k: jax.device_put(v, rep) for k, v in qctx.items()},
-        )
-        if not fetch:
-            return d, p, ovf
-        d, p, ovf = jax.device_get((d, p, ovf))
-        return d[:B], p[:B], ovf[:B]
+            with _trace.annotate_dispatch(span):
+                d, p, ovf = self._fn(
+                    dsnap.arrays, dsnap.tid_map, now,
+                    put(u_subj), put(u_srel), put(u_wc), put(u_qctx),
+                    put(q["q_res"]), put(q["q_perm"]), put(q["q_subj"]),
+                    put(q["q_srel"]), put(q["q_wc"]), put(q["q_row"]), put(q["q_self"]),
+                    put(q["q_ctx"]),
+                    {k: jax.device_put(v, rep) for k, v in qctx.items()},
+                )
+            ssp.event("stage.collective")
+            if not fetch:
+                return d, p, ovf
+            d, p, ovf = jax.device_get((d, p, ovf))
+            ssp.event("stage.fetch")
+            return d[:B], p[:B], ovf[:B]
+        finally:
+            ssp.end()
 
     def check_batch(
         self,
@@ -480,12 +498,13 @@ class ShardedEngine(DeviceEngine):
         now_us: Optional[int] = None,
         latency: bool = False,  # accepted for Client parity; the latency
         # path is single-chip (engine/latency.py), so it's ignored here
+        span=_trace.NOOP,
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         if not rels:
             z = np.zeros(0, bool)
             return z, z, z
         queries, _, qctx = self._lower_queries(dsnap.snapshot, rels, dsnap.strings)
-        return self._dispatch_columns(dsnap, queries, qctx, now_us)
+        return self._dispatch_columns(dsnap, queries, qctx, now_us, span=span)
 
     def check_columns(
         self,

@@ -186,6 +186,9 @@ def build_closure(
     """Flatten the snapshot's membership graph (ms_/mp_ views) into a
     ClosureIndex via a semi-naive fixpoint of vectorized joins."""
     metrics.default.inc("closure.rebuilds")
+    from ..utils import trace as _trace
+
+    _trace.event_if_active("closure.rebuild", revision=int(snap.revision))
     S1 = np.int64(snap.num_slots + 1)  # srel1 radix
     b = _Builder(S1, per_source_cap)
 
@@ -748,6 +751,18 @@ def advance_closure(
         ovf_srel1=(full_ovf % S1).astype(np.int32),
     )
     metrics.default.inc("closure.delta_applies")
+    # write-path observability: a sampled request whose delta-prepare
+    # reached this advance records it on the request's active span
+    # (utils/trace.py thread-local; one branch when tracing is off)
+    from ..utils import trace as _trace
+
+    _trace.event_if_active(
+        "closure.advance",
+        revision=int(revision),
+        affected_pairs=int(A_p.shape[0]),
+        affected_users=int(A_u.shape[0]),
+        changed_dsts=int(changed_dsts.shape[0]),
+    )
     return AdvanceResult(
         state=ClosureState(
             S1=S1, per_source_cap=st.per_source_cap, revision=revision,

@@ -48,6 +48,7 @@ from ..schema import CompiledSchema, compile_schema, parse_schema
 from ..native.sort import lexsort2, lexsort4
 from ..schema.compiler import SchemaValidationError
 from ..utils import faults
+from ..utils import trace as _trace
 from ..utils.errors import (
     AlreadyExistsError,
     PreconditionFailedError,
@@ -370,8 +371,12 @@ class Store:
     # -- writes ------------------------------------------------------------
     def write(self, txn: Txn) -> str:
         """Atomically apply a transaction (rel/txn.go semantics); returns
-        the new revision token (client/client.go:117-126)."""
-        with self._lock:
+        the new revision token (client/client.go:117-126).  A sampled
+        write leaves a root trace (utils/trace.py) whose events include
+        any incremental-closure advance this revision later triggers on
+        the prepare path."""
+        wsp = _trace.root_span("write", updates=len(txn.updates))
+        with wsp, self._lock:
             compiled = self._require_schema()
             now_us = self._now_us()
             for u in txn.updates:
@@ -437,6 +442,8 @@ class Store:
             self._head_rev += 1
             self._log.append(_LogEntry(self._head_rev, applied))
             self._new_data.notify_all()
+            wsp.set_attr("revision", self._head_rev)
+            wsp.set_attr("applied", len(applied))
             return RevisionToken(self._head_rev)
 
     def _validate_caveat_context(self, r: Relationship) -> None:

@@ -41,6 +41,7 @@ from dataclasses import dataclass
 from typing import Callable, Optional
 
 from . import metrics as _metrics
+from . import trace as _trace
 from .context import Context
 from .errors import DeadlineExceededError, ShedError
 
@@ -90,17 +91,25 @@ class DispatchGate:
             return self._inflight
 
     @contextmanager
-    def admit(self):
+    def admit(self, span=_trace.NOOP):
         if self.max_inflight > 0:
             with self._lock:
                 if self._inflight >= self.max_inflight:
                     self._m.inc("admission.sheds")
+                    span.event(
+                        "admission.shed",
+                        error="ShedError", inflight=self._inflight,
+                    )
+                    span.set_attr("shed_error", "ShedError")
                     raise ShedError(
                         f"dispatch admission: {self._inflight} in-flight"
                         f" >= max_inflight {self.max_inflight}"
                     )
                 self._inflight += 1
                 self._m.set_gauge("admission.inflight", self._inflight)
+                span.event("admission.admit", inflight=self._inflight)
+        else:
+            span.event("admission.admit", inflight=-1)
         try:
             yield
         finally:
@@ -231,7 +240,7 @@ class AdmissionController:
             else:
                 self._cost_ewma += _EWMA_ALPHA * (seconds - self._cost_ewma)
 
-    def check_deadline(self, ctx: Context) -> None:
+    def check_deadline(self, ctx: Context, span=_trace.NOOP) -> None:
         """Shed a dispatch whose deadline cannot cover the expected cost
         — before any device work (pre-H2D), not after the kernel has
         spent the budget.  Raises ``DeadlineExceededError`` (classified,
@@ -258,6 +267,11 @@ class AdmissionController:
                     if self._cost_ewma is not None:
                         self._cost_ewma /= 2.0
             self._m.inc("admission.deadline_sheds")
+            span.event(
+                "admission.deadline_shed",
+                remaining_s=round(max(remaining, 0.0), 6),
+                expected_s=round(est, 6),
+            )
             raise DeadlineExceededError(
                 f"deadline budget: {max(remaining, 0.0) * 1000:.1f} ms remain,"
                 f" dispatch expected to take {est * 1000:.1f} ms"

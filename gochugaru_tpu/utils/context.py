@@ -43,6 +43,24 @@ class Context:
     def with_value(self, key: str, val: Any) -> "Context":
         return Context(self, values={key: val})
 
+    # -- tracing (utils/trace.py) ------------------------------------------
+    def with_span(self, span) -> "Context":
+        """Carry a request-scoped trace span (utils/trace.py) down the
+        context chain — the structural analogue of the overlap key riding
+        ``with_value``.  The NOOP span rides for free: the SAME context
+        comes back, so the disabled-tracing path creates no child
+        context (zero dict churn on the latency path)."""
+        from . import trace as _trace
+
+        return _trace.ctx_with_span(self, span)
+
+    def span(self):
+        """The active trace span carried by this context chain, or the
+        NOOP singleton (one branch when tracing is disabled)."""
+        from . import trace as _trace
+
+        return _trace.span_of(self)
+
     # -- cancellation ------------------------------------------------------
     def with_cancel(self) -> "Context":
         return Context(self)

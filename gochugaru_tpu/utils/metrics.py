@@ -9,7 +9,11 @@ tool; these are the cheap always-on numbers.
 Timers keep a bounded ring of raw samples alongside the running
 count/total, so tail latency is a first-class readout: ``percentile``
 answers "what is my p99 right now" from the live process, and
-``snapshot`` publishes ``.p50_s``/``.p99_s`` per timer.  The north-star
+``snapshot`` publishes ``.p50_s``/``.p90_s``/``.p99_s``/``.p999_s`` per
+timer (one shared nearest-rank definition, one sorted pass).  The
+telemetry exporter (utils/telemetry.py) renders the same registry as
+Prometheus text, and utils/trace.py adds request-scoped spans on top —
+counters stay the cheap always-on layer underneath.  The north-star
 metric is a p99, and a mean cannot stand in for it — the latency-mode
 dispatch path (engine/latency.py) publishes its per-stage budget through
 these samples.
@@ -21,7 +25,26 @@ import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
+
+#: the percentiles ``snapshot`` publishes per timer (one sorted pass)
+SNAPSHOT_QUANTILES = (50.0, 90.0, 99.0, 99.9)
+
+
+def nearest_rank(sorted_samples, q: float) -> float:
+    """Nearest-rank percentile over an ascending-sorted sequence — the
+    ONE definition ``percentile``, ``snapshot`` and the telemetry
+    exporter (utils/telemetry.py) all share, so their p99s cannot
+    disagree.  ``q`` in [0, 100]; no numpy dependency here."""
+    n = len(sorted_samples)
+    i = min(n - 1, max(0, int(round(q / 100.0 * (n - 1)))))
+    return sorted_samples[i]
+
+
+def quantile_suffix(q: float) -> str:
+    """'p50_s'/'p90_s'/'p99_s'/'p999_s'-style key suffix for a [0,100]
+    percentile (99.9 → 'p999_s')."""
+    return "p" + format(q, "g").replace(".", "") + "_s"
 
 
 class Metrics:
@@ -35,6 +58,12 @@ class Metrics:
         self._counters: Dict[str, float] = defaultdict(float)
         self._timings: Dict[str, list] = defaultdict(lambda: [0, 0.0])  # [n, total_s]
         self._samples: Dict[str, list] = defaultdict(list)  # ring of raw seconds
+        #: explicit per-ring write cursor.  NOT derived from the timing
+        #: count: an in-flight timer racing ``reset()`` recreates the
+        #: ``_timings`` entry out of step with ``_samples`` (count says
+        #: "overwrite slot n" while the ring is empty again) — the
+        #: cursor lives and dies with its ring, so the two cannot skew
+        self._scursor: Dict[str, int] = defaultdict(int)
         self._gauges: Dict[str, float] = {}  # last-set values (breaker state)
 
     def inc(self, name: str, delta: float = 1.0) -> None:
@@ -60,7 +89,9 @@ class Metrics:
             if len(s) < self.SAMPLE_CAP:
                 s.append(seconds)
             else:
-                s[(t[0] - 1) % self.SAMPLE_CAP] = seconds
+                cur = self._scursor[name]
+                s[cur] = seconds
+                self._scursor[name] = (cur + 1) % self.SAMPLE_CAP
 
     @contextmanager
     def timer(self, name: str):
@@ -83,31 +114,55 @@ class Metrics:
             s = self._samples.get(name)
             if not s:
                 return None
-            s = sorted(s)
-        # nearest-rank on the sorted ring: no numpy dependency here
-        i = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
-        return s[i]
+            s = list(s)  # sort outside the lock observe() contends on
+        return nearest_rank(sorted(s), q)
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             out = dict(self._counters)
             out.update(self._gauges)
-            samples = {k: sorted(v) for k, v in self._samples.items() if v}
+            samples = {k: list(v) for k, v in self._samples.items() if v}
             for k, (n, total) in self._timings.items():
                 out[f"{k}.count"] = n
                 out[f"{k}.total_s"] = total
                 if n:
                     out[f"{k}.mean_s"] = total / n
         for k, s in samples.items():
-            out[f"{k}.p50_s"] = s[int(round(0.50 * (len(s) - 1)))]
-            out[f"{k}.p99_s"] = s[int(round(0.99 * (len(s) - 1)))]
+            # one sorted pass per timer, every published quantile off it;
+            # sorting happens outside the lock the latency path's
+            # observe() contends on, off a ring copy
+            s = sorted(s)
+            for q in SNAPSHOT_QUANTILES:
+                out[f"{k}.{quantile_suffix(q)}"] = nearest_rank(s, q)
         return out
+
+    def typed_snapshot(
+        self,
+    ) -> Tuple[Dict[str, float], Dict[str, float], Dict[str, Tuple[int, float, List[float]]]]:
+        """(counters, gauges, timers) with types preserved — the
+        telemetry exporter needs to know a counter from a gauge from a
+        timer to emit correct Prometheus TYPE lines.  Timers map to
+        (count, total_s, ascending-sorted sample ring)."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            timers = {
+                k: (n, total, list(self._samples.get(k, ())))
+                for k, (n, total) in self._timings.items()
+            }
+        # sort the ring copies AFTER releasing the lock: a /metrics
+        # scrape sorting every 2048-sample ring must not stall the
+        # latency path's observe() behind the registry lock
+        return counters, gauges, {
+            k: (n, total, sorted(s)) for k, (n, total, s) in timers.items()
+        }
 
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
             self._timings.clear()
             self._samples.clear()
+            self._scursor.clear()
             self._gauges.clear()
 
 

@@ -22,6 +22,7 @@ import time
 from typing import Callable, Optional, TypeVar
 
 from . import metrics as _metrics
+from . import trace as _trace
 from .context import Context
 from .errors import DeadlineExceededError, PermanentError, is_retriable
 
@@ -48,6 +49,9 @@ def retry_retriable_errors(
     the backoff."""
     interval = INITIAL_INTERVAL
     tries = 0
+    # the request's trace span rides the context (utils/trace.py); the
+    # disabled path is one branch returning the NOOP singleton
+    span = _trace.span_of(ctx)
     while True:
         err = ctx.err()
         if err is not None:
@@ -71,6 +75,11 @@ def retry_retriable_errors(
                 # Never sleep past the deadline (backoff.WithContext behavior).
                 pause = min(pause, max(dl - time.monotonic(), 0.0))
             _metrics.default.inc("retry.retries")
+            span.event(
+                "retry",
+                error=type(e).__name__, attempt=tries,
+                pause_s=round(pause, 6),
+            )
             if pause > 0.0:
                 if sleep is not None:
                     sleep(pause)

@@ -1,0 +1,194 @@
+"""Telemetry export: Prometheus text rendering of the live Metrics
+registry, JSONL trace dump, and a stdlib HTTP daemon serving both.
+
+The bench suite measures offline (Graphulo discipline, arXiv:1609.08642);
+a serving process for millions of users must expose the SAME numbers
+live.  This module is deliberately dependency-free: ``http.server`` on a
+daemon thread, Prometheus exposition text v0.0.4 by hand — the container
+bakes no prometheus_client, and the format is ten lines of code.
+
+Surface:
+
+- ``render_prometheus(registry)`` — counters as ``counter``, gauges as
+  ``gauge``, timer rings as ``summary`` quantile series (p50/p90/p99/
+  p999 via the shared ``metrics.nearest_rank``) plus ``_count``/``_sum``.
+- ``render_traces(tracer)`` — the tracer ring as JSONL.
+- ``TelemetryServer`` — ``/metrics`` (Prometheus text), ``/traces``
+  (JSONL), ``/healthz`` (JSON liveness).  Bound to localhost by
+  default; ``port=0`` picks an ephemeral port (read ``.port`` back).
+- ``client.with_telemetry(port=...)`` (client.py) starts one per client;
+  ``scripts/telemetryd.py`` runs one standalone.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from . import metrics as _metrics
+from . import trace as _trace
+
+#: every exported series is namespaced (dots/dashes → underscores after)
+PROM_PREFIX = "gochugaru_"
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+#: quantile-label values for the timer summaries, paired with the
+#: shared snapshot percentiles (50 → "0.5", 99.9 → "0.999")
+_QUANTILE_LABELS = tuple(
+    (q, format(q / 100.0, "g")) for q in _metrics.SNAPSHOT_QUANTILES
+)
+
+
+def prom_name(name: str, suffix: str = "") -> str:
+    """'checks.dispatch' → 'gochugaru_checks_dispatch<suffix>'."""
+    return PROM_PREFIX + _NAME_RE.sub("_", name) + suffix
+
+
+def _fmt(v: float) -> str:
+    # Prometheus wants plain decimal/scientific; repr of a float is fine
+    return repr(float(v))
+
+
+def render_prometheus(registry: Optional[_metrics.Metrics] = None) -> str:
+    """The registry as Prometheus exposition text.  Counters/gauges map
+    directly; each timer ring becomes a summary — quantile series from
+    the SAME nearest-rank math ``Metrics.snapshot`` publishes, so the
+    scraped p99 and the in-process p99 cannot disagree."""
+    m = registry or _metrics.default
+    counters, gauges, timers = m.typed_snapshot()
+    lines = []
+    for name in sorted(counters):
+        pn = prom_name(name, "_total")
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {_fmt(counters[name])}")
+    for name in sorted(gauges):
+        pn = prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {_fmt(gauges[name])}")
+    for name in sorted(timers):
+        n, total, samples = timers[name]
+        base = _NAME_RE.sub("_", name)
+        # timer names already end in '_s' by convention; normalize the
+        # exported unit suffix to _seconds either way
+        base = base[:-2] if base.endswith("_s") else base
+        pn = PROM_PREFIX + base + "_seconds"
+        lines.append(f"# TYPE {pn} summary")
+        if samples:
+            for q, label in _QUANTILE_LABELS:
+                lines.append(
+                    f'{pn}{{quantile="{label}"}} '
+                    f"{_fmt(_metrics.nearest_rank(samples, q))}"
+                )
+        lines.append(f"{pn}_count {n}")
+        lines.append(f"{pn}_sum {_fmt(total)}")
+    return "\n".join(lines) + "\n"
+
+
+def render_traces(tracer: Optional[_trace.Tracer] = None) -> str:
+    """The tracer's finished-trace ring as JSONL ('' when tracing is
+    disabled or nothing was kept)."""
+    tr = tracer if tracer is not None else _trace.get()
+    if tr is None:
+        return ""
+    return tr.dump_jsonl()
+
+
+class TelemetryServer:
+    """``/metrics`` + ``/traces`` + ``/healthz`` on a daemon thread.
+
+    Read-only by construction: the handlers render from the registry and
+    the tracer ring, never mutate them — safe to point a scraper at a
+    serving process.  ``close()`` shuts the listener down; the client
+    never calls it implicitly (a dropped Client must not tear telemetry
+    out from under a scraper mid-poll; the daemon thread dies with the
+    process)."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        registry: Optional[_metrics.Metrics] = None,
+        tracer: Optional[_trace.Tracer] = None,
+    ) -> None:
+        self._registry = registry or _metrics.default
+        self._tracer = tracer  # None → follow the global tracer live
+        self._t0 = time.monotonic()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args) -> None:  # silence per-request noise
+                pass
+
+            def _reply(self, code: int, body: str, ctype: str) -> None:
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self) -> None:
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        self._reply(
+                            200, render_prometheus(outer._registry),
+                            "text/plain; version=0.0.4; charset=utf-8",
+                        )
+                    elif path == "/traces":
+                        self._reply(
+                            200, render_traces(outer._tracer),
+                            "application/x-ndjson; charset=utf-8",
+                        )
+                    elif path == "/healthz":
+                        self._reply(
+                            200,
+                            json.dumps({
+                                "status": "ok",
+                                "uptime_s": round(
+                                    time.monotonic() - outer._t0, 3
+                                ),
+                                "tracing": _trace.enabled(),
+                            }),
+                            "application/json",
+                        )
+                    else:
+                        self._reply(404, "not found\n", "text/plain")
+                except BrokenPipeError:  # scraper went away mid-write
+                    pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.25},
+            name=f"gochugaru-telemetry-{self.port}",
+            daemon=True,
+        )
+        self._thread.start()
+        _metrics.default.set_gauge("telemetry.port", self.port)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+__all__ = [
+    "PROM_PREFIX",
+    "TelemetryServer",
+    "prom_name",
+    "render_prometheus",
+    "render_traces",
+]

@@ -1,0 +1,187 @@
+"""Telemetry export (utils/telemetry.py) and the metrics satellites:
+Prometheus rendering, the shared nearest-rank percentile math
+(p50/p90/p99/p999 in one sorted pass), the explicit sample-ring write
+cursor, and the HTTP endpoint end-to-end."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gochugaru_tpu.utils import metrics, trace
+from gochugaru_tpu.utils.metrics import Metrics, nearest_rank, quantile_suffix
+from gochugaru_tpu.utils.telemetry import (
+    TelemetryServer,
+    prom_name,
+    render_prometheus,
+    render_traces,
+)
+
+
+@pytest.fixture(autouse=True)
+def _trace_hygiene():
+    trace.disable()
+    yield
+    trace.disable()
+
+
+# ---------------------------------------------------------------------------
+# metrics satellites
+# ---------------------------------------------------------------------------
+
+
+def test_percentile_and_snapshot_share_one_definition():
+    m = Metrics()
+    for i in range(100):
+        m.observe("t_s", (i + 1) / 1000.0)
+    snap = m.snapshot()
+    for q in (50.0, 90.0, 99.0, 99.9):
+        assert snap[f"t_s.{quantile_suffix(q)}"] == m.percentile("t_s", q), q
+    # the new quantiles ride the same pass as the old ones
+    assert snap["t_s.p90_s"] == pytest.approx(0.090, abs=0.002)
+    assert snap["t_s.p99_s"] == pytest.approx(0.099, abs=0.002)
+    assert snap["t_s.p999_s"] == pytest.approx(0.100, abs=0.002)
+    assert quantile_suffix(99.9) == "p999_s"
+
+
+def test_nearest_rank_edges():
+    assert nearest_rank([5.0], 99.0) == 5.0
+    assert nearest_rank([1.0, 2.0], 0.0) == 1.0
+    assert nearest_rank([1.0, 2.0], 100.0) == 2.0
+
+
+def test_ring_cursor_wraps_in_order():
+    m = Metrics()
+    cap = Metrics.SAMPLE_CAP
+    for i in range(cap + 5):
+        m.observe("t_s", float(i))
+    # the 5 oldest samples (0..4) were overwritten in ring order
+    s = m._samples["t_s"]
+    assert len(s) == cap
+    assert s[:5] == [float(cap), float(cap + 1), float(cap + 2),
+                     float(cap + 3), float(cap + 4)]
+    assert s[5] == 5.0
+    assert m._scursor["t_s"] == 5
+
+
+def test_ring_cursor_survives_reset_race():
+    """The regression the explicit cursor fixes: deriving the write slot
+    from the timing COUNT lets an in-flight timer that observed across a
+    reset() recreate _timings out of step with _samples.  The cursor
+    lives and dies with its ring, so post-reset writes always restart at
+    slot 0 / append mode."""
+    m = Metrics()
+    cap = Metrics.SAMPLE_CAP
+    for i in range(cap + 7):
+        m.observe("t_s", float(i))
+    assert m._scursor["t_s"] == 7
+    m.reset()
+    # racing in-flight timer lands after the reset: the old code would
+    # have indexed by the recreated count (slot n-1) against a ring that
+    # may or may not exist — now it's a plain append with cursor 0
+    m.observe("t_s", 42.0)
+    assert m._samples["t_s"] == [42.0]
+    assert m._scursor["t_s"] == 0
+    # refill: wrap starts from slot 0 again, not an inherited offset
+    for i in range(cap):
+        m.observe("t_s", float(i))
+    assert m._samples["t_s"][0] == float(cap - 1)  # 42.0 was slot 0 … then
+    # cursor advanced exactly once past the wrap boundary
+    assert m._scursor["t_s"] == 1
+
+
+# ---------------------------------------------------------------------------
+# prometheus rendering
+# ---------------------------------------------------------------------------
+
+
+def test_render_prometheus_types_and_quantiles():
+    m = Metrics()
+    m.inc("checks.requested", 41)
+    m.inc("checks.requested")
+    m.set_gauge("breaker.state", 2)
+    for i in range(200):
+        m.observe("checks.dispatch", (i + 1) / 1000.0)
+    text = render_prometheus(m)
+    lines = text.splitlines()
+    assert "# TYPE gochugaru_checks_requested_total counter" in lines
+    assert "gochugaru_checks_requested_total 42.0" in lines
+    assert "# TYPE gochugaru_breaker_state gauge" in lines
+    assert "gochugaru_breaker_state 2.0" in lines
+    assert "# TYPE gochugaru_checks_dispatch_seconds summary" in lines
+    for q in ("0.5", "0.9", "0.99", "0.999"):
+        assert any(
+            ln.startswith(f'gochugaru_checks_dispatch_seconds{{quantile="{q}"}} ')
+            for ln in lines
+        ), q
+    assert "gochugaru_checks_dispatch_seconds_count 200" in lines
+    # quantile values equal the registry's own percentile math
+    p99 = m.percentile("checks.dispatch", 99.0)
+    assert f'gochugaru_checks_dispatch_seconds{{quantile="0.99"}} {p99!r}' in lines
+    # '_s'-suffixed timer names normalize to _seconds, not _s_seconds
+    m2 = Metrics()
+    m2.observe("latency.kernel_s", 0.001)
+    assert "gochugaru_latency_kernel_seconds_count 1" in render_prometheus(m2)
+    assert prom_name("a.b-c", "_total") == "gochugaru_a_b_c_total"
+
+
+def test_render_traces_follows_global_tracer():
+    assert render_traces() == ""  # disabled → empty, not an error
+    tr = trace.configure(sample_rate=1.0, slow_threshold_s=None)
+    trace.root_span("probe", k="v").end()
+    out = render_traces()
+    assert json.loads(out.splitlines()[0])["name"] == "probe"
+    assert render_traces(tr) == out
+
+
+# ---------------------------------------------------------------------------
+# the HTTP endpoint
+# ---------------------------------------------------------------------------
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def test_telemetry_server_endpoints():
+    m = Metrics()
+    m.inc("checks.requested", 7)
+    m.observe("checks.dispatch", 0.003)
+    tr = trace.configure(sample_rate=1.0, slow_threshold_s=None)
+    trace.root_span("check", batch=1).end()
+    srv = TelemetryServer(port=0, registry=m)
+    try:
+        assert srv.port > 0
+        code, body = _get(srv.url + "/healthz")
+        assert code == 200 and json.loads(body)["status"] == "ok"
+        assert json.loads(body)["tracing"] is True
+        code, body = _get(srv.url + "/metrics")
+        assert code == 200
+        assert "gochugaru_checks_requested_total 7.0" in body
+        assert 'gochugaru_checks_dispatch_seconds{quantile="0.99"}' in body
+        code, body = _get(srv.url + "/traces")
+        assert code == 200
+        assert json.loads(body.splitlines()[0])["name"] == "check"
+        with pytest.raises(urllib.error.HTTPError):
+            _get(srv.url + "/nope")
+        # the gauge advertises the bound port on the default registry
+        assert metrics.default.gauge("telemetry.port") == srv.port
+    finally:
+        srv.close()
+
+
+def test_with_telemetry_client_option():
+    from gochugaru_tpu.client import new_tpu_evaluator, with_telemetry
+
+    c = new_tpu_evaluator(
+        with_telemetry(port=0, trace_sample_rate=1.0, trace_slow_ms=None)
+    )
+    try:
+        assert c.telemetry is not None and c.telemetry.port > 0
+        assert trace.enabled(), "with_telemetry(trace_sample_rate=) installs tracer"
+        code, body = _get(c.telemetry.url + "/metrics")
+        assert code == 200 and "gochugaru_" in body
+    finally:
+        c.telemetry.close()

@@ -1,0 +1,239 @@
+"""Request-scoped tracing (utils/trace.py): span-tree coverage of the
+check lifecycle (admission → dispatch → stage events), error attributes
+on the shed/retry path, the zero-allocation no-op contract when sampling
+is off, the keep-slow tail rule, and watch/write spans."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gochugaru_tpu import consistency, rel
+from gochugaru_tpu.client import (
+    new_tpu_evaluator,
+    with_admission_control,
+    with_latency_mode,
+)
+from gochugaru_tpu.utils import metrics, trace
+from gochugaru_tpu.utils.admission import AdmissionConfig
+from gochugaru_tpu.utils.context import background
+from gochugaru_tpu.utils.errors import DeadlineExceededError, ShedError
+
+SCHEMA = """
+definition user {}
+definition doc { relation reader: user  permission read = reader }
+"""
+
+
+@pytest.fixture(autouse=True)
+def _trace_hygiene():
+    """No test may leak an installed tracer into the next (the tracer is
+    process-global by design, like the fault registry)."""
+    trace.disable()
+    yield
+    trace.disable()
+
+
+@pytest.fixture(scope="module")
+def doc_client():
+    c = new_tpu_evaluator(with_latency_mode())
+    ctx = background()
+    c.write_schema(ctx, SCHEMA)
+    txn = rel.Txn()
+    for i in range(16):
+        txn.create(rel.must_from_triple(f"doc:d{i}", "reader", f"user:u{i}"))
+    c.write(ctx, txn)
+    rs = [rel.must_from_triple(f"doc:d{i}", "read", f"user:u{i}") for i in range(8)]
+    # warm: first dispatch compiles; the traced assertions below want a
+    # warm (budget-recording) latency dispatch
+    assert c.check(ctx, consistency.full(), *rs) == [True] * 8
+    return c, ctx, rs
+
+
+def _spans_by_name(t):
+    out = {}
+    for sp in t["spans"]:
+        out.setdefault(sp["name"], []).append(sp)
+    return out
+
+
+def test_sampled_check_covers_admission_dispatch_stages(doc_client):
+    c, ctx, rs = doc_client
+    tr = trace.configure(sample_rate=1.0, slow_threshold_s=None, capacity=32)
+    assert c.check(ctx, consistency.full(), *rs) == [True] * 8
+    traces = [t for t in tr.traces() if t["name"] == "check"]
+    assert len(traces) == 1, "one sampled check → exactly one trace"
+    t = traces[0]
+    by = _spans_by_name(t)
+
+    # tree shape: check → dispatch → device.check_batch → latency.dispatch
+    # → four stage spans
+    root = by["check"][0]
+    assert root["parent_id"] == -1 and root["attrs"]["batch"] == 8
+    disp = by["dispatch"][0]
+    assert disp["parent_id"] == root["span_id"]
+    assert any(e["name"] == "admission.admit" for e in root["events"])
+    dev = by["device.check_batch"][0]
+    assert dev["parent_id"] == disp["span_id"]
+    lat = by["latency.dispatch"][0]
+    assert lat["parent_id"] == dev["span_id"]
+    assert lat["attrs"]["compiled"] is False, "warm dispatch must not compile"
+    stage_names = {"stage.host_lower", "stage.h2d", "stage.kernel", "stage.d2h"}
+    assert stage_names <= set(by), set(by)
+    for s in stage_names:
+        assert by[s][0]["parent_id"] == lat["span_id"]
+
+    # the stage span durations must agree with the metrics stage timers:
+    # both are built from the SAME perf_counter stamps, so the last
+    # budget's values match the span durations exactly (within the
+    # float rounding the JSONL dump applies)
+    engine = c._engine
+    dsnap = next(iter(c._dsnap_cache.values()))
+    b = dsnap.latency_path.last_budget
+    for sname, bval in [
+        ("stage.host_lower", b.host_lower_s), ("stage.h2d", b.h2d_s),
+        ("stage.kernel", b.kernel_s), ("stage.d2h", b.d2h_s),
+    ]:
+        assert by[sname][0]["dur_s"] == pytest.approx(bval, abs=1e-9), sname
+    assert lat["dur_s"] == pytest.approx(b.total_s, abs=1e-9)
+    # ... and the metrics registry really did observe that kernel sample
+    ring = metrics.default._samples.get("latency.kernel_s")
+    assert ring and any(abs(v - b.kernel_s) < 1e-12 for v in ring)
+
+    # the JSONL dump round-trips
+    lines = [ln for ln in tr.dump_jsonl().splitlines() if ln]
+    parsed = [json.loads(ln) for ln in lines]
+    assert any(p["trace_id"] == t["trace_id"] for p in parsed)
+
+
+def test_shed_retry_path_records_shed_error():
+    c = new_tpu_evaluator(
+        with_latency_mode(),
+        with_admission_control(AdmissionConfig(max_inflight=1)),
+    )
+    ctx = background()
+    c.write_schema(ctx, SCHEMA)
+    txn = rel.Txn()
+    txn.create(rel.must_from_triple("doc:d0", "reader", "user:u0"))
+    c.write(ctx, txn)
+    r = rel.must_from_triple("doc:d0", "read", "user:u0")
+    tr = trace.configure(sample_rate=1.0, slow_threshold_s=None, capacity=32)
+
+    # occupy the single admission slot so every dispatch sheds
+    cm = c._admission.gate.admit()
+    cm.__enter__()
+    try:
+        with pytest.raises((DeadlineExceededError, ShedError)):
+            c.check(ctx.with_timeout(0.30), consistency.full(), r)
+    finally:
+        cm.__exit__(None, None, None)
+
+    traces = [t for t in tr.traces() if t["name"] == "check"]
+    assert traces, "shed check must still finish (and keep) its trace"
+    t = traces[-1]
+    root = t["spans"][0]
+    # the ShedError lands as a root attribute (set by the gate) ...
+    assert root["attrs"].get("shed_error") == "ShedError"
+    # ... as admission.shed events ...
+    evs = [e for sp in t["spans"] for e in sp.get("events", ())]
+    assert any(
+        e["name"] == "admission.shed" and e.get("error") == "ShedError"
+        for e in evs
+    )
+    # ... and the retry envelope recorded at least one backoff on it
+    assert any(
+        e["name"] == "retry" and e.get("error") == "ShedError" for e in evs
+    )
+    # the terminal error is attributed on the root
+    assert root["attrs"].get("error") in ("DeadlineExceededError", "ShedError")
+
+
+def test_sampling_off_allocates_zero_spans(doc_client):
+    c, ctx, rs = doc_client
+    # rate 0: tracer installed but every head decision is "no"
+    trace.configure(sample_rate=0.0, slow_threshold_s=None)
+    assert trace.root_span("check") is trace.NOOP
+    n0 = trace.spans_created()
+    assert c.check(ctx, consistency.full(), *rs) == [True] * 8
+    assert trace.spans_created() == n0, (
+        "sampling off must allocate no Span objects anywhere on the path"
+    )
+    # tracer absent entirely: same contract, and the context rides free
+    trace.disable()
+    ctx2 = ctx.with_span(trace.NOOP)
+    assert ctx2 is ctx, "NOOP span must not grow the context chain"
+    assert ctx.span() is trace.NOOP
+    n0 = trace.spans_created()
+    assert c.check(ctx, consistency.full(), *rs) == [True] * 8
+    assert trace.spans_created() == n0
+
+
+def test_keep_slow_tail_rule(doc_client):
+    c, ctx, rs = doc_client
+    # head sampling off, tail threshold 0 → every request is "slow"
+    tr = trace.configure(sample_rate=0.0, slow_threshold_s=0.0, capacity=8)
+    assert c.check(ctx, consistency.full(), *rs) == [True] * 8
+    kept = [t for t in tr.traces() if t["name"] == "check"]
+    assert kept and kept[-1]["tail_kept"] is True
+    assert kept[-1]["spans"][0]["attrs"]["batch"] == 8
+    assert kept[-1]["duration_s"] > 0
+    # and a high threshold keeps nothing
+    tr = trace.configure(sample_rate=0.0, slow_threshold_s=60.0)
+    assert c.check(ctx, consistency.full(), *rs) == [True] * 8
+    assert not tr.traces()
+
+
+def test_watch_and_write_spans(doc_client):
+    c, ctx, _ = doc_client
+    tr = trace.configure(sample_rate=1.0, slow_threshold_s=None, capacity=32)
+    wctx = ctx.with_cancel()
+    from gochugaru_tpu.rel.update import UpdateFilter
+
+    stream = c.updates_since_revision(wctx, UpdateFilter(), "")
+    got = []
+
+    def consume():  # exactly one update, then the thread exits
+        try:
+            got.append(next(stream))
+        except StopIteration:
+            pass
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    try:
+        time.sleep(0.05)
+        txn = rel.Txn()
+        txn.touch(rel.must_from_triple("doc:d0", "reader", "user:watcher"))
+        c.write(ctx, txn)
+        t.join(timeout=10)
+        assert not t.is_alive() and len(got) == 1
+    finally:
+        wctx.cancel()
+        t.join(timeout=5)
+        stream.close()
+    names = {t_["name"] for t_ in tr.traces()}
+    assert "write" in names, names
+    assert "watch" in names, names
+    watch = [t_ for t_ in tr.traces() if t_["name"] == "watch"][-1]
+    assert watch["spans"][0]["attrs"]["delivered"] == 1
+    write = [t_ for t_ in tr.traces() if t_["name"] == "write"][-1]
+    assert write["spans"][0]["attrs"]["applied"] == 1
+    assert "revision" in write["spans"][0]["attrs"]
+
+
+def test_span_event_cap_bounded():
+    tr = trace.configure(sample_rate=1.0, slow_threshold_s=None, capacity=4)
+    sp = trace.root_span("flood")
+    for i in range(trace.MAX_EVENTS + 50):
+        sp.event("e", i=i)
+    sp.end()
+    t = tr.traces()[-1]
+    root = t["spans"][0]
+    assert len(root["events"]) == trace.MAX_EVENTS
+    assert root["attrs"]["events_dropped"] == 50
+    # the ring itself is bounded too
+    for i in range(10):
+        trace.root_span("r", i=i).end()
+    assert len(tr.traces()) == 4

@@ -1,0 +1,76 @@
+"""Tracing-overhead budget smoke (CPU proxy): 100%-sampled tracing must
+add <5% to the warm small-batch p99 vs tracing disabled.
+
+The zero-cost-when-disabled contract is asserted structurally in
+test_trace.py (identity NOOP, zero span allocations); this test bounds
+the cost of tracing when it is ON — a 100%-sampled request pays a root
+span, the dispatch child, four stage spans, and ring retention.
+Measured through ``benchmarks.common.small_batch_latency``, the SAME
+harness that produced the PR-3 5.2 ms baseline row, whose per-rep span
+rooting mirrors client.check exactly.
+
+Estimator: tracing cost is a UNIFORM per-rep shift (span bookkeeping
+runs on every rep; the residual GC pressure is ~110 µs amortized over
+~75 reps — measured to land well below the p99 level, not at it).  A
+uniform shift of δ moves every quantile, p99 included, by δ — so the
+budget "p99_on ≤ 1.05 × p99_off" holds iff δ ≤ 0.05 × p99_off.  δ is
+estimated as the off/on median difference with the tracer flipped
+in/out PER REP (``interleave_tracer``): adjacent reps see the same
+host conditions, pairing the scheduler noise away.  Direct p99-vs-p99
+A/B was tried first and cannot resolve 5% on a shared 2-core box — the
+window-p99 estimator alone swings ±20% between identical runs.  The
+p90 delta rides along as a tail-shape guard (it would catch a cost
+that only bites above the median, e.g. a per-ring-eviction stall) with
+the same allowance; both deltas come from one interleaved stream."""
+
+import numpy as np
+import pytest
+
+from benchmarks.common import small_batch_latency
+from gochugaru_tpu.utils import trace
+
+from test_latency_path import build_rbac_world
+
+B = 256
+REPS = 2000  # 1000 per mode, interleaved
+BUDGET = 0.05
+
+
+@pytest.fixture(autouse=True)
+def _trace_hygiene():
+    trace.disable()
+    yield
+    trace.disable()
+
+
+def test_tracing_enabled_overhead_under_5pct():
+    from gochugaru_tpu.engine.device import DeviceEngine
+
+    cs, snap, users, repos, slot = build_rbac_world()
+    engine = DeviceEngine(cs)
+    dsnap = engine.prepare(snap)
+    rng = np.random.default_rng(11)
+    q_res = rng.choice(repos, B).astype(np.int32)
+    q_perm = rng.choice(np.array([slot["read"], slot["admin"]], np.int32), B)
+    q_subj = rng.choice(users, B).astype(np.int32)
+
+    tracer = trace.Tracer(sample_rate=1.0, slow_threshold_s=None, capacity=256)
+    r = small_batch_latency(
+        engine, dsnap, q_res, q_perm, q_subj,
+        warmup=40, reps=REPS, interleave_tracer=tracer,
+    )
+
+    # the on-reps really sampled (guard against measuring noop-vs-noop)
+    assert len(tracer.traces()) == tracer._ring.maxlen
+
+    allowance = BUDGET * r["p99_ms_off"]
+    assert r["delta_p50_ms"] <= allowance, (
+        f"tracing's uniform per-request cost breaks the 5% p99 budget: "
+        f"median shift {r['delta_p50_ms']:.3f} ms > "
+        f"0.05 x p99_off {r['p99_ms_off']:.3f} ms ({r})"
+    )
+    assert r["delta_p90_ms"] <= allowance, (
+        f"tracing cost is tail-shaped beyond the 5% p99 budget: "
+        f"p90 shift {r['delta_p90_ms']:.3f} ms > "
+        f"0.05 x p99_off {r['p99_ms_off']:.3f} ms ({r})"
+    )
