@@ -56,23 +56,25 @@ def main() -> int:
     q_perm = rng.choice(np.array([slot["read"], slot["admin"]], np.int32), B)
     q_subj = rng.choice(users, B).astype(np.int32)
 
-    def rate_of(engine, label):
-        """Steady-state checks/s of one engine's columnar dispatch."""
-        dsnap = engine.prepare(snap)
+    def rate_of(engine, label, prepare=None):
+        """Steady-state checks/s of one engine's columnar dispatch;
+        returns (rate, DeviceSnapshot, warm (d, p, o))."""
+        dsnap = (prepare or engine.prepare)(snap)
         fn = lambda: engine.check_columns(
             dsnap, q_res, q_perm, q_subj, now_us=1_700_000_000_000_000
         )
-        d0, _, _ = fn()  # warm: compile + page-in
+        out0 = fn()  # warm: compile + page-in
         fn()
         reps = 6
         t0 = time.perf_counter()
         for _ in range(reps):
-            out = fn()
+            fn()
         dt = time.perf_counter() - t0
-        note(f"{label}: {reps * B / dt:,.0f} checks/s granted={int(d0.sum())}")
-        return reps * B / dt
+        note(f"{label}: {reps * B / dt:,.0f} checks/s"
+             f" granted={int(out0[0].sum())}")
+        return reps * B / dt, dsnap, out0
 
-    single_rate = rate_of(DeviceEngine(cs), "single-device")
+    single_rate, _ds, single_out = rate_of(DeviceEngine(cs), "single-device")
 
     mesh_rates = {}
     for shape in ((1, 8), (4, 2)):
@@ -81,10 +83,71 @@ def main() -> int:
             from gochugaru_tpu.parallel import ShardedEngine, make_mesh
 
             eng = ShardedEngine(cs, make_mesh(*shape))
-            mesh_rates[key] = round(rate_of(eng, key), 1)
+            mesh_rates[key] = round(rate_of(eng, key)[0], 1)
         except Exception as e:  # mesh unavailable: report, don't die
             note(f"{key} failed: {type(e).__name__}: {e}")
             mesh_rates[key] = None
+
+    # ---- partitioned serving: owner-routed vs replicated, 4 devices -----
+    # The pre-PR way to serve a fold-bearing schema collective-free is
+    # data-parallel replication (mesh M×1: every device holds the FULL
+    # stacked+fold tables, batch splits along data).  The partitioned
+    # serve (mesh 1×M, serve="routed") model-splits the primary/fold
+    # point tables — O(E/M) HBM per device — and owner-routes each query
+    # to its bucket's shard, also with no collective in the compiled
+    # program.  Same 4 devices, same batch, same answers; the row is the
+    # HBM-per-device vs throughput trade.
+    def table_bytes_per_device(dsnap):
+        """Max over devices of resident stacked+fold table bytes
+        (node_type/caveat-context lookups excluded on both sides)."""
+        per = {}
+        for k, arr in dsnap.arrays.items():
+            if k == "node_type" or k.startswith("ectx_"):
+                continue
+            for s in arr.addressable_shards:
+                per[s.device.id] = (
+                    per.get(s.device.id, 0) + int(np.asarray(s.data).nbytes)
+                )
+        return max(per.values())
+
+    part_fields = {}
+    try:
+        from gochugaru_tpu.parallel import ShardedEngine, make_mesh
+
+        M = 4
+        rep_eng = ShardedEngine(cs, make_mesh(M, 1))
+        rep_rate, rep_ds, rep_out = rate_of(
+            rep_eng, "replicated 4-dev (data-parallel)"
+        )
+        rt_eng = ShardedEngine(cs, make_mesh(1, M))
+        rt_rate, rt_ds, rt_out = rate_of(
+            rt_eng, "partitioned 4-dev (owner-routed)",
+            prepare=rt_eng.prepare_snapshot_partitioned,
+        )
+        if not (rt_ds.flat_meta is not None and rt_ds.flat_meta.part_serve):
+            raise RuntimeError("partitioned feed declined the bench world")
+        oracle_match = all(
+            np.array_equal(a, b) for a, b in zip(single_out, rt_out)
+        ) and all(np.array_equal(a, b) for a, b in zip(single_out, rep_out))
+        rep_bytes = table_bytes_per_device(rep_ds)
+        rt_bytes = table_bytes_per_device(rt_ds)
+        note(
+            f"table bytes/device: replicated {rep_bytes:,} vs routed"
+            f" {rt_bytes:,} ({rt_bytes / rep_bytes:.1%});"
+            f" rate routed/replicated {rt_rate / rep_rate:.2f}x"
+            f" oracle_match={oracle_match}"
+        )
+        part_fields = dict(
+            routed_rate=round(rt_rate, 1),
+            replicated_rate=round(rep_rate, 1),
+            table_bytes_per_device=int(rt_bytes),
+            replicated_table_bytes_per_device=int(rep_bytes),
+            table_bytes_ratio=round(rt_bytes / rep_bytes, 4),
+            rate_vs_replicated=round(rt_rate / rep_rate, 4),
+            oracle_match=bool(oracle_match),
+        )
+    except Exception as e:  # mesh/feed unavailable: report, don't die
+        note(f"partitioned_serving failed: {type(e).__name__}: {e}")
 
     # ---- degraded-mode phase: client checks under injected faults ------
     # store-backed world so the full client path (admission gate, retry
@@ -198,6 +261,24 @@ def main() -> int:
             " max_inflight=2, 4 workers"
         ),
     )
+    if part_fields:
+        emit(
+            "partitioned_serving",
+            part_fields["routed_rate"],
+            "checks/sec",
+            part_fields["routed_rate"] / NORTH_STAR_RATE,
+            **part_fields,
+            edges=int(snap.num_edges),
+            batch=int(B),
+            platform=jax.default_backend(),
+            note=(
+                "4-dev CPU proxy: owner-routed partitioned serve"
+                f" ({part_fields['table_bytes_ratio']:.0%} table bytes"
+                "/device) vs data-parallel replicated baseline"
+                f" ({part_fields['rate_vs_replicated']:.2f}x rate),"
+                " fold engaged, collective-free both"
+            ),
+        )
     return 0
 
 

@@ -147,6 +147,11 @@ def main() -> int:
            if q else ["--edges", "10000000"]),
         4000,
     ))
+    configs.append((
+        "8 — partitioned-serving smoke (2-shard parity + routed serve)",
+        ["bash", "scripts/partition_smoke.sh"],
+        600,
+    ))
     if not q:
         # Leopard-scale CPU proxy (VERDICT r04 item 3): the same Watch
         # re-index loop at a 100M-edge base — BASELINE config 5's

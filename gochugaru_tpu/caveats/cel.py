@@ -9,6 +9,14 @@ vectorizes onto TPU):
   (``== != < <= > >=``), arithmetic (``+ - * / %``, unary ``-``), ``in``
   (membership in a list literal or list-valued context value)
 - parentheses
+- ``timestamp("<RFC 3339>")`` and ``duration("1h30m")`` constructors
+  (host evaluation): timestamps and durations compare and do the CEL
+  arithmetic (ts − ts = dur, ts ± dur = ts, dur ± dur = dur); context
+  parameters DECLARED as ``timestamp``/``duration`` coerce from RFC 3339
+  / CEL duration strings (or datetimes / numeric seconds) at evaluation
+  time.  The device VM declines these constructs (``_HostOnly``), so
+  caveats using them evaluate on the host path — per ROADMAP, host
+  first; a typed device lowering can follow
 
 Evaluation is three-valued: a missing context parameter makes the result
 UNKNOWN rather than an error — SpiceDB's CONDITIONAL permissionship — and
@@ -20,8 +28,10 @@ collapses permissionship to bool (client/client.go:277).
 
 from __future__ import annotations
 
+import datetime as _dt
 import re
 from dataclasses import dataclass
+from functools import cached_property
 from typing import Any, Dict, List, Mapping, Optional, Tuple
 
 
@@ -48,6 +58,145 @@ class CelType:
         "int", "uint", "double", "bool", "string", "timestamp", "duration",
         "any", "list", "map",
     }
+
+
+class _TimeValue:
+    """Shared microsecond scalar: construction + the ordered
+    comparisons (strictly same-typed, like CEL).  The subclasses own
+    equality, hashing, and the time algebra."""
+
+    __slots__ = ("us",)
+    _kind = "time"
+
+    def __init__(self, us: int) -> None:
+        self.us = int(us)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"{self._kind}({self.us}us)"
+
+    def _cmp(self, other: Any):
+        if type(other) is not type(self):
+            raise TypeError(
+                f"{self._kind} compared with non-{self._kind}"
+            )
+        return self.us, other.us
+
+    def __lt__(self, other):
+        a, b = self._cmp(other)
+        return a < b
+
+    def __le__(self, other):
+        a, b = self._cmp(other)
+        return a <= b
+
+    def __gt__(self, other):
+        a, b = self._cmp(other)
+        return a > b
+
+    def __ge__(self, other):
+        a, b = self._cmp(other)
+        return a >= b
+
+
+class Timestamp(_TimeValue):
+    """A CEL timestamp: microseconds since the Unix epoch.  Orders
+    against other timestamps; ``ts - ts`` is a Duration, ``ts ± dur``
+    a Timestamp — the CEL time algebra the host evaluator computes."""
+
+    __slots__ = ()
+    _kind = "timestamp"
+
+    def __eq__(self, other: Any) -> Any:
+        return isinstance(other, Timestamp) and self.us == other.us
+
+    def __hash__(self) -> int:
+        return hash(("ts", self.us))
+
+    def __sub__(self, other):
+        if isinstance(other, Timestamp):
+            return Duration(self.us - other.us)
+        if isinstance(other, Duration):
+            return Timestamp(self.us - other.us)
+        raise TypeError("timestamp - non-time")
+
+    def __add__(self, other):
+        if isinstance(other, Duration):
+            return Timestamp(self.us + other.us)
+        raise TypeError("timestamp + non-duration")
+
+
+class Duration(_TimeValue):
+    """A CEL duration: signed microseconds."""
+
+    __slots__ = ()
+    _kind = "duration"
+
+    def __eq__(self, other: Any) -> Any:
+        return isinstance(other, Duration) and self.us == other.us
+
+    def __hash__(self) -> int:
+        return hash(("dur", self.us))
+
+    def __add__(self, other):
+        if isinstance(other, Duration):
+            return Duration(self.us + other.us)
+        if isinstance(other, Timestamp):
+            return Timestamp(self.us + other.us)
+        raise TypeError("duration + non-time")
+
+    def __sub__(self, other):
+        if isinstance(other, Duration):
+            return Duration(self.us - other.us)
+        raise TypeError("duration - non-duration")
+
+    def __neg__(self):
+        return Duration(-self.us)
+
+
+#: parts are UNSIGNED — like Go's time.ParseDuration, only ONE leading
+#: sign is legal ("1h-30m" and a bare "-" are rejected, not summed)
+_DUR_PART = re.compile(r"(\d+(?:\.\d+)?)(h|ms|us|ns|m|s)")
+_DUR_SCALE = {
+    "h": 3_600_000_000, "m": 60_000_000, "s": 1_000_000,
+    "ms": 1_000, "us": 1, "ns": 1e-3,
+}
+
+
+def parse_duration(s: str) -> Duration:
+    """CEL/Go duration literal: "1h30m", "300s", "1.5s", "-2m" ..."""
+    body = s.strip()
+    neg = body.startswith("-")
+    if neg or body.startswith("+"):
+        body = body[1:]
+    if not body:
+        raise CelCompileError(f"empty duration literal {s!r}")
+    if body == "0":  # Go accepts the bare zero without a unit
+        return Duration(0)
+    pos = 0
+    total = 0.0
+    while pos < len(body):
+        m = _DUR_PART.match(body, pos)
+        if m is None:
+            raise CelCompileError(f"bad duration literal {s!r}")
+        total += float(m.group(1)) * _DUR_SCALE[m.group(2)]
+        pos = m.end()
+    return Duration(round(-total if neg else total))
+
+
+def parse_timestamp(s: str) -> Timestamp:
+    """RFC 3339 timestamp ("2024-01-02T03:04:05Z", offsets allowed)."""
+    body = s.strip()
+    try:
+        dt = _dt.datetime.fromisoformat(body.replace("Z", "+00:00"))
+    except ValueError as e:
+        raise CelCompileError(f"bad timestamp literal {s!r}") from e
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=_dt.timezone.utc)
+    return Timestamp(round(dt.timestamp() * 1_000_000))
+
+
+#: host-evaluable builtin constructors (the device VM declines these)
+_CEL_FUNCS = {"timestamp", "duration"}
 
 
 _CEL_TOKEN = re.compile(
@@ -82,6 +231,7 @@ def _tokenize(src: str) -> List[Tuple[str, str]]:
 #   ("lit", value) ("var", name) ("member", base, name)
 #   ("not", x) ("neg", x) ("or", a, b) ("and", a, b) ("cond", c, t, f)
 #   ("cmp", op, a, b) ("arith", op, a, b) ("in", a, b) ("list", [items])
+#   ("call", fname, [args])  — timestamp()/duration() constructors
 
 
 class _CelParser:
@@ -210,6 +360,40 @@ class _CelParser:
                 return ("lit", None)
             if text == "in":
                 raise CelCompileError("misplaced 'in'")
+            if self.peek()[1] == "(":
+                if text not in _CEL_FUNCS:
+                    raise CelCompileError(f"unknown function {text!r}")
+                self.next()
+                args = []
+                while self.peek()[1] != ")":
+                    args.append(self.parse_ternary())
+                    if self.peek()[1] not in (",", ")"):
+                        raise CelCompileError(
+                            f"expected ',' or ')' in {text}() arguments"
+                        )
+                    if self.peek()[1] == ",":
+                        self.next()
+                self.expect(")")
+                # arity/shape checked at COMPILE time; a literal argument
+                # parses eagerly (bad literals are schema-write errors,
+                # not first-check errors) and folds to its host value —
+                # the device lowering declines the folded literal the
+                # same way it declines the call
+                if len(args) != 1:
+                    raise CelCompileError(
+                        f"{text}() takes one string argument"
+                    )
+                if args[0][0] == "lit":
+                    v = args[0][1]
+                    if not isinstance(v, str):
+                        raise CelCompileError(
+                            f"{text}() takes one string argument"
+                        )
+                    return ("lit", (
+                        parse_timestamp(v) if text == "timestamp"
+                        else parse_duration(v)
+                    ))
+                return ("call", text, args)
             return ("var", text)
         raise CelCompileError(f"unexpected token {text!r}")
 
@@ -289,15 +473,71 @@ class CelProgram:
             elif op == "list":
                 for it in node[1]:
                     walk(it)
+            elif op == "call":
+                for a in node[2]:
+                    walk(a)
 
         walk(self.ast)
         return out
 
     # -- host evaluation ---------------------------------------------------
+    @cached_property
+    def _timed_params(self) -> Mapping[str, str]:
+        """Params declared timestamp/duration, computed once per program
+        (host evaluation runs per caveated edge per check)."""
+        return {
+            n: t.split("<", 1)[0] for n, t in self.params.items()
+            if t.split("<", 1)[0] in ("timestamp", "duration")
+        }
+
+    def _coerced(self, context: Mapping[str, Any]) -> Mapping[str, Any]:
+        """Coerce context values of params DECLARED timestamp/duration
+        into the comparable host types: RFC 3339 / Go-duration strings,
+        datetimes, or numeric seconds."""
+        timed = self._timed_params
+        need = [
+            n for n in timed
+            if context.get(n) is not None
+            and not isinstance(context[n], _TimeValue)
+        ]
+        if not need:
+            return context
+        out = dict(context)
+        for n in need:
+            base = timed[n]
+            v = out[n]
+            if base == "timestamp":
+                if isinstance(v, _dt.datetime):
+                    out[n] = Timestamp(round(v.timestamp() * 1_000_000))
+                elif isinstance(v, str):
+                    out[n] = parse_timestamp(v)
+                # bool is an int subtype but a True/False "timestamp"
+                # is garbage — ERROR, never coerce to a grantable epoch
+                elif isinstance(v, (int, float)) and not isinstance(v, bool):
+                    out[n] = Timestamp(round(v * 1_000_000))
+                else:
+                    raise CelCompileError(
+                        f"caveat {self.name!r}: cannot coerce {v!r} to"
+                        " timestamp"
+                    )
+            else:
+                if isinstance(v, _dt.timedelta):
+                    out[n] = Duration(round(v.total_seconds() * 1_000_000))
+                elif isinstance(v, str):
+                    out[n] = parse_duration(v)
+                elif isinstance(v, (int, float)) and not isinstance(v, bool):
+                    out[n] = Duration(round(v * 1_000_000))
+                else:
+                    raise CelCompileError(
+                        f"caveat {self.name!r}: cannot coerce {v!r} to"
+                        " duration"
+                    )
+        return out
+
     def evaluate(self, context: Mapping[str, Any]):
         """Evaluate against a merged context.  Returns True / False /
         UNKNOWN (missing context parameter somewhere it mattered)."""
-        result = self._eval(self.ast, context)
+        result = self._eval(self.ast, self._coerced(context))
         if _is_unknown(result):
             return UNKNOWN
         if not isinstance(result, bool):
@@ -412,6 +652,18 @@ class CelProgram:
             if not isinstance(b, (list, tuple, set, frozenset, str, Mapping)):
                 raise CelCompileError(f"'in' target not a collection in {self.name!r}")
             return a in b
+        if op == "call":
+            args = [self._eval(a, ctx) for a in node[2]]
+            if any(_is_unknown(a) for a in args):
+                return UNKNOWN
+            if len(args) != 1 or not isinstance(args[0], str):
+                raise CelCompileError(
+                    f"{node[1]}() takes one string argument in {self.name!r}"
+                )
+            return (
+                parse_timestamp(args[0]) if node[1] == "timestamp"
+                else parse_duration(args[0])
+            )
         raise CelCompileError(f"unknown node {op!r}")
 
 

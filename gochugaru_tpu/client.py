@@ -94,6 +94,7 @@ class _Options:
         self.latency_mode = False
         self.admission: Optional[AdmissionConfig] = None
         self.mesh = None  # jax.sharding.Mesh → sharded engine
+        self.mesh_partitioned = False  # partitioned (owner-routed) serve
         self.telemetry_port: Optional[int] = None
         self.telemetry_host = "127.0.0.1"
         self.trace_sample_rate: Optional[float] = None
@@ -158,17 +159,28 @@ def with_latency_mode() -> Option:
     return opt
 
 
-def with_mesh(mesh) -> Option:
+def with_mesh(mesh, *, partitioned: bool = False) -> Option:
     """Evaluate checks over a (data × model) device mesh: the client
     builds a ShardedEngine (parallel/sharded.py) — query batches split
     along the data axis, the bucket-sharded tables along the model axis
     — instead of the single-chip DeviceEngine.  The multichip serving
     shape; dispatch faults and the partitioned-prepare fault site
     (``prepare.partition``) retry under the same client envelope as the
-    single-chip sites."""
+    single-chip sites.
+
+    ``partitioned=True`` prepares snapshots through the bucket-
+    partitioned feed (engine/partition.py partition_feed with
+    serve="routed"): the primary/fold point tables live model-split —
+    O(E/M) HBM per device — membership/group tables whole per device,
+    and eligible Check batches owner-route to their shards with no
+    collective in the compiled program.  Fold-bearing schemas serve on
+    this path (the fold/rc derivations are partition-composable since
+    this round); worlds the feed cannot partition (keys past the int32
+    pack) fall back to the ordinary sharded prepare transparently."""
 
     def opt(o: _Options) -> None:
         o.mesh = mesh
+        o.mesh_partitioned = partitioned
 
     return opt
 
@@ -243,6 +255,7 @@ class Client:
         self._profile_dir = o.profile_dir
         self._latency_mode = o.latency_mode
         self._mesh = o.mesh
+        self._mesh_partitioned = o.mesh_partitioned
         # jax.profiler allows one active trace per process: profiled
         # dispatches serialize so concurrent check() calls don't collide
         self._profile_lock = threading.Lock()
@@ -331,7 +344,10 @@ class Client:
     def _dsnap_for(self, engine: DeviceEngine, snap: Snapshot) -> DeviceSnapshot:
         with self._lock:
             ds = self._lru_get(self._dsnap_cache, snap.revision)
-            if ds is None or ds.snapshot is not snap:
+            if ds is None or (
+                ds.snapshot is not snap
+                and getattr(ds, "source_snapshot", None) is not snap
+            ):
                 # incremental prepare when the previous revision is still
                 # resident: base tables stay on device, only the delta
                 # overlay ships (engine/device.py _prepare_delta)
@@ -341,7 +357,12 @@ class Client:
                     if di is not None
                     else None
                 )
-                ds = engine.prepare(snap, prev=prev)
+                if self._mesh_partitioned and hasattr(
+                    engine, "prepare_snapshot_partitioned"
+                ):
+                    ds = engine.prepare_snapshot_partitioned(snap, prev=prev)
+                else:
+                    ds = engine.prepare(snap, prev=prev)
                 self._lru_put(self._dsnap_cache, snap.revision, ds)
             return ds
 

@@ -640,6 +640,10 @@ class DeviceSnapshot:
     #: LatencyPath) — per-snapshot warm state (staging buffers, local
     #: pin table); the executables themselves are shared engine-wide
     latency_path: Optional[Any] = None
+    #: the store Snapshot a partitioned prepare was fed from (its
+    #: ``snapshot`` is the bucket-filtered view); the client's dsnap
+    #: cache identity check consults it
+    source_snapshot: Optional[Any] = None
 
 
 class DeviceEngine:

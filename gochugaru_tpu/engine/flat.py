@@ -261,6 +261,18 @@ class FlatMeta:
     #: tables are bucket-sharded / stacked for shard_map (the kernel must
     #: be built with the matching ``axis``; make_flat_fn enforces this)
     sharded: bool = False
+    #: partitioned-SERVE placement (engine/partition.py partition_feed
+    #: with serve="routed"): only the primary/fold point tables (ehx,
+    #: pfx) are split along the model axis — everything else (userset /
+    #: arrow / T / closure / pus / ovf / pfu / csr / rc stacked tables)
+    #: is membership- or group-structure-sized and placed WHOLE on every
+    #: device, mirroring the host partition (membership subgraph
+    #: replicated, edges partitioned).  The kernel then resolves those
+    #: tables' bucket owners arithmetically (no collective at the site),
+    #: so the only remaining collectives are the e/pf probes at derived
+    #: keys — and an owner-ROUTED batch, whose root probes are local by
+    #: construction, dispatches with no collectives at all
+    part_serve: bool = False
     #: flattened recursive hierarchies (the resource-side Leopard index):
     #: ((ts_slot, group_cap, fan), ...) — per eligible tupleset, the
     #: ancestor-closure tables rc{ts}_off / rc{ts}gx / rc{ts}x exist and
@@ -1702,10 +1714,25 @@ def build_flat_arrays_sharded(
             pfh = build_hash([pf_k1, pf_k2], min_size=ms)
             out["pfh_off"], out["pfx"] = _stack_point(pfh, pf_cols, M)
             pfh_cap = pfh.cap
-        pfu = build_range_hash(u_k1, min_size=ms)
-        out["pfu_off"], out["pfugx"], out["pfux"], pfu_cap = _stack_range(
-            pfu, [u_gk, u_until], M, max(64, u_fan)
-        )
+        if PART:
+            # fold userset view (u_k1 arrives k1-sorted): partitioned
+            # group stacking, same discipline as the usr/arr views
+            pfu_gk, pfu_glo, pfu_ghi = _groups_of(u_k1)
+            h_pfu = _hash_cols([pfu_gk])
+            gpfu = range_geom(
+                pfu_gk, pfu_ghi - pfu_glo, h_pfu, M, min_size=ms,
+                fan_pad=max(64, u_fan),
+            )
+            out["pfu_off"], out["pfugx"], out["pfux"] = stack_range(
+                pfu_gk, pfu_glo, pfu_ghi - pfu_glo, h_pfu,
+                gather_cols([u_gk, u_until]), gpfu, 2,
+            )
+            pfu_cap = gpfu.cap
+        else:
+            pfu = build_range_hash(u_k1, min_size=ms)
+            out["pfu_off"], out["pfugx"], out["pfux"], pfu_cap = _stack_range(
+                pfu, [u_gk, u_until], M, max(64, u_fan)
+            )
         s_fan = _round_fan(max(int(csr.max_run), 1))
         out["csr_off"], out["csrgx"], out["csrx"], csr_cap = _stack_range(
             csr, [cl_k2, cl.c_d_until, cl.c_p_until], M, max(64, s_fan)
@@ -1733,13 +1760,32 @@ def build_flat_arrays_sharded(
     for ts_slot, (src, anc, d_u, p_u, fan) in _rc_build(
         snap, config, plan, ar_dd
     ).items():
-        ri = build_range_hash(src, min_size=ms)
-        (
-            out[f"rc{ts_slot}_off"],
-            out[f"rc{ts_slot}gx"],
-            out[f"rc{ts_slot}x"],
-            gcap,
-        ) = _stack_range(ri, [anc, d_u, p_u], M, max(64, fan))
+        if PART:
+            # ancestor-closure view (src arrives sorted): partitioned
+            # group stacking — O(rc/M) fill scratch per shard
+            rc_gk, rc_glo, rc_ghi = _groups_of(src)
+            h_rc = _hash_cols([rc_gk])
+            grc = range_geom(
+                rc_gk, rc_ghi - rc_glo, h_rc, M, min_size=ms,
+                fan_pad=max(64, fan),
+            )
+            (
+                out[f"rc{ts_slot}_off"],
+                out[f"rc{ts_slot}gx"],
+                out[f"rc{ts_slot}x"],
+            ) = stack_range(
+                rc_gk, rc_glo, rc_ghi - rc_glo, h_rc,
+                gather_cols([anc, d_u, p_u]), grc, 3,
+            )
+            gcap = grc.cap
+        else:
+            ri = build_range_hash(src, min_size=ms)
+            (
+                out[f"rc{ts_slot}_off"],
+                out[f"rc{ts_slot}gx"],
+                out[f"rc{ts_slot}x"],
+                gcap,
+            ) = _stack_range(ri, [anc, d_u, p_u], M, max(64, fan))
         rc_list.append((int(ts_slot), _round_cap(gcap), fan))
 
     if PART:
@@ -2474,6 +2520,20 @@ def build_delta_arrays(
 # ---------------------------------------------------------------------------
 
 
+#: stacked tables that stay model-split under the partitioned-serve
+#: placement (FlatMeta.part_serve) — the O(E)-scale primary and folded
+#: identity point tables plus the T join.  Everything else is
+#: membership/group-structure sized and resident whole per device
+#: there.  tx's bucket geometry differs from the routing geometry, so
+#: routed kernels never compile a T probe (sharded.py _routable sends
+#: T-probing slots to the psum fallback, whose ownership-mask probe is
+#: geometry-self-consistent)
+PART_SHARDED_TBLS = frozenset({"ehx", "pfx", "tx"})
+PART_SHARDED_KEYS = frozenset(
+    {"ehx", "eh_off", "pfx", "pfh_off", "tx", "th_off"}
+)
+
+
 def make_flat_fn(
     compiled: CompiledSchema,
     plan: DevicePlan,
@@ -2484,6 +2544,7 @@ def make_flat_fn(
     jit: bool = True,
     axis: Optional[str] = None,
     model_size: int = 1,
+    routed: bool = False,
 ):
     """Build the batched flat check function for a static set of permission
     slots.  Queries select their slot's result with a vectorized compare —
@@ -2494,7 +2555,22 @@ def make_flat_fn(
     build_flat_arrays_sharded) every probe masks bucket ownership, boolean
     site outputs OR-reduce with psum over ICI, and userset/arrow candidate
     blocks broadcast from their single owning shard — the program is the
-    same straight-line probe pipeline with one collective per site."""
+    same straight-line probe pipeline with one collective per site.
+
+    With ``meta.part_serve`` (partitioned-serve placement) only the
+    primary/fold point tables are model-split; every other stacked table
+    is whole per device, probed by resolving its owner's block
+    arithmetically — those sites need NO collective, so the only psums
+    left are the e/pf probes.  With ``routed=True`` on top, the batch
+    axis itself is owner-routed (each shard holds exactly the queries
+    whose root (k1, k2) bucket it owns): the e/pf root probes drop their
+    ownership mask — a row with the probed key can only live in its
+    owner's buckets, so a non-owner probe misses by construction — and
+    the compiled program contains no collective at all.  Routed kernels
+    are only built for ROUTABLE slot sets (fully folded permissions and
+    bare relation leaves, no wildcard edges): the dispatcher enforces
+    this, because a routed sub-batch is shard-local and a psum over it
+    would merge unrelated queries."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -2503,6 +2579,7 @@ def make_flat_fn(
 
     tri = make_tri_fn(caveat_plan) if caveat_plan is not None else None
     SH = axis is not None
+    PART = bool(meta.part_serve)
     # under sharding the delta overlay tables are REPLICATED (they are
     # small): delta probe sites use plain unsharded probes whose results
     # are identical on every shard, composed after the base sites'
@@ -2512,6 +2589,12 @@ def make_flat_fn(
             "kernel/layout mismatch: bucket-sharded tables need the model"
             " axis and vice versa (FlatMeta.sharded vs make_flat_fn axis)"
         )
+    if (PART or routed) and not SH:
+        raise ValueError(
+            "partitioned-serve/routed kernels need the model axis"
+        )
+    if routed and not PART:
+        raise ValueError("routed dispatch requires part_serve placement")
 
     perm_programs: Dict[int, List[Tuple[str, int, ExprIR]]] = {}
     for (tname, tid, slot, expr) in plan.topo_programs:
@@ -2657,19 +2740,36 @@ def make_flat_fn(
 
         dm = meta.delta
         me = lax.axis_index(axis) if SH else None
+        # part-serve: every non-e/pf stacked table is whole per device
+        # and its probes resolve ownership arithmetically — the owner-
+        # broadcast/OR sites become identity (SH_VB guards them)
+        SH_VB = SH and not PART
 
         def por(x):
-            """Boolean OR-reduce over the model axis (identity 1-chip)."""
+            """Boolean OR-reduce over the model axis (identity 1-chip,
+            and identity under part-serve, where every non-e/pf probe is
+            locally complete)."""
             return (
-                x if not SH
+                x if not SH_VB
+                else lax.psum(x.astype(jnp.int32), axis).astype(bool)
+            )
+
+        def por_m(x, mine):
+            """OR-reduce for model-split point sites: needed exactly when
+            the probe carried a bucket-ownership mask; a maskless probe
+            was locally complete (1-chip, part-serve whole-resident
+            table, or a routed batch on its owner shard)."""
+            return (
+                x if mine is None
                 else lax.psum(x.astype(jnp.int32), axis).astype(bool)
             )
 
         def vbcast(own, x):
             """Single-owner int32 broadcast over the model axis: exactly
             one shard contributes (its bucket owns the key), the psum of
-            masked values IS the value (identity 1-chip)."""
-            return x if not SH else lax.psum(jnp.where(own, x, 0), axis)
+            masked values IS the value (identity 1-chip; identity under
+            part-serve, where the sliced block is already the owner's)."""
+            return x if not SH_VB else lax.psum(jnp.where(own, x, 0), axis)
 
         def blk_hit(blk, q_cols, mine=None):
             """Exact-key hit mask over a probe block's candidates, with
@@ -2708,20 +2808,47 @@ def make_flat_fn(
                     arrs[off_key], arrs[tbl_key], cap, q_cols
                 ), None
             off, tbl = arrs[off_key], arrs[tbl_key]
+            if PART and tbl_key not in PART_SHARDED_TBLS:
+                # whole-resident stacked table: resolve the owner shard's
+                # block arithmetically (off is the full [M·(bpd+1)]
+                # stacked offsets; rows live at [s·R_pad + local]) — no
+                # ownership mask, no collective.  Overshooting a shard's
+                # padding reads a neighbour's rows, whose keys carry a
+                # different owner and can never equal the probed key
+                bpd = off.shape[0] // model_size - 1
+                R_pad = jnp.int32(tbl.shape[0] // model_size)
+                h = (
+                    mix32(q_cols, jnp) & jnp.uint32(bpd * model_size - 1)
+                ).astype(jnp.int32)
+                s = h // jnp.int32(bpd)
+                start = take_in_bounds(
+                    off, s * jnp.int32(bpd + 1) + (h & jnp.int32(bpd - 1))
+                ) + s * R_pad
+                return slice_blocks(tbl, start, cap), None
             bpd = off.shape[0] - 1
             h = (
                 mix32(q_cols, jnp) & jnp.uint32(bpd * model_size - 1)
             ).astype(jnp.int32)
+            # routed batches sit on their owner shard already, and a
+            # non-owner probe of a model-split table misses by key
+            # construction — no mask, no psum at the site
+            if routed:
+                start = take_in_bounds(off, h & jnp.int32(bpd - 1))
+                return slice_blocks(tbl, start, cap), None
             mine = (h // jnp.int32(bpd)) == me
             start = take_in_bounds(off, h & jnp.int32(bpd - 1))
             return slice_blocks(tbl, start, cap), mine
 
         def range_probe(off_key: str, tbl_key: str, cap: int, q,
-                        rep: bool = False):
+                        rep: bool = False, rows_key: Optional[str] = None):
             """(lo, hi) LOCAL row range of group key ``q``; (0, 0) on a
             miss or on non-owning shards.  ``rep`` marks a REPLICATED
             table (delta overlays): the bucket-ownership math would use
-            the wrong hash mask there, so it probes plainly."""
+            the wrong hash mask there, so it probes plainly.  Under
+            part-serve the group entry's row range is local to its
+            owner's block of the whole-resident stacked rows table
+            (``rows_key``), so the owner's base offset is added — on a
+            miss lo == hi keeps the slice empty."""
             if rep:
                 blk, mine = probe_block(
                     arrs[off_key], arrs[tbl_key], cap, (q,)
@@ -2731,6 +2858,16 @@ def make_flat_fn(
             hit = blk_hit(blk, (q,), mine)
             lo = jnp.max(jnp.where(hit, blk[..., 1], 0), axis=-1)
             hi = jnp.max(jnp.where(hit, blk[..., 2], 0), axis=-1)
+            if PART and not rep and rows_key is not None:
+                goff = arrs[off_key]
+                bpd = goff.shape[0] // model_size - 1
+                R_rows = jnp.int32(arrs[rows_key].shape[0] // model_size)
+                hq = (
+                    mix32((q,), jnp) & jnp.uint32(bpd * model_size - 1)
+                ).astype(jnp.int32)
+                base = (hq // jnp.int32(bpd)) * R_rows
+                lo = lo + base
+                hi = hi + base
             return lo, hi
 
         def range_of(prefix: str, cap: int, n: int, q):
@@ -2739,6 +2876,7 @@ def make_flat_fn(
                     prefix + "_off",
                     {"usr": "usgx", "arr": "argx"}[prefix],
                     cap, q,
+                    rows_key={"usr": "usx", "arr": "arx"}[prefix],
                 )
             ri = {
                 k: arrs[prefix + "_" + k]
@@ -2760,8 +2898,8 @@ def make_flat_fn(
                 )
                 hit = blk_hit(blk, (srck, gk), mine)
                 return (
-                    por(jnp.any(hit & (blk[..., 2] > now), axis=-1)),
-                    por(jnp.any(hit & (blk[..., 3] > now), axis=-1)),
+                    por_m(jnp.any(hit & (blk[..., 2] > now), axis=-1), mine),
+                    por_m(jnp.any(hit & (blk[..., 3] > now), axis=-1), mine),
                 )
             row = probe_rows(
                 arrs["clh_off"], arrs["clh_rows"],
@@ -2827,18 +2965,25 @@ def make_flat_fn(
 
             def csr_slice(k):
                 ok = k >= 0
-                if not SH and meta.pf_s_direct:
+                # part-serve with the direct view: the dense offset
+                # array + split columns are replicated (they are the
+                # COMPACT closure-by-source form — the bucket-hash
+                # group tables cost ~16× the bytes), so the single-chip
+                # two-element-gather path applies on every shard
+                split = (not SH) or (PART and meta.pf_s_direct)
+                if split and meta.pf_s_direct:
                     kc = jnp.where(ok, k, 0)
                     lo = tk(arrs["csr_start"], kc)
                     hi = jnp.where(ok, tk(arrs["csr_start"], kc + 1), lo)
                 else:
                     lo, hi = range_probe(
-                        "csr_off", "csrgx", meta.pf_s_cap, k
+                        "csr_off", "csrgx", meta.pf_s_cap, k,
+                        rows_key="csrx",
                     )
                 valid = (
                     jnp.arange(fanS, dtype=jnp.int32) < (hi - lo)[..., None]
                 ) & ok[..., None]
-                if SH:
+                if not split:
                     blk = slice_blocks(arrs["csrx"], lo, fanS)
                     blk = vbcast(valid[..., None], blk)
                     valid = por(valid)
@@ -2931,7 +3076,8 @@ def make_flat_fn(
                             t = tri(cav, ctxc, qb, tables)
                             hd, hp = live & (t == 2), live & (t >= 1)
                     return (
-                        por(jnp.any(hd, axis=-1)), por(jnp.any(hp, axis=-1))
+                        por_m(jnp.any(hd, axis=-1), mine),
+                        por_m(jnp.any(hp, axis=-1), mine),
                     )
 
                 ed, ep = pe_site(bq(q_k2, nd))
@@ -2945,7 +3091,8 @@ def make_flat_fn(
                 # slice (the Leopard skipping-list read — never the dense
                 # product, never per-group hash probes)
                 fanU = max(meta.pf_u_fan, 1)
-                if not SH and meta.pf_direct:
+                split_u = (not SH) or (PART and meta.pf_direct)
+                if split_u and meta.pf_direct:
                     fc = (
                         tk(pf_fidx_t, jnp.clip(bq(q_perm, nd), 0, None))
                         if slot is None
@@ -2959,12 +3106,13 @@ def make_flat_fn(
                     hi = jnp.where(ok, tk(arrs["pfu_start"], base + 1), lo)
                 else:
                     lo, hi = range_probe(
-                        "pfu_off", "pfugx", meta.pf_u_cap, k1
+                        "pfu_off", "pfugx", meta.pf_u_cap, k1,
+                        rows_key="pfux",
                     )
                 valid = (
                     jnp.arange(fanU, dtype=jnp.int32) < (hi - lo)[..., None]
                 ) & exists[..., None]
-                if SH:
+                if not split_u:
                     ublk = slice_blocks(arrs["pfux"], lo, fanU)
                     ublk = vbcast(valid[..., None], ublk)
                     valid = por(valid)
@@ -3090,8 +3238,8 @@ def make_flat_fn(
                         )
                         hit = blk_hit(blk, (k1, k2q), mine) & exists[..., None]
                         bd, bp = gate2_blk("e", blk, eL, hit)
-                        hd = por(jnp.any(bd, axis=-1))
-                        hp = por(jnp.any(bp, axis=-1))
+                        hd = por_m(jnp.any(bd, axis=-1), mine)
+                        hp = por_m(jnp.any(bp, axis=-1), mine)
                         if dm is not None and dm.has_tombs:
                             tb = probe_block(
                                 arrs["dl_tb_off"], arrs["dl_tbx"],
@@ -3142,8 +3290,14 @@ def make_flat_fn(
                         )
                         hit = blk_hit(blk, (k1, k2q), mine) & exists[..., None]
                         return (
-                            por(jnp.any(hit & (blk[..., 2] > now), axis=-1)),
-                            por(jnp.any(hit & (blk[..., 3] > now), axis=-1)),
+                            por_m(
+                                jnp.any(hit & (blk[..., 2] > now), axis=-1),
+                                mine,
+                            ),
+                            por_m(
+                                jnp.any(hit & (blk[..., 3] > now), axis=-1),
+                                mine,
+                            ),
                         )
                     trow = probe_rows(
                         arrs["th_off"], arrs["th_rows"],
@@ -3239,7 +3393,9 @@ def make_flat_fn(
                     pblk, pmine = pblock(
                         "push_off", "pusx", meta.pus_cap, (gk,)
                     )
-                    in_pus = por(jnp.any(blk_hit(pblk, (gk,), pmine), axis=-1))
+                    in_pus = por_m(
+                        jnp.any(blk_hit(pblk, (gk,), pmine), axis=-1), pmine
+                    )
                     in_d = (in_d | refl) & ~permf
                     in_p = in_p | refl | in_pus | permf
                 else:
@@ -3391,7 +3547,8 @@ def make_flat_fn(
             # rc tables follow the base layout: bucket-sharded under SH
             # (owner-local ranges, broadcast below), plain otherwise
             lo, hi = range_probe(
-                f"rc{ts_slot}_off", f"rc{ts_slot}gx", cap, nq
+                f"rc{ts_slot}_off", f"rc{ts_slot}gx", cap, nq,
+                rows_key=f"rc{ts_slot}x",
             )
             valid = (
                 jnp.arange(fan, dtype=jnp.int32) < (hi - lo)[..., None]
@@ -3575,7 +3732,9 @@ def make_flat_fn(
                     oblk, omine = pblock(
                         "ovfh_off", "ovfx", meta.ovf_cap, (k,)
                     )
-                    return por(jnp.any(blk_hit(oblk, (k,), omine), axis=-1))
+                    return por_m(
+                        jnp.any(blk_hit(oblk, (k,), omine), axis=-1), omine
+                    )
                 return probe_rows(
                     arrs["ovfh_off"], arrs["ovfh_rows"],
                     (arrs["ovf_k"],), (k,), meta.ovf_cap, meta.ovf_n,

@@ -501,12 +501,33 @@ class FeedPartition:
     meta: object  # engine.flat.FlatMeta
     owned: Tuple[int, ...]
     M: int
+    #: fold maintenance state (engine/fold.py FoldState, armed with
+    #: maps/N) when the fold committed — carried onto the DeviceSnapshot
+    #: so incremental prepares keep the fold instead of downgrading
+    fold_state: object = None
 
 
 def _owned_mask_of(owner: np.ndarray, M: int, owned) -> np.ndarray:
     m = np.zeros(M, bool)
     m[np.asarray(owned, np.int64)] = True
     return m[owner.astype(np.int64)]
+
+
+def snapshot_raw_columns(snap, copy: bool = False) -> Dict[str, np.ndarray]:
+    """The raw pre-interned column dict ``partition_feed`` consumes,
+    from a resident Snapshot.  The srel re-encoding (``e_srel1 - 1``,
+    -1 = direct subject) is the feed's convention and is load-bearing —
+    every caller must agree on it, which is why this is THE helper.
+    ``copy=True`` hands the feed private arrays (the feed releases its
+    refs as it goes but callers that keep using the snapshot may want
+    isolation anyway)."""
+    cp = (lambda a: a.copy()) if copy else (lambda a: a)
+    return dict(
+        res=cp(snap.e_res), rel=cp(snap.e_rel), subj=cp(snap.e_subj),
+        srel=(snap.e_srel1.astype(np.int32) - 1),
+        caveat=cp(snap.e_caveat), ctx=cp(snap.e_ctx),
+        exp_us=cp(snap.e_exp_us),
+    )
 
 
 def partition_feed(
@@ -520,6 +541,8 @@ def partition_feed(
     *,
     contexts: Optional[list] = None,
     epoch_us: Optional[int] = None,
+    plan=None,
+    serve: str = "partitioned",
 ) -> Optional[FeedPartition]:
     """Partition a RAW store feed by bucket-shard ownership and prepare
     the stacked flat tables from the local partitions — the multihost
@@ -537,10 +560,34 @@ def partition_feed(
     the feed): the membership subgraph (``finish_snapshot`` over userset
     rows ∪ rows feeding used usersets), the flattened closure, the dense
     slot maps and node radix, the T-index JOIN (its rows partition right
-    after), pus/ovf/closure tables, and every FlatMeta field.  The
-    permission fold and rc flattening are DECLINED on this path (their
-    inputs are the full per-edge views; the walked kernel answers
-    exactly) — the reference build for parity must pass ``plan=None``.
+    after), pus/ovf/closure tables, and every FlatMeta field.
+
+    With ``plan`` (the engine's DevicePlan) the permission FOLD and rc
+    flattening run too: their derivations read the full views through a
+    stub (raw primary columns + the replicated membership snapshot's
+    userset view + the transient global arrow view) and are CANONICAL —
+    dedup sorts by full row identity — so the raw feed order yields the
+    same rows as the sorted reference snapshot, and each owned shard's
+    slice of the pf/pfu/rc stacked tables is then built independently
+    by the same stable owner/local-bucket discipline (bitwise-identical
+    to the full derivation; tests/test_fold_partition.py).  The csr
+    closure-by-source view is replicated like the closure itself.  With
+    ``plan=None`` fold/rc are declined as before (the parity oracle for
+    the walked layout).
+
+    ``serve`` picks the placement the tables are built for:
+
+    - ``"partitioned"`` (default): every O(E)-scale table materializes
+      owned shard slices only — the bitwise-parity layout
+      (``build_flat_arrays_sharded`` with the same plan is the oracle).
+    - ``"routed"``: the owner-routed SERVING layout
+      (FlatMeta.part_serve) — the O(E)-scale point tables (ehx, pfx,
+      tx) keep owned-only slices; the userset/arrow/pfu/rc stacked
+      tables build WHOLE on every process (they are membership- or
+      group-structure-sized, exactly the state the host already
+      replicates), so each device probes them locally and a routed
+      query batch dispatches with no collectives
+      (parallel/sharded.py).
 
     Returns None when the dense keys don't pack into int32 (same bail as
     the builders — such worlds use the legacy engine)."""
@@ -560,23 +607,33 @@ def partition_feed(
         _arrow_data_depth,
         _ceil_pow2,
         _e_cols_at,
+        _fold_packed,
         _groups_of,
         _m_srel1,
         _node_radix,
         _pack,
         _primary_hash_chunked,
+        _rc_build,
         _round_cap,
+        _round_fan,
         _run_maxes,
         _stack_point,
+        _stack_range,
         _tindex_join,
         _uniq_small,
     )
-    from .hash import build_hash
+    from .hash import build_hash, build_range_hash
 
+    if serve not in ("partitioned", "routed"):
+        raise ValueError(f"unknown serve mode {serve!r}")
+    routed = serve == "routed"
     faults.fire("prepare.partition")
     _t0 = _time.perf_counter()
     M = model_size
     owned_t = tuple(range(M)) if owned is None else tuple(sorted(owned))
+    # routed serving replicates the membership/group-structure tables on
+    # every device; only the primary/fold point tables keep owned slices
+    own_small = None if routed else owned_t
     if epoch_us is None:
         epoch_us = int(_time.time() * 1_000_000)
     contexts = contexts or []
@@ -651,13 +708,44 @@ def partition_feed(
     class _Stub:
         pass
 
+    # full-view stub: raw (unsorted) primary columns + the replicated
+    # membership snapshot's userset view + the transient global arrow
+    # view.  fold_permissions/_rc_build read per-edge views through it;
+    # their outputs are CANONICAL (dedup sorts by full row identity), so
+    # the raw feed order yields the same FoldResult/ancestor closures as
+    # the sorted reference snapshot — bitwise
     stub = _Stub()
-    stub.e_rel, stub.us_rel = rel, mem_snap.us_rel
-    stub.ar_rel = ar_full["rel"]
+    stub.compiled, stub.interner = compiled, interner
+    stub.e_rel, stub.e_res, stub.e_subj, stub.e_srel1 = rel, res, subj, srel1
+    stub.e_caveat, stub.e_ctx, stub.e_exp = caveat, ctx, exp32
+    stub.us_rel, stub.us_res = mem_snap.us_rel, mem_snap.us_res
+    stub.us_subj, stub.us_srel = mem_snap.us_subj, mem_snap.us_srel
+    stub.us_caveat, stub.us_ctx = mem_snap.us_caveat, mem_snap.us_ctx
+    stub.us_exp, stub.us_perm = mem_snap.us_exp, mem_snap.us_perm
+    stub.pus_n, stub.pus_r = mem_snap.pus_n, mem_snap.pus_r
+    stub.ar_rel, stub.ar_res = ar_full["rel"], ar_full["res"]
+    stub.ar_child = ar_full["subj"]
+    stub.ar_caveat, stub.ar_ctx = ar_full["caveat"], ar_full["ctx"]
+    stub.ar_exp = ar_full["exp"]
     stub.num_slots, stub.num_nodes = num_slots, mem_snap.num_nodes
-    stub.pus_r, stub.us_srel = mem_snap.pus_r, mem_snap.us_srel
-    stub.ar_res, stub.ar_child = ar_full["res"], ar_full["subj"]
-    maps = _active_maps(stub, cl, ())
+    stub.node_type = mem_snap.node_type
+    stub.wildcard_node_of_type = mem_snap.wildcard_node_of_type
+
+    # permission fold over the full views (engine/fold.py): the
+    # derivation is leaf-/group-structure-shaped; only its TABLES are
+    # stacked below (owned slices on the partitioned layout).  Folded
+    # slots join the k1 radix exactly as in the reference builders
+    fr = fstate = None
+    if plan is not None:
+        from .fold import fold_permissions
+
+        with metrics.default.timer("prepare.fold_s"):
+            got_fold = fold_permissions(stub, config, plan, cl)
+        if got_fold is not None:
+            fr, fstate = got_fold
+    maps = _active_maps(
+        stub, cl, {slot for _, slot in fr.pairs} if fr is not None else ()
+    )
     N = _node_radix(stub, maps)
     if N is None:
         return None
@@ -688,6 +776,19 @@ def partition_feed(
     cl_k2 = _pack(cl.c_g, S1, maps.k2[cl.c_grel] + 1)
     pus_k = _pack(mem_snap.pus_n, S1, maps.k2[mem_snap.pus_r] + 1)
     ovf_k = _pack(cl.ovf_src, S1, _m_srel1(maps, cl.ovf_srel1))
+
+    # fold dense packing + the subject-fan decline, in the reference
+    # builder's exact order, and the rc ancestor closures — both read
+    # the full-view stub, which the primary partition below releases.
+    # Their outputs are self-contained arrays sized by the fold/closure
+    # structure, partitioned into stacked slices further down
+    got = _fold_packed(fr, stub, maps, N, config) if fr is not None else None
+    csr = None
+    if got is not None:
+        csr = build_range_hash(cl_k1, min_size=ms)
+        if int(csr.max_run) > config.flat_fold_subj_fan_cap:
+            got = None
+    rc_built = _rc_build(stub, config, plan, ar_dd)
 
     # ---- primary: hash raw rows chunked, keep only owned ---------------
     h_e = _primary_hash_chunked(
@@ -757,7 +858,9 @@ def partition_feed(
         ar_gkg, ar_glo, ar_ghi, h_arg, gar
     )
     ar_loc = filter_columns(ar_full, ar_rows)
-    del ar_full, ar_gk
+    del ar_gk
+    if not routed:
+        del ar_full  # routed serving stacks the WHOLE arrow view below
 
     # ---- T-index: global join, rows partitioned right after ------------
     tj = _tindex_join(mem_snap, config, cl, us_gk, cl_k1, cl_k2, pus_k, maps)
@@ -789,31 +892,69 @@ def partition_feed(
     )
     del h_own
 
-    us_cols = (
-        [snap.us_subj, maps.k2[snap.us_srel]]
-        + ([snap.us_caveat, snap.us_ctx] if flags["us_hascav"] else [])
-        + ([snap.us_exp] if flags["us_hasexp"] else [])
-        + ([snap.us_perm] if flags["us_hasperm"] else [])
-    )
-    out["usr_off"], out["usgx"], out["usx"] = stack_range(
-        us_l_gk, us_l_glo, us_l_lens, us_l_h,
-        gather_cols(us_cols), gus, len(us_cols), owned=owned_t,
-    )
-    ar_cols = (
-        [snap.ar_child]
-        + ([snap.ar_caveat, snap.ar_ctx] if flags["ar_hascav"] else [])
-        + ([snap.ar_exp] if flags["ar_hasexp"] else [])
-    )
-    out["arr_off"], out["argx"], out["arx"] = stack_range(
-        ar_l_gk, ar_l_glo, ar_l_lens, ar_l_h,
-        gather_cols(ar_cols), gar, len(ar_cols), owned=owned_t,
-    )
+    if routed:
+        # routed serving: the userset/arrow views are membership- and
+        # resource-structure-sized — stack them WHOLE (every device
+        # probes its owner's block arithmetically, no collectives).
+        # The full userset view IS the replicated membership snapshot's
+        us_cols = (
+            [mem_snap.us_subj, maps.k2[mem_snap.us_srel]]
+            + (
+                [mem_snap.us_caveat, mem_snap.us_ctx]
+                if flags["us_hascav"] else []
+            )
+            + ([mem_snap.us_exp] if flags["us_hasexp"] else [])
+            + ([mem_snap.us_perm] if flags["us_hasperm"] else [])
+        )
+        out["usr_off"], out["usgx"], out["usx"] = stack_range(
+            us_gkg, us_glo, us_ghi - us_glo, h_usg,
+            gather_cols(us_cols), gus, len(us_cols),
+        )
+        ar_cols = (
+            [ar_full["subj"]]
+            + (
+                [ar_full["caveat"], ar_full["ctx"]]
+                if flags["ar_hascav"] else []
+            )
+            + ([ar_full["exp"]] if flags["ar_hasexp"] else [])
+        )
+        out["arr_off"], out["argx"], out["arx"] = stack_range(
+            ar_gkg, ar_glo, ar_ghi - ar_glo, h_arg,
+            gather_cols(ar_cols), gar, len(ar_cols),
+        )
+        del ar_full
+    else:
+        us_cols = (
+            [snap.us_subj, maps.k2[snap.us_srel]]
+            + ([snap.us_caveat, snap.us_ctx] if flags["us_hascav"] else [])
+            + ([snap.us_exp] if flags["us_hasexp"] else [])
+            + ([snap.us_perm] if flags["us_hasperm"] else [])
+        )
+        out["usr_off"], out["usgx"], out["usx"] = stack_range(
+            us_l_gk, us_l_glo, us_l_lens, us_l_h,
+            gather_cols(us_cols), gus, len(us_cols), owned=owned_t,
+        )
+        ar_cols = (
+            [snap.ar_child]
+            + ([snap.ar_caveat, snap.ar_ctx] if flags["ar_hascav"] else [])
+            + ([snap.ar_exp] if flags["ar_hasexp"] else [])
+        )
+        out["arr_off"], out["argx"], out["arx"] = stack_range(
+            ar_l_gk, ar_l_glo, ar_l_lens, ar_l_h,
+            gather_cols(ar_cols), gar, len(ar_cols), owned=owned_t,
+        )
 
     t_kw = dict(has_tindex=False, t_cap=4, t_n=8, t_slots=())
     if tj is not None:
         T_k1, T_k2, T_d, T_p, t_slots = tj
         h_T = _hash_cols([T_k1, T_k2])
         gT = point_geom(h_T, M, min_size=ms)
+        # owned slices on BOTH layouts: the T join is O(E·fold)-scale —
+        # the largest table after the primary — so the routed placement
+        # model-splits it like ehx/pfx.  Its bucket geometry differs
+        # from the routing geometry, so T-probing slots are simply not
+        # routable (parallel/sharded.py _routable): they take the psum
+        # fallback, where the ownership-mask probe is exact
         t_own = _owned_mask_of(shard_owner(h_T, gT.size, M), M, owned_t)
         T_cols = [c[t_own] for c in (T_k1, T_k2, T_d, T_p)]
         out["th_off"], out["tx"] = stack_point(
@@ -825,7 +966,7 @@ def partition_feed(
             t_n=_ceil_pow2(max(gT.n, 1)),
             t_slots=t_slots,
         )
-        del tj, T_k1, T_k2, T_d, T_p, h_T, T_cols
+        del tj, T_k1, T_k2, T_d, T_p, h_T, t_own, T_cols
 
     # globally-small tables: full stacked build on every process (their
     # inputs are the replicated closure / pus derivations)
@@ -838,10 +979,116 @@ def partition_feed(
     out["push_off"], out["pusx"] = _stack_point(push, [pus_k], M)
     out["ovfh_off"], out["ovfx"] = _stack_point(ovfh, [ovf_k], M)
 
+    # ---- permission fold (P-index): owned slices of the pf point
+    # table + pfu range view; the csr closure-by-source view replicates
+    # like the closure it is derived from --------------------------------
+    fold_kw: Dict = {}
+    if got is not None:
+        pf_k1, pf_k2, pf_subj, (u_k1, u_gk, u_until, u_fan), pff = got
+        pf_cols = (
+            [pf_k1, pf_k2]
+            + ([fr.e_cav, fr.e_ctx] if pff["pf_hascav"] else [])
+            + ([fr.e_until] if pff["pf_hasuntil"] else [])
+        )
+        h_pf = _hash_cols([pf_k1, pf_k2])
+        gpf = point_geom(h_pf, M, min_size=ms)
+        out["pfh_off"], out["pfx"] = stack_point(
+            h_pf, gather_cols(pf_cols), gpf, len(pf_cols), owned=owned_t
+        )
+        s_fan = _round_fan(max(int(csr.max_run), 1))
+        extra: Dict = {}
+        direct_ok = False
+        if routed:
+            # routed serving replicates the fold's subject-side views:
+            # prefer the COMPACT single-chip form (dense ``pfu_start`` /
+            # ``csr_start`` offset arrays + split 1-wide columns — the
+            # bucket-hash group tables cost ~16× the bytes per row, all
+            # of it replicated on this placement)
+            from .flat import _pf_view_tables
+
+            fold_slots = tuple(sorted({s for _, s in fr.pairs}))
+            pf_arrays, pf_kw = _pf_view_tables(
+                u_k1, u_gk, u_until, u_fan,
+                cl_k1, cl_k2, cl.c_d_until, cl.c_p_until, s_fan,
+                maps=maps, N=N, S1=S1, fold_slots=fold_slots,
+                config=config,
+            )
+            direct_ok = pf_kw["pf_direct"] and pf_kw["pf_s_direct"]
+            if direct_ok:
+                out.update(pf_arrays)
+                extra = pf_kw
+        if not direct_ok:
+            # stacked group views: owned slices on the partitioned
+            # layout, whole on the routed one (key space over the
+            # direct budget)
+            pfu_gkg, pfu_glo, pfu_ghi = _groups_of(u_k1)
+            h_pfu = _hash_cols([pfu_gkg])
+            gpfu = range_geom(
+                pfu_gkg, pfu_ghi - pfu_glo, h_pfu, M, min_size=ms,
+                fan_pad=max(64, u_fan),
+            )
+            out["pfu_off"], out["pfugx"], out["pfux"] = stack_range(
+                pfu_gkg, pfu_glo, pfu_ghi - pfu_glo, h_pfu,
+                gather_cols([u_gk, u_until]), gpfu, 2, owned=own_small,
+            )
+            out["csr_off"], out["csrgx"], out["csrx"], csr_cap = _stack_range(
+                csr, [cl_k2, cl.c_d_until, cl.c_p_until], M, max(64, s_fan)
+            )
+            extra = dict(
+                pf_u_cap=_round_cap(gpfu.cap),
+                pf_s_cap=_round_cap(csr_cap),
+            )
+        fold_kw = dict(
+            fold_pairs=fr.pairs,
+            pf_e_cap=_round_cap(gpf.cap),
+            pf_u_fan=u_fan,
+            pf_s_fan=s_fan,
+            pf_haswc=bool(np.isin(pf_subj, wc_nodes).any()),
+            pf_has_e=pf_k1.shape[0] > 0,
+            pf_has_u=u_k1.shape[0] > 0,
+            **extra,
+            **pff,
+        )
+        # arm the maintenance state with the packing context it needs
+        # at delta time (fold_delta_update), exactly like the reference
+        # builders — without it the first incremental prepare would
+        # sticky-downgrade the fold (pf_off) and unroute folded slots
+        fstate.maps, fstate.N = maps, N
+    else:
+        fstate = None
+
+    # ---- rc ancestor closures: owned slices of each range view ---------
+    rc_list = []
+    for ts_slot, (src, anc, d_u, p_u, fan) in rc_built.items():
+        rc_gk, rc_glo, rc_ghi = _groups_of(src)
+        h_rc = _hash_cols([rc_gk])
+        grc = range_geom(
+            rc_gk, rc_ghi - rc_glo, h_rc, M, min_size=ms,
+            fan_pad=max(64, fan),
+        )
+        (
+            out[f"rc{ts_slot}_off"],
+            out[f"rc{ts_slot}gx"],
+            out[f"rc{ts_slot}x"],
+        ) = stack_range(
+            rc_gk, rc_glo, rc_ghi - rc_glo, h_rc,
+            gather_cols([anc, d_u, p_u]), grc, 3, owned=own_small,
+        )
+        rc_list.append((int(ts_slot), _round_cap(grc.cap), fan))
+
+    # routing/attribution gauge: how many primary rows this process's
+    # owned shards materialized (the O(E·owned/M) share of the feed)
+    metrics.default.set_gauge(
+        "partition.owned_rows", float(int(snap.e_rel.shape[0]))
+    )
+
     meta = FlatMeta(
         N=N, S1=S1,
         k1_dense=tuple(int(x) for x in maps.k1),
         k2_dense=tuple(int(x) for x in maps.k2),
+        **fold_kw,
+        rc_slots=tuple(sorted(rc_list)),
+        part_serve=routed,
         e_cap=_round_cap(ge.cap), e_n=_ceil_pow2(max(ge.n, 1)),
         usr_cap=_round_cap(gus.cap),
         usr_gn=8,
@@ -873,5 +1120,6 @@ def partition_feed(
         "prepare.partition_s", _time.perf_counter() - _t0
     )
     return FeedPartition(
-        snapshot=snap, arrays=out, meta=meta, owned=owned_t, M=M
+        snapshot=snap, arrays=out, meta=meta, owned=owned_t, M=M,
+        fold_state=fstate,
     )

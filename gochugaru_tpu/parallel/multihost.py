@@ -197,13 +197,12 @@ def _worker_main() -> None:
     # (engine/partition.py), and the dispatch must verify identically
     part_checked = -1
     if os.environ.get("GOCHUGARU_DRYRUN_PARTITION", "1") == "1":
-        from gochugaru_tpu.engine.partition import partition_feed
-
-        cols = dict(
-            res=snap.e_res, rel=snap.e_rel, subj=snap.e_subj,
-            srel=snap.e_srel1.astype(np.int32) - 1,
-            caveat=snap.e_caveat, ctx=snap.e_ctx, exp_us=snap.e_exp_us,
+        from gochugaru_tpu.engine.partition import (
+            partition_feed,
+            snapshot_raw_columns,
         )
+
+        cols = snapshot_raw_columns(snap)
         part = partition_feed(
             snap.revision, cs, snap.interner, cols, engine.config,
             engine.model_size, owned=owned_model_shards(mesh),

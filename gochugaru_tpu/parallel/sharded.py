@@ -125,9 +125,33 @@ class ShardedEngine(DeviceEngine):
             return P()
         return P(MODEL_AXIS)
 
-    def _flat_sharded_fn(self, slots: Tuple[int, ...], meta, arr_keys):
-        """Cache of shard_mapped flat kernels per (slots, meta, keys)."""
-        key = (slots, meta, arr_keys)
+    @staticmethod
+    def _part_spec_of(key: str):
+        """Partitioned-serve placement (FlatMeta.part_serve): the
+        O(E)-scale point tables (primary, fold, T join) split along the
+        model axis; every other stacked table is membership-/group-
+        structure-sized and resident whole per device (the kernel
+        resolves their bucket owners arithmetically — no collective at
+        those sites)."""
+        from ..engine.flat import PART_SHARDED_KEYS
+
+        return P(MODEL_AXIS) if key in PART_SHARDED_KEYS else P()
+
+    def _spec_fn_for(self, meta):
+        return self._part_spec_of if (
+            meta is not None and meta.part_serve
+        ) else self._flat_spec_of
+
+    def _flat_sharded_fn(
+        self, slots: Tuple[int, ...], meta, arr_keys, routed: bool = False
+    ):
+        """Cache of shard_mapped flat kernels per (slots, meta, keys,
+        routed).  A ROUTED kernel takes the query matrix split along the
+        model axis (each shard holds exactly the queries whose root
+        bucket it owns) and compiles with no collectives; the plain
+        kernel replicates the batch along model and psums the e/pf
+        sites (part_serve) or every site (classic stacked layout)."""
+        key = (slots, meta, arr_keys, routed)
         fn = self._flat_sharded_fns.get(key)
         if fn is not None:
             return fn
@@ -137,18 +161,21 @@ class ShardedEngine(DeviceEngine):
             self.compiled, self.plan, self.config, meta, slots,
             caveat_plan=self.caveat_plan, jit=False,
             axis=MODEL_AXIS, model_size=self.model_size,
+            routed=routed,
         )
-        arr_spec = {k: self._flat_spec_of(k) for k in arr_keys}
+        spec_of = self._spec_fn_for(meta)
+        arr_spec = {k: spec_of(k) for k in arr_keys}
         qctx_spec = {k: P() for k in ("vi", "vf", "pr", "host")}
+        batch_axis = MODEL_AXIS if routed else DATA_AXIS
         in_specs = (
             arr_spec, P(), P(),  # arrays, tid_map, now
-            P(None, DATA_AXIS),  # packed query matrix (flat.QM_LAYOUT)
+            P(None, batch_axis),  # packed query matrix (flat.QM_LAYOUT)
             qctx_spec,
         )
         fn = jax.jit(
             shard_map(
                 raw, mesh=self.mesh, in_specs=in_specs,
-                out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+                out_specs=(P(batch_axis),) * 3,
                 **_SHARD_MAP_NO_CHECK,
             )
         )
@@ -156,6 +183,33 @@ class ShardedEngine(DeviceEngine):
             self._flat_sharded_fns.pop(next(iter(self._flat_sharded_fns)))
         self._flat_sharded_fns[key] = fn
         return fn
+
+    def _routable(self, meta, slots) -> bool:
+        """A batch owner-routes iff every root probe a query can make is
+        local on its owner shard: all slots are either fully folded
+        permissions (pf probe pair) or bare relation leaves (dynamic
+        e/KU sites keyed by the query's own (k1, k2)); wildcard edges
+        probe a SECOND e/pf bucket whose owner differs, so worlds with
+        them keep the psum path.  T-probing slots (meta.t_slots) are
+        unroutable too: the T join is model-split under part-serve and
+        its bucket geometry differs from the routing geometry, so only
+        the psum path's ownership-mask probe is exact there (the KU
+        walk those slots compile alongside probes whole-resident
+        membership tables and stays local)."""
+        if meta.has_wc_edges or meta.pf_haswc:
+            return False
+        if meta.has_tindex and any(s in meta.t_slots for s in slots):
+            return False
+        dm = meta.delta
+        fold_on = bool(meta.fold_pairs) and not (
+            dm is not None and dm.pf_off
+        )
+        folded = frozenset(meta.fold_pairs) if fold_on else frozenset()
+        unfolded = {
+            s for (tname, _tid, s, _e) in self.plan.topo_programs
+            if (tname, s) not in folded
+        }
+        return all(s not in unfolded for s in slots)
 
     # -- snapshot preparation: pad every view to a multiple of model_size --
     def prepare(
@@ -219,10 +273,17 @@ class ShardedEngine(DeviceEngine):
         materializes the full table on any host.  Replicated tables
         (node types, contexts, dl_* — and the closure-derived stacks,
         which every process builds whole from the replicated membership
-        subgraph) ship via the ordinary replicated device_put."""
+        subgraph) ship via the ordinary replicated device_put.
+
+        A ``serve="routed"`` feed (FlatMeta.part_serve) places the
+        O(E)-scale point tables (primary, fold, T join) model-split —
+        genuinely disjoint per-device slices, O(E/M) HBM each — and
+        everything else whole per device, so owner-routed batches
+        dispatch with no collectives (``_dispatch_flat_routed``)."""
         from ..engine.partition import ShardSlices
 
         snap = part.snapshot
+        spec_of = self._spec_fn_for(part.meta)
         host = dict(part.arrays)
         host["node_type"] = _pad_payload(
             snap.node_type, _ceil_pow2(2 * snap.num_nodes), -1
@@ -231,7 +292,7 @@ class ShardedEngine(DeviceEngine):
         host.update(ectx)
         arrays = {}
         for k, v in host.items():
-            sh = NamedSharding(self.mesh, self._flat_spec_of(k))
+            sh = NamedSharding(self.mesh, spec_of(k))
             if isinstance(v, ShardSlices):
                 cb = v.block_for
             else:
@@ -255,7 +316,38 @@ class ShardedEngine(DeviceEngine):
             snapshot=snap,
             strings=strings,
             flat_meta=part.meta,
+            fold_state=part.fold_state,
         )
+
+    def prepare_snapshot_partitioned(
+        self, snap: Snapshot, prev: Optional[DeviceSnapshot] = None
+    ) -> DeviceSnapshot:
+        """Partitioned (owner-routed) serve from a resident Snapshot —
+        the client's ``with_mesh(partitioned=True)`` path: feed the
+        snapshot's raw columns through ``partition_feed(serve="routed")``
+        and place with ``prepare_partitioned``.  The incremental path
+        rides the partitioned base tables like any sharded snapshot;
+        worlds the feed declines (keys past the int32 pack) fall back to
+        the ordinary sharded prepare."""
+        if prev is not None:
+            out = self._prepare_delta(snap, prev)
+            if out is not None:
+                out.source_snapshot = snap
+                return out
+        from ..engine.partition import partition_feed, snapshot_raw_columns
+
+        raw = snapshot_raw_columns(snap)
+        part = partition_feed(
+            snap.revision, snap.compiled, snap.interner, raw,
+            self.config, self.model_size,
+            contexts=snap.contexts, epoch_us=snap.epoch_us,
+            plan=self.plan, serve="routed",
+        )
+        if part is None:
+            return self.prepare(snap)
+        out = self.prepare_partitioned(part)
+        out.source_snapshot = snap
+        return out
 
     def _delta_prev_ok(self, prev: DeviceSnapshot) -> bool:
         # the sharded incremental prepare rides bucket-sharded base tables
@@ -312,22 +404,36 @@ class ShardedEngine(DeviceEngine):
         now_us: Optional[int],
         fetch: bool = True,
         bucket_min: int = 0,
+        span=_trace.NOOP,
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Dispatch over the bucket-sharded flat tables: queries partition
         along the data axis; the kernel's probe sites OR-reduce over the
-        model axis internally (engine/flat.py make_flat_fn with axis)."""
+        model axis internally (engine/flat.py make_flat_fn with axis).
+        On a partitioned-serve snapshot (FlatMeta.part_serve), batches
+        whose slot set is routable are owner-routed instead — each model
+        shard evaluates only the queries whose root bucket it owns, with
+        no collective in the compiled program."""
         faults.fire("sharded.collective")
         snap = dsnap.snapshot
         D = self.data_size
         B = queries["q_res"].shape[0]
-        per = _ceil_pow2(
-            -(-B // D), max(bucket_min, self.config.batch_bucket_min)
-        )
-        BP = per * D
 
         all_slots = sorted(
             {int(s) for s in np.unique(queries["q_perm"]) if s >= 0}
         )
+        meta = dsnap.flat_meta
+        if (
+            meta.part_serve and D == 1 and fetch
+            and self._routable(meta, all_slots)
+        ):
+            return self._dispatch_flat_routed(
+                dsnap, queries, qctx, now_us, all_slots,
+                bucket_min=bucket_min, span=span,
+            )
+        per = _ceil_pow2(
+            -(-B // D), max(bucket_min, self.config.batch_bucket_min)
+        )
+        BP = per * D
         now = jnp.int32(snap.now_rel32(now_us))
         # packed query matrix (flat.QM_LAYOUT): batch rides axis 1, which
         # partitions over the data axis — ONE sharded transfer; the rare
@@ -393,6 +499,116 @@ class ShardedEngine(DeviceEngine):
         d, p, ovf = jax.device_get((d, p, ovf))
         return d[:B], p[:B], ovf[:B]
 
+    def _dispatch_flat_routed(
+        self,
+        dsnap: DeviceSnapshot,
+        queries: Dict[str, np.ndarray],
+        qctx: Dict[str, np.ndarray],
+        now_us: Optional[int],
+        all_slots,
+        bucket_min: int = 0,
+        span=_trace.NOOP,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Owner-routed dispatch over a partitioned-serve snapshot: each
+        query is hashed by its root (k1, k2) bucket on the HOST and
+        grouped to its owner shard before H2D, so each device dispatches
+        only against its owned primary/fold slices — O(E/M) HBM per
+        device — and the compiled program contains no collective (the
+        membership/group tables are whole per device; engine/flat.py
+        make_flat_fn routed=True).  Folded-slot queries route by the pf
+        geometry, everything else by the primary geometry — same mix32,
+        different modulus.  The model-split T join is never probed here:
+        _routable keeps T-probing slots on the psum path."""
+        import time as _time
+
+        from ..engine.flat import QM_ROWS, _dense_np
+        from ..engine.hash import mix32
+        from ..engine.partition import shard_owner
+        from ..utils import metrics as _metrics
+
+        meta = dsnap.flat_meta
+        M = self.model_size
+        B = queries["q_res"].shape[0]
+        _t0 = _time.perf_counter()
+        qmh = build_qm(queries, B, meta)  # [8, B] dense-mapped host matrix
+        k1 = (qmh[7].astype(np.int64) * meta.N + qmh[0]).astype(np.int32)
+        k2 = (qmh[2].astype(np.int64) * meta.S1 + qmh[3]).astype(np.int32)
+        h = mix32([k1, k2], np)
+        e_size = (
+            int(dsnap.arrays["eh_off"].shape[0]) // M - 1
+        ) * M
+        owner = shard_owner(h, e_size, M).astype(np.int64)
+        pf_slots = sorted({s for _, s in meta.fold_pairs})
+        if pf_slots and "pfh_off" in dsnap.arrays:
+            pf_size = (
+                int(dsnap.arrays["pfh_off"].shape[0]) // M - 1
+            ) * M
+            pf_owner = shard_owner(h, pf_size, M).astype(np.int64)
+            is_pf = np.isin(qmh[1], np.asarray(pf_slots, np.int32))
+            owner = np.where(is_pf, pf_owner, owner)
+        # invalid / self queries probe nothing that needs locality
+        owner = np.where((qmh[0] < 0) | (qmh[1] < 0), 0, owner)
+        counts = np.bincount(owner, minlength=M)
+        per = _ceil_pow2(
+            int(counts.max()), max(bucket_min, self.config.batch_bucket_min)
+        )
+        order = np.argsort(owner, kind="stable")
+        starts = np.cumsum(counts) - counts
+        pos = np.arange(B, dtype=np.int64) - np.repeat(starts, counts)
+        dst = np.empty(B, np.int64)
+        dst[order] = owner[order] * per + pos
+        qm_r = np.full((QM_ROWS, M * per), -1, np.int32)
+        qm_r[3] = qm_r[6] = 0
+        qm_r[:, dst] = qmh
+        route_s = _time.perf_counter() - _t0
+        _metrics.default.observe("dispatch.route_s", route_s)
+        span.event(
+            "route",
+            shard_batches=[int(c) for c in counts],
+            pad_per_shard=int(per),
+            exchange_bytes=int(qm_r.nbytes),
+        )
+
+        # NOTE: no faults.fire here — _dispatch_flat already fired
+        # "sharded.collective" for this dispatch before routing; firing
+        # again would double-count injections on the routed path
+        now = jnp.int32(dsnap.snapshot.now_rel32(now_us))
+        dsh = NamedSharding(self.mesh, P(None, MODEL_AXIS))
+        rep = NamedSharding(self.mesh, P())
+        qctx_dev = {k: jax.device_put(v, rep) for k, v in qctx.items()}
+        arr_keys = tuple(sorted(dsnap.arrays.keys()))
+        cap = max(self.config.flat_max_slots, 1)
+        k1d = _dense_np(meta.k1_dense)
+        d = p = ovf = None
+        for at in range(0, max(len(all_slots), 1), cap):
+            chunk = tuple(all_slots[at : at + cap])
+            if len(all_slots) > cap:
+                # multi-chunk: splice the slot rows on the ROUTED layout
+                # host-side (rare path — distinct permissions > cap)
+                qmc_h = qm_r.copy()
+                pc = qm_r[1]
+                keep = np.isin(pc, np.asarray(chunk, np.int32))
+                qmc_h[1] = np.where(keep, pc, -1)
+                qmc_h[7] = np.where(
+                    keep & (pc >= 0),
+                    k1d[np.clip(pc, 0, k1d.shape[0] - 1)], -1,
+                ).astype(np.int32)
+                qm_dev = jax.device_put(qmc_h, dsh)
+            else:
+                qm_dev = jax.device_put(qm_r, dsh)
+            fn = self._flat_sharded_fn(chunk, meta, arr_keys, routed=True)
+            cd, cp, covf = fn(
+                dsnap.arrays, dsnap.tid_map, now, qm_dev, qctx_dev,
+            )
+            d = cd if d is None else d | cd
+            p = cp if p is None else p | cp
+            ovf = covf if ovf is None else ovf | covf
+        d, p, ovf = jax.device_get((d, p, ovf))
+        span.event("unroute")
+        return (
+            np.asarray(d)[dst], np.asarray(p)[dst], np.asarray(ovf)[dst]
+        )
+
     def _dispatch_columns(
         self,
         dsnap: DeviceSnapshot,
@@ -423,7 +639,7 @@ class ShardedEngine(DeviceEngine):
                 with _trace.annotate_dispatch(span):
                     return self._dispatch_flat(
                         dsnap, queries, qctx, now_us, fetch,
-                        bucket_min=bucket_min,
+                        bucket_min=bucket_min, span=ssp,
                     )
             snap = dsnap.snapshot
             D = self.data_size

@@ -58,3 +58,117 @@ def test_compile_errors():
         compile_cel("t", {"a": "wat"}, "a > 1")  # unknown type
     with pytest.raises(CelCompileError):
         compile_cel("t", {"a": "int"}, "a @ 1")  # bad char
+
+
+def test_timestamp_duration_host_evaluation():
+    """timestamp()/duration() constructors compute on the host: the CEL
+    time algebra (ts − ts = dur, ts ± dur = ts, dur ± dur = dur) plus
+    comparisons, with declared params coerced from RFC 3339 / Go
+    duration strings, datetimes, and numeric seconds."""
+    import datetime as dt
+
+    p = {"at": "timestamp"}
+    assert ev('at < timestamp("2024-06-01T00:00:00Z")', p,
+              {"at": "2024-01-01T00:00:00Z"}) is True
+    assert ev('at < timestamp("2024-06-01T00:00:00Z")', p,
+              {"at": "2024-12-01T00:00:00Z"}) is False
+    assert ev('at < timestamp("2024-06-01T00:00:00Z")', p, {}) is UNKNOWN
+    # datetime and epoch-seconds coercion
+    t0 = dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc)
+    assert ev('at >= timestamp("2024-01-01T00:00:00Z")', p, {"at": t0}) is True
+    assert ev('at == timestamp("2024-01-01T00:00:00Z")', p,
+              {"at": t0.timestamp()}) is True
+    # offsets (RFC 3339 with numeric zone)
+    assert ev('at == timestamp("2024-01-01T02:00:00+02:00")', p,
+              {"at": t0}) is True
+
+    d = {"age": "duration"}
+    assert ev('age <= duration("1h30m")', d, {"age": "45m"}) is True
+    assert ev('age <= duration("1h30m")', d, {"age": "2h"}) is False
+    assert ev('age == duration("90s")', d, {"age": 90}) is True
+    assert ev('age == duration("-2m")', d, {"age": "-2m"}) is True
+    assert ev('age == duration("1.5s")', d, {"age": 1.5}) is True
+
+    # the algebra
+    both = {"start": "timestamp", "now": "timestamp"}
+    expr = 'now - start < duration("30m") && now >= start'
+    assert ev(expr, both, {
+        "start": t0, "now": t0 + dt.timedelta(minutes=10)}) is True
+    assert ev(expr, both, {
+        "start": t0, "now": t0 + dt.timedelta(hours=1)}) is False
+    assert ev(expr, both, {"start": t0}) is UNKNOWN
+    assert ev('timestamp("2024-01-01T01:00:00Z")'
+              ' - timestamp("2024-01-01T00:00:00Z") == duration("1h")',
+              {}, {}) is True
+    assert ev('timestamp("2024-01-01T00:00:00Z") + duration("1h")'
+              ' == timestamp("2024-01-01T01:00:00Z")', {}, {}) is True
+    assert ev('duration("1h") - duration("30m") == duration("30m")',
+              {}, {}) is True
+
+
+def test_timestamp_duration_compile_errors():
+    with pytest.raises(CelCompileError):
+        compile_cel("t", {}, 'frobnicate("x")')
+    # literal constructor arguments validate at COMPILE time (schema
+    # write), not on the first live check
+    with pytest.raises(CelCompileError):
+        compile_cel("t", {}, 'timestamp("not a time")')
+    with pytest.raises(CelCompileError):
+        compile_cel("t", {}, 'duration("3 parsecs")')
+    with pytest.raises(CelCompileError):
+        compile_cel("t", {}, "timestamp() == timestamp()")  # arity
+    with pytest.raises(CelCompileError):
+        compile_cel("t", {}, 'duration("1h", "2h") == duration("1h")')
+    with pytest.raises(CelCompileError):
+        compile_cel("t", {}, 'timestamp("a" "b") < timestamp("c")')
+    with pytest.raises(CelCompileError):
+        compile_cel("t", {}, 'duration(5) == duration("5s")')  # non-str
+    with pytest.raises(CelCompileError):
+        # comparing a timestamp against a bare number is a type error
+        compile_cel(
+            "t", {"at": "timestamp"}, "at < 5"
+        ).evaluate({"at": "2024-01-01T00:00:00Z"})
+
+
+def test_duration_literal_strictness():
+    """Go/CEL reject bare signs and interior-signed parts — a malformed
+    stored context must ERROR, never coerce to a grantable zero."""
+    from gochugaru_tpu.caveats.cel import parse_duration
+
+    assert parse_duration("0").us == 0
+    assert parse_duration("-1h30m").us == -5_400_000_000
+    for bad in ("-", "+", "", "1h-30m", "-1h-30m", "1h+30m", "h", "1x"):
+        with pytest.raises(CelCompileError):
+            parse_duration(bad)
+    # through the evaluator: a declared duration param with a malformed
+    # value raises instead of silently comparing as zero
+    with pytest.raises(CelCompileError):
+        ev('age <= duration("1h")', {"age": "duration"}, {"age": "-"})
+    # bool is an int subtype but a True/False time value is garbage —
+    # must ERROR, never coerce to the epoch / zero duration
+    with pytest.raises(CelCompileError):
+        ev('age <= duration("1h")', {"age": "duration"}, {"age": False})
+    with pytest.raises(CelCompileError):
+        ev('at < timestamp("2024-06-01T00:00:00Z")',
+           {"at": "timestamp"}, {"at": False})
+
+
+def test_timestamp_caveat_declines_device_lowering():
+    """Caveats computing with timestamps stay host-only: the device VM
+    must decline them (ROADMAP: host first), so a schema carrying one
+    still serves — the caveat resolves through the host oracle."""
+    from gochugaru_tpu.caveats.device import build_caveat_plan
+    from gochugaru_tpu.schema import compile_schema, parse_schema
+
+    cs = compile_schema(parse_schema("""
+    caveat not_expired(deadline timestamp, now timestamp) {
+        now < deadline
+    }
+    definition user {}
+    definition doc {
+        relation reader: user with not_expired
+        permission view = reader
+    }
+    """))
+    plan = build_caveat_plan(cs)
+    assert not plan.has_device_programs

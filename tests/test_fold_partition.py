@@ -1,0 +1,581 @@
+"""Shard-local fold/rc derivations + owner-routed serving.
+
+Part 1 — bitwise parity: with a DevicePlan, ``partition_feed`` now
+derives the permission fold (pfx / pfu / csr) and the rc ancestor
+closures from the raw feed (full views through a stub; the derivations
+are canonical, so the unsorted feed order yields the same rows as the
+sorted reference snapshot) and stacks each owned shard's slice
+independently.  The merged result must be BITWISE-identical — array for
+array plus FlatMeta equality — to the full build-then-stack derivation
+(``build_flat_arrays_sharded`` with the legacy path) on randomized
+worlds with caveats, wildcards, closure overflow, and the T-join
+engaged.
+
+Part 2 — owner-routed serving: a ``serve="routed"`` feed through
+``ShardedEngine.prepare_partitioned`` keeps only the primary/fold point
+tables model-split (O(E/M) per device) and dispatches owner-routed
+batches with no collectives; results must match the single-chip engine
+exactly and the host oracle.  Batches whose slot set is not routable
+(walked programs, wildcard worlds) fall back to the psum path on the
+same snapshot and must match too."""
+
+import random
+
+import numpy as np
+import pytest
+
+from test_prepare_parity import NOW, SCHEMA, _random_world
+
+from gochugaru_tpu import rel
+from gochugaru_tpu.engine.device import DeviceEngine
+from gochugaru_tpu.engine.flat import build_flat_arrays_sharded
+from gochugaru_tpu.engine.partition import (
+    ShardSlices,
+    partition_feed,
+    snapshot_raw_columns,
+)
+from gochugaru_tpu.engine.plan import EngineConfig
+from gochugaru_tpu.schema import compile_schema, parse_schema
+from gochugaru_tpu.store.interner import Interner
+from gochugaru_tpu.store.snapshot import (
+    build_snapshot,
+    build_snapshot_from_columns,
+    relationships_to_raw_columns,
+)
+
+NOWUS = NOW
+
+
+def _as_full(v):
+    return v.to_full() if isinstance(v, ShardSlices) else v
+
+
+def _build_both(rels, cs, M, **cfg_kw):
+    """(partition_feed arrays/meta, legacy reference arrays/meta) at the
+    same feed, both WITH the device plan (fold/rc engaged)."""
+    itn = Interner()
+    raw, contexts = relationships_to_raw_columns(cs, itn, rels)
+    snap = build_snapshot_from_columns(
+        1, cs, itn, contexts=contexts, epoch_us=NOW,
+        **{k: v.copy() for k, v in raw.items()},
+    )
+    eng = DeviceEngine(cs, EngineConfig.for_schema(cs, **cfg_kw))
+    legacy = EngineConfig.for_schema(
+        cs, flat_partition_build=False, **cfg_kw
+    )
+    built = build_flat_arrays_sharded(snap, legacy, M, plan=eng.plan)
+    assert built is not None
+    ref_arrays, ref_meta, _f, _c = built
+    cfg = EngineConfig.for_schema(cs, **cfg_kw)
+    part = partition_feed(
+        1, cs, itn, raw, cfg, M, contexts=contexts, epoch_us=NOW,
+        plan=eng.plan,
+    )
+    assert part is not None
+    return part, ref_arrays, ref_meta
+
+
+def _assert_bitwise(part, ref_arrays, ref_meta):
+    assert set(part.arrays) == set(ref_arrays), (
+        set(part.arrays) ^ set(ref_arrays)
+    )
+    for k in sorted(ref_arrays):
+        got = _as_full(part.arrays[k])
+        assert got.shape == ref_arrays[k].shape, k
+        assert np.array_equal(got, ref_arrays[k]), f"table {k} differs"
+    assert part.meta == ref_meta, "FlatMeta differs"
+
+
+@pytest.mark.parametrize("seed,M", [(7, 2), (23, 4)])
+def test_fold_partition_bitwise_parity(seed, M):
+    """Randomized world (caveats with contexts, wildcards, userset
+    chains, expirations, the T-join): the partitioned fold tables merge
+    bitwise-identical to the full derivation."""
+    rels = _random_world(seed, 50_000)
+    cs = compile_schema(parse_schema(SCHEMA))
+    part, ref_arrays, ref_meta = _build_both(rels, cs, M)
+    assert ref_meta.fold_pairs, "world must actually fold something"
+    assert any(k.startswith("pf") for k in ref_arrays)
+    _assert_bitwise(part, ref_arrays, ref_meta)
+
+
+def test_fold_partition_parity_with_closure_overflow():
+    """Small closure cap: overflow sources disable the fold (the
+    builders must agree on the decline, and the ovf tables still merge
+    bitwise)."""
+    rels = _random_world(3, 40_000)
+    cs = compile_schema(parse_schema(SCHEMA))
+    part, ref_arrays, ref_meta = _build_both(
+        rels, cs, 2, closure_source_cap=12
+    )
+    assert ref_meta.has_ovf
+    _assert_bitwise(part, ref_arrays, ref_meta)
+
+
+RC_SCHEMA = """
+definition user {}
+definition folder {
+    relation parent: folder
+    relation viewer: user
+    permission view = viewer + parent->view
+}
+"""
+
+
+def _folder_world(depth: int, chains: int, seed: int = 5):
+    rng = random.Random(seed)
+    rels = []
+    for c in range(chains):
+        for d in range(1, depth):
+            rels.append(rel.Relationship(
+                resource_type="folder", resource_id=f"c{c}f{d}",
+                resource_relation="parent",
+                subject_type="folder", subject_id=f"c{c}f{d - 1}",
+            ))
+        for _ in range(6):
+            rels.append(rel.Relationship(
+                resource_type="folder",
+                resource_id=f"c{c}f{rng.randrange(depth)}",
+                resource_relation="viewer",
+                subject_type="user", subject_id=f"u{rng.randrange(40)}",
+            ))
+    return rels
+
+
+@pytest.mark.parametrize("M", [2, 4])
+def test_rc_partition_bitwise_parity(M):
+    """Deep recursive folder hierarchy past the unroll budget: the rc
+    ancestor-closure tables (fold disabled so the rc path is the one
+    being compared) merge bitwise-identical to the full derivation."""
+    rels = _folder_world(depth=14, chains=40)
+    cs = compile_schema(parse_schema(RC_SCHEMA))
+    part, ref_arrays, ref_meta = _build_both(rels, cs, M, flat_fold=False)
+    assert ref_meta.rc_slots, "world must engage the rc index"
+    _assert_bitwise(part, ref_arrays, ref_meta)
+
+
+def test_fold_partition_owned_subset_slices():
+    """Owned-subset runs materialize exactly the owned slices of the
+    fold/rc stacked tables."""
+    M = 4
+    rels = _random_world(9, 30_000)
+    cs = compile_schema(parse_schema(SCHEMA))
+    itn = Interner()
+    raw, contexts = relationships_to_raw_columns(cs, itn, rels)
+    eng = DeviceEngine(cs, EngineConfig.for_schema(cs))
+    cfg = EngineConfig.for_schema(cs)
+    full = partition_feed(
+        1, cs, itn, {k: v.copy() for k, v in raw.items()}, cfg, M,
+        contexts=contexts, epoch_us=NOW, plan=eng.plan,
+    )
+    owned = (0, 2)
+    part = partition_feed(
+        1, cs, itn, {k: v.copy() for k, v in raw.items()}, cfg, M,
+        owned=owned, contexts=contexts, epoch_us=NOW, plan=eng.plan,
+    )
+    assert full.meta == part.meta
+    assert full.meta.fold_pairs
+    saw_fold_slices = False
+    for k, v in part.arrays.items():
+        ref = full.arrays[k]
+        if not isinstance(v, ShardSlices):
+            assert np.array_equal(v, ref), k
+            continue
+        assert sorted(v.blocks) == list(owned), k
+        if k.startswith(("pf", "rc")):
+            saw_fold_slices = True
+        reff = _as_full(ref)
+        for s in owned:
+            assert np.array_equal(
+                v.blocks[s], reff[s * v.per : (s + 1) * v.per]
+            ), (k, s)
+    assert saw_fold_slices, "fold tables must be owned-sliced"
+
+
+# ---------------------------------------------------------------------------
+# owner-routed serving
+# ---------------------------------------------------------------------------
+
+ROUTED_SCHEMA = """
+caveat on_tuesday(day string) { day == "tuesday" }
+definition user {}
+definition team { relation member: user }
+definition org {
+    relation admin: user
+    relation member: user | team#member
+}
+definition repo {
+    relation org: org
+    relation maintainer: user | team#member
+    relation reader: user with on_tuesday
+    permission admin = org->admin + maintainer
+    permission read = reader + admin + org->member
+}
+definition audit {
+    relation auditor: user
+    relation owner: user
+    permission both = auditor & owner
+}
+"""
+
+
+def _routed_world(seed: int = 3, n_repos: int = 600, n_users: int = 200):
+    rng = random.Random(seed)
+    rels = []
+    for t in range(12):
+        for _ in range(8):
+            rels.append(rel.Relationship(
+                resource_type="team", resource_id=f"t{t}",
+                resource_relation="member",
+                subject_type="user", subject_id=f"u{rng.randrange(n_users)}",
+            ))
+    for o in range(4):
+        rels.append(rel.Relationship(
+            resource_type="org", resource_id=f"o{o}",
+            resource_relation="admin",
+            subject_type="user", subject_id=f"u{rng.randrange(n_users)}",
+        ))
+        for t in rng.sample(range(12), 2):
+            rels.append(rel.Relationship(
+                resource_type="org", resource_id=f"o{o}",
+                resource_relation="member",
+                subject_type="team", subject_id=f"t{t}",
+                subject_relation="member",
+            ))
+    for r in range(n_repos):
+        rels.append(rel.Relationship(
+            resource_type="repo", resource_id=f"r{r}",
+            resource_relation="org",
+            subject_type="org", subject_id=f"o{rng.randrange(4)}",
+        ))
+        rels.append(rel.Relationship(
+            resource_type="repo", resource_id=f"r{r}",
+            resource_relation="maintainer",
+            subject_type="team", subject_id=f"t{rng.randrange(12)}",
+            subject_relation="member",
+        ))
+        for _ in range(2):
+            kw = dict(
+                resource_type="repo", resource_id=f"r{r}",
+                resource_relation="reader",
+                subject_type="user", subject_id=f"u{rng.randrange(n_users)}",
+            )
+            if rng.random() < 0.2:
+                kw.update(caveat_name="on_tuesday",
+                          caveat_context={"day": "tuesday"})
+            rels.append(rel.Relationship(**kw))
+    for a in range(40):
+        rels.append(rel.Relationship(
+            resource_type="audit", resource_id=f"a{a}",
+            resource_relation="auditor",
+            subject_type="user", subject_id=f"u{rng.randrange(60)}",
+        ))
+        rels.append(rel.Relationship(
+            resource_type="audit", resource_id=f"a{a}",
+            resource_relation="owner",
+            subject_type="user", subject_id=f"u{rng.randrange(60)}",
+        ))
+    return rels
+
+
+def _routed_fixture(M=4):
+    from gochugaru_tpu.parallel import ShardedEngine, make_mesh
+
+    rels = _routed_world()
+    cs = compile_schema(parse_schema(ROUTED_SCHEMA))
+    itn = Interner()
+    snap = build_snapshot(1, cs, itn, rels, epoch_us=NOW)
+    cfg = EngineConfig.for_schema(cs)
+    eng = ShardedEngine(cs, make_mesh(1, M), cfg)
+    raw = snapshot_raw_columns(snap, copy=True)
+    part = partition_feed(
+        snap.revision, cs, snap.interner, raw, cfg, M,
+        contexts=snap.contexts, epoch_us=snap.epoch_us, plan=eng.plan,
+        serve="routed",
+    )
+    assert part is not None and part.meta.part_serve
+    assert part.meta.fold_pairs, "read/admin must fold"
+    return rels, cs, snap, cfg, eng, eng.prepare_partitioned(part)
+
+
+def test_routed_dispatch_matches_single_chip_and_oracle():
+    """Owner-routed dispatch over the partitioned-serve snapshot: the
+    routed kernel (no collectives) must agree with the single-chip
+    engine bit-for-bit and with the host oracle, on a fold-bearing
+    batch mixing folded permissions and relation leaves."""
+    from gochugaru_tpu.caveats import compile_cel
+    from gochugaru_tpu.engine.oracle import Oracle, T
+
+    rels, cs, snap, cfg, eng, dsnap = _routed_fixture()
+    single = DeviceEngine(cs, cfg)
+    ds_single = single.prepare(snap)
+
+    slot = cs.slot_of_name
+    rng = np.random.default_rng(7)
+    B = 2048
+    names = [f"u{i}" for i in range(200)]
+    res_names = [f"r{i}" for i in range(600)]
+    q_res = np.array(
+        [snap.interner.lookup("repo", rng.choice(res_names)) for _ in range(B)],
+        np.int32,
+    )
+    q_perm = rng.choice(
+        np.array([slot["read"], slot["admin"], slot["reader"]], np.int32), B
+    )
+    q_subj = np.array(
+        [snap.interner.lookup("user", rng.choice(names)) for _ in range(B)],
+        np.int32,
+    )
+    d0, p0, o0 = single.check_columns(
+        ds_single, q_res, q_perm, q_subj, now_us=NOW
+    )
+    d1, p1, o1 = eng.check_columns(dsnap, q_res, q_perm, q_subj, now_us=NOW)
+    assert np.array_equal(d0, d1)
+    assert np.array_equal(p0, p1)
+    assert np.array_equal(o0, o1)
+    assert 0 < int(d1.sum()) < B
+
+    # oracle spot-check through the relationship path (check_batch)
+    checks = [
+        rel.must_from_triple(
+            f"repo:r{rng.integers(600)}",
+            str(rng.choice(["read", "admin"])),
+            f"user:u{rng.integers(200)}",
+        )
+        for _ in range(96)
+    ]
+    progs = {
+        name: compile_cel(name, decl.params, decl.expression)
+        for name, decl in cs.schema.caveats.items()
+    }
+    oracle = Oracle(cs, rels, progs, now_us=NOW)
+    d, p, ovf = eng.check_batch(dsnap, checks, now_us=NOW)
+    verified = 0
+    for i, q in enumerate(checks):
+        want = oracle.check_relationship(q)
+        if ovf[i]:
+            continue
+        if d[i]:
+            assert want == T, q
+            verified += 1
+        elif not p[i]:
+            assert want != T, q
+            verified += 1
+    assert verified >= len(checks) // 2
+
+
+def test_unroutable_batch_falls_back_to_psum_path():
+    """A batch touching the walked (intersection) permission is not
+    routable: it must dispatch through the psum path on the SAME
+    partitioned-serve snapshot and still match the single-chip engine."""
+    rels, cs, snap, cfg, eng, dsnap = _routed_fixture()
+    assert not eng._routable(
+        dsnap.flat_meta, [cs.slot_of_name["both"]]
+    )
+    assert eng._routable(
+        dsnap.flat_meta, [cs.slot_of_name["read"], cs.slot_of_name["reader"]]
+    )
+    single = DeviceEngine(cs, cfg)
+    ds_single = single.prepare(snap)
+    rng = np.random.default_rng(11)
+    B = 512
+    q_res = np.array(
+        [snap.interner.lookup("audit", f"a{rng.integers(40)}")
+         for _ in range(B)],
+        np.int32,
+    )
+    q_perm = np.full(B, cs.slot_of_name["both"], np.int32)
+    # mix in folded-slot queries so the fallback covers mixed batches
+    q_perm[: B // 4] = cs.slot_of_name["read"]
+    q_subj = np.array(
+        [snap.interner.lookup("user", f"u{rng.integers(60)}")
+         for _ in range(B)],
+        np.int32,
+    )
+    d0, p0, o0 = single.check_columns(
+        ds_single, q_res, q_perm, q_subj, now_us=NOW
+    )
+    d1, p1, o1 = eng.check_columns(dsnap, q_res, q_perm, q_subj, now_us=NOW)
+    assert np.array_equal(d0, d1)
+    assert np.array_equal(p0, p1)
+    assert np.array_equal(o0, o1)
+
+
+def test_routed_per_device_tables_are_disjoint_and_small():
+    """The routed snapshot's O(E)-scale point tables are genuinely
+    model-split (each device holds 1/M of ehx/pfx/tx); the membership
+    tables are whole per device."""
+    _rels, _cs, _snap, _cfg, _eng, dsnap = _routed_fixture()
+    M = 4
+    for name in ("ehx", "eh_off", "pfx", "pfh_off", "tx", "th_off"):
+        arr = dsnap.arrays[name]
+        total = int(arr.nbytes)
+        per = {}
+        for s in arr.addressable_shards:
+            per.setdefault(s.device.id, 0)
+            per[s.device.id] += int(np.asarray(s.data).nbytes)
+        assert len(per) == M
+        for dev, got in per.items():
+            assert got == total // M, (name, dev, got, total)
+    usx = dsnap.arrays["usx"]
+    for s in usx.addressable_shards:
+        assert int(np.asarray(s.data).nbytes) == int(usx.nbytes)
+
+
+def test_t_slot_batch_falls_back_to_psum_and_matches():
+    """A T-probing slot (userset leaf, e.g. ``maintainer``) is NOT
+    routable — the T join is model-split under part-serve and its
+    bucket geometry differs from the routing geometry — so the batch
+    dispatches through the psum path, whose ownership-mask T probe over
+    the sharded tx must still match the single-chip engine exactly."""
+    rels, cs, snap, cfg, eng, dsnap = _routed_fixture()
+    m_slot = cs.slot_of_name["maintainer"]
+    assert m_slot in dsnap.flat_meta.t_slots, "maintainer must T-index"
+    assert not eng._routable(dsnap.flat_meta, [m_slot])
+    single = DeviceEngine(cs, cfg)
+    ds_single = single.prepare(snap)
+    rng = np.random.default_rng(13)
+    B = 1024
+    q_res = np.array(
+        [snap.interner.lookup("repo", f"r{rng.integers(600)}")
+         for _ in range(B)],
+        np.int32,
+    )
+    q_perm = np.full(B, m_slot, np.int32)
+    # mix folded slots in so the fallback covers the mixed case too
+    q_perm[: B // 4] = cs.slot_of_name["read"]
+    q_subj = np.array(
+        [snap.interner.lookup("user", f"u{rng.integers(200)}")
+         for _ in range(B)],
+        np.int32,
+    )
+    d0, p0, o0 = single.check_columns(
+        ds_single, q_res, q_perm, q_subj, now_us=NOW
+    )
+    d1, p1, o1 = eng.check_columns(dsnap, q_res, q_perm, q_subj, now_us=NOW)
+    assert np.array_equal(d0, d1)
+    assert np.array_equal(p0, p1)
+    assert np.array_equal(o0, o1)
+    assert 0 < int(d1.sum()) < B
+
+
+def test_client_with_mesh_partitioned_serves_folds_and_traces_routing():
+    """client.with_mesh(mesh, partitioned=True): fold-bearing schemas
+    serve through the partitioned feed (the PR-5 decline is gone), the
+    dispatch owner-routes, and the request trace attributes the routing
+    (per-shard batch sizes + exchange bytes on the sharded.dispatch
+    span) with dispatch.route_s / partition.owned_rows metrics live."""
+    from gochugaru_tpu import consistency
+    from gochugaru_tpu.client import new_tpu_evaluator, with_mesh
+    from gochugaru_tpu.parallel import make_mesh
+    from gochugaru_tpu.utils import metrics as _metrics
+    from gochugaru_tpu.utils import trace
+    from gochugaru_tpu.utils.context import background
+
+    c = new_tpu_evaluator(with_mesh(make_mesh(1, 4), partitioned=True))
+    ctx = background()
+    c.write_schema(ctx, ROUTED_SCHEMA)
+    txn = rel.Txn()
+    rng = random.Random(2)
+    for r in range(60):
+        txn.touch(rel.must_from_triple(f"repo:r{r}", "org", "org:o0"))
+        txn.touch(rel.Relationship(
+            resource_type="repo", resource_id=f"r{r}",
+            resource_relation="reader",
+            subject_type="user", subject_id=f"u{rng.randrange(30)}",
+            caveat_name="on_tuesday",
+            caveat_context={"day": "tuesday"},
+        ))
+    txn.touch(rel.must_from_triple("org:o0", "admin", "user:u0"))
+    c.write(ctx, txn)
+
+    _metrics.default.reset()
+    tr = trace.configure(sample_rate=1.0, slow_threshold_s=None, capacity=32)
+    try:
+        got = c.check(
+            ctx, consistency.full(),
+            *[rel.must_from_triple(f"repo:r{r}", "read", "user:u0")
+              for r in range(32)],
+        )
+        assert all(got), "org admin u0 must read every repo"
+        got2 = c.check(
+            ctx, consistency.full(),
+            rel.must_from_triple("repo:r0", "read", "user:u29"),
+            rel.must_from_triple("repo:r1", "admin", "user:u1"),
+        )
+        assert got2[1] is False
+    finally:
+        traces = [t for t in tr.traces() if t["name"] == "check"]
+        trace.disable()
+    evs = [
+        e
+        for t in traces
+        for sp in t["spans"]
+        if sp["name"] == "sharded.dispatch"
+        for e in sp.get("events", ())
+    ]
+    routes = [e for e in evs if e["name"] == "route"]
+    assert routes, "owner-routed dispatch must record its route event"
+    r0 = routes[0]
+    assert len(r0["shard_batches"]) == 4
+    assert sum(r0["shard_batches"]) == 32
+    assert r0["exchange_bytes"] > 0
+    m = _metrics.default.snapshot()
+    assert m.get("dispatch.route_s.count", 0) >= 1
+    assert m.get("partition.owned_rows", 0) > 0
+
+
+def test_partitioned_client_keeps_fold_across_delta_prepares():
+    """Regression: ``prepare_partitioned`` must carry the feed's armed
+    FoldState onto the DeviceSnapshot.  Without it the FIRST incremental
+    prepare finds ``fold_state=None`` and sticky-downgrades the fold
+    (DeltaMeta.pf_off), which silently drops every folded slot off the
+    owner-routed path onto the psum fallback for the rest of the chain."""
+    from gochugaru_tpu import consistency
+    from gochugaru_tpu.client import new_tpu_evaluator, with_mesh
+    from gochugaru_tpu.parallel import make_mesh
+    from gochugaru_tpu.utils import metrics as _metrics
+    from gochugaru_tpu.utils.context import background
+
+    c = new_tpu_evaluator(with_mesh(make_mesh(1, 4), partitioned=True))
+    ctx = background()
+    c.write_schema(ctx, ROUTED_SCHEMA)
+    txn = rel.Txn()
+    for r in range(48):
+        txn.touch(rel.must_from_triple(f"repo:r{r}", "org", "org:o0"))
+    txn.touch(rel.must_from_triple("org:o0", "admin", "user:u0"))
+    # seed the maintainer slot so the delta below stays dense-mappable
+    # (a fresh relation first used mid-chain is a legitimate full-prepare
+    # bail — not what this test is about)
+    txn.touch(rel.must_from_triple("repo:r1", "maintainer", "user:u5"))
+    rev1 = c.write(ctx, txn)
+    assert c.check(
+        ctx, consistency.at_least(rev1),
+        rel.must_from_triple("repo:r0", "read", "user:u0"),
+    ) == [True]
+    ds1 = c._dsnap_cache[max(c._dsnap_cache)]
+    assert ds1.flat_meta.fold_pairs, "world must fold"
+    assert ds1.fold_state is not None, "feed must arm the fold state"
+
+    # a plain leaf write advances the chain through the incremental
+    # prepare; the fold must stay engaged (no pf_off) and the next
+    # batch must still owner-route
+    txn2 = rel.Txn()
+    txn2.touch(rel.must_from_triple("repo:r0", "maintainer", "user:u7"))
+    rev2 = c.write(ctx, txn2)
+    _metrics.default.reset()
+    got = c.check(
+        ctx, consistency.at_least(rev2),
+        rel.must_from_triple("repo:r0", "read", "user:u7"),
+        rel.must_from_triple("repo:r1", "read", "user:u7"),
+        rel.must_from_triple("repo:r1", "read", "user:u0"),
+    )
+    assert got == [True, False, True]
+    ds2 = c._dsnap_cache[max(c._dsnap_cache)]
+    assert ds2.flat_meta.delta is not None, "chain must ride the delta path"
+    assert not ds2.flat_meta.delta.pf_off, "fold downgraded on first delta"
+    assert ds2.fold_state is not None
+    m = _metrics.default.snapshot()
+    assert m.get("dispatch.route_s.count", 0) >= 1, (
+        "post-delta folded batch must still owner-route"
+    )

@@ -126,9 +126,10 @@ def test_partitioned_build_scratch_is_shard_local():
     O(E) sort/gather/interleave: every tracked sort-layer call stays
     bounded by ~E/M (+ pad slack).  The legacy build-full-then-stack
     path trips the same tracker (sanity: the assertion discriminates).
-    Fold derivation is out of scope (its join is global by design —
-    ISSUE: only the hash/range/T tables and their sort scratch become
-    shard-local), so the fold is off here."""
+    Fold DERIVATION sorts are global by design (canonical dedup over
+    the leaf/group structure), so the fold is off here; the fold
+    TABLES' shard-locality has its own tracker below
+    (test_partitioned_fold_tables_are_shard_local)."""
     import sys
 
     sys.path.insert(0, ".")
@@ -168,4 +169,63 @@ def test_partitioned_build_scratch_is_shard_local():
     legacy = prepare_with(partition=False)
     assert max(n for _, n in legacy) >= E, (
         "tracker failed to see the legacy path's full-size build"
+    )
+
+
+def test_partitioned_fold_tables_are_shard_local():
+    """The partitioned serve path (partition_feed with a plan) must
+    never MATERIALIZE a full O(E)-scale fold/rc table: every table fill
+    (fill_interleaved — the pass that writes interleaved/stacked rows)
+    stays bounded by ~rows/M + pad slack, while the legacy full
+    derivation fills the whole pf table in one pass (sanity: the same
+    tracker sees it).  The fold derivation's own sorts are exempt by
+    design — canonical dedup over the leaf/group structure — which is
+    why this tracker watches the table fills, not the sort layer."""
+    import sys
+
+    sys.path.insert(0, ".")
+    import numpy as np
+    from bench import build_world
+
+    from gochugaru_tpu.engine.device import DeviceEngine
+    from gochugaru_tpu.engine.flat import build_flat_arrays_sharded
+    from gochugaru_tpu.engine.partition import partition_feed
+    from gochugaru_tpu.engine.plan import EngineConfig
+
+    cs, snap, users, repos, slot = build_world(
+        n_repos=40_000, n_users=1_000, n_teams=100, n_orgs=10
+    )
+    M = 4
+    cfg = EngineConfig.for_schema(cs, flat_partition_chunk=1 << 15)
+    plan = DeviceEngine(cs, cfg).plan
+
+    def fills_of(run):
+        calls = []
+        with pytest.MonkeyPatch.context() as mp:
+            _shim_sizes(mp, calls)
+            run()
+        return [n for name, n in calls if name == "fill_interleaved"]
+
+    legacy_cfg = EngineConfig.for_schema(cs, flat_partition_build=False)
+    ref_box = []
+    legacy = fills_of(lambda: ref_box.append(build_flat_arrays_sharded(
+        snap, legacy_cfg, M, plan=plan
+    )))
+    assert ref_box[0] is not None
+    assert ref_box[0][1].fold_pairs, "world must fold"
+    L = max(legacy)
+    assert L >= snap.num_edges, "legacy path must fill a full-size table"
+
+    from gochugaru_tpu.engine.partition import snapshot_raw_columns
+
+    raw = snapshot_raw_columns(snap, copy=True)
+    part_box = []
+    part_fills = fills_of(lambda: part_box.append(partition_feed(
+        snap.revision, cs, snap.interner, raw, cfg, M,
+        contexts=snap.contexts, epoch_us=snap.epoch_us, plan=plan,
+    )))
+    assert part_box[0] is not None and part_box[0].meta.fold_pairs
+    P = max(part_fills)
+    assert P <= L // M + 70_000, (
+        f"full-size fold/rc table fill: {P} rows (legacy max {L})"
     )
