@@ -210,6 +210,8 @@ def measure_batch(engine, dsnap, snap, users, repos, slot, B, note):
     p99_ms = float(np.percentile(lat, 99))
     blocked_rate = B / float(np.median(raw))
 
+    from benchmarks.common import est_bytes_per_check, table_bytes
+
     out = {
         "metric": "rbac_2hop_bulk_check_throughput",
         "value": round(blocked_rate, 1),
@@ -221,6 +223,12 @@ def measure_batch(engine, dsnap, snap, users, repos, slot, B, note):
         "batch": int(B),
         "edges": int(snap.num_edges),
         "host_fallback": host_work,
+        # the HBM roofline columns next to checks/s: resident table
+        # bytes per edge + estimated gathered bytes per check
+        "table_bytes_per_edge": round(
+            table_bytes(dsnap) / max(int(snap.num_edges), 1), 2
+        ),
+        "bytes_per_check": round(est_bytes_per_check(dsnap), 1),
         "platform": jax.default_backend(),
         **({"note": note} if note else {}),
     }
@@ -432,6 +440,58 @@ def _run_child(mode: str, timeout_s: int, note: str | None):
 
 _PROBE_VERDICT: "list[str | None]" = []  # memoized per process
 
+#: on-disk probe verdict cache: the subprocess probe exists to guard
+#: against a HUNG TPU init, and a hung probe costs the full 75 s
+#: timeout — once per PROCESS under the memo above, which standalone
+#: repeat runs of bench.py re-paid every time (BENCH_r05 tail).  The
+#: verdict persists here keyed by jaxlib version + TPU env, matching
+#: the GOCHUGARU_BACKEND_PROBED parent-inherit path run_all.py uses.
+#: GOCHUGARU_PROBE_CACHE=0 disables; the path is overridable for tests.
+PROBE_CACHE_PATH = os.environ.get(
+    "GOCHUGARU_PROBE_CACHE_PATH", "/tmp/gochugaru_backend_probe.json"
+)
+
+
+def _probe_cache_key() -> str:
+    try:
+        from importlib.metadata import version
+
+        jaxlib = version("jaxlib")
+    except Exception:
+        jaxlib = "unknown"
+    tpu_env = ",".join(
+        f"{k}={os.environ.get(k, '')}"
+        for k in ("TPU_NAME", "TPU_WORKER_ID", "TPU_SKIP_MDS_QUERY")
+    )
+    return f"jaxlib={jaxlib};{tpu_env}"
+
+
+def _probe_cache_read() -> "str | None | bool":
+    """The cached verdict (a reason string or None=usable), or False
+    when absent/stale/disabled."""
+    if os.environ.get("GOCHUGARU_PROBE_CACHE", "1") == "0":
+        return False
+    try:
+        with open(PROBE_CACHE_PATH) as f:
+            blob = json.load(f)
+        if blob.get("key") != _probe_cache_key():
+            return False
+        return blob.get("reason", False)
+    except (OSError, ValueError):
+        return False
+
+
+def _probe_cache_write(reason: "str | None") -> None:
+    if os.environ.get("GOCHUGARU_PROBE_CACHE", "1") == "0":
+        return
+    try:
+        tmp = PROBE_CACHE_PATH + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"key": _probe_cache_key(), "reason": reason}, f)
+        os.replace(tmp, PROBE_CACHE_PATH)
+    except OSError:
+        pass  # cache is best-effort; next run just re-probes
+
 
 def _probe_backend() -> str | None:
     """Cheap bounded liveness probe of the default (TPU) backend; returns
@@ -472,6 +532,12 @@ def _probe_backend() -> str | None:
             None if probed == "tpu"
             else f"parent probe found backend={probed} (probe skipped)"
         )
+    cached = _probe_cache_read()
+    if cached is not False:
+        return remember(
+            cached if cached is None
+            else f"{cached} (cached verdict, {PROBE_CACHE_PATH})"
+        )
     try:
         r = subprocess.run(
             [sys.executable, "-c",
@@ -479,12 +545,17 @@ def _probe_backend() -> str | None:
             capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
         )
     except subprocess.TimeoutExpired:
-        return remember(f"backend probe timed out after {PROBE_TIMEOUT_S}s")
+        reason = f"backend probe timed out after {PROBE_TIMEOUT_S}s"
+        _probe_cache_write(reason)
+        return remember(reason)
     if r.returncode != 0:
         tail = (r.stderr or "").strip().splitlines()
-        return remember(
+        reason = (
             f"backend probe failed: {tail[-1][:200] if tail else r.returncode}"
         )
+        _probe_cache_write(reason)
+        return remember(reason)
+    _probe_cache_write(None)
     return remember(None)
 
 

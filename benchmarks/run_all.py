@@ -152,6 +152,18 @@ def main() -> int:
         ["bash", "scripts/partition_smoke.sh"],
         600,
     ))
+    configs.append((
+        "9 — HBM-lean packed tables: bytes reduction + parity @ config 3"
+        + (" (quick, 5% scale)" if q else ""),
+        [py, "benchmarks/bench7_hbm.py"]
+        + (["--scale", "0.05"] if q else []),
+        3600,
+    ))
+    configs.append((
+        "10 — HBM-lean smoke (packed-vs-unpacked parity + bytes bar)",
+        ["bash", "scripts/hbm_smoke.sh"],
+        600,
+    ))
     if not q:
         # Leopard-scale CPU proxy (VERDICT r04 item 3): the same Watch
         # re-index loop at a 100M-edge base — BASELINE config 5's

@@ -644,6 +644,14 @@ class DeviceSnapshot:
     #: ``snapshot`` is the bucket-filtered view); the client's dsnap
     #: cache identity check consults it
     source_snapshot: Optional[Any] = None
+    #: HBM-lean mode keeps the raw O(E) kernel columns HOST-side (the
+    #: flat blockslice kernel never reads them; sharded prepares never
+    #: shipped them): the rare legacy fallback (a batch with more
+    #: distinct permissions than flat_max_slots) ships them lazily once
+    #: per snapshot via DeviceEngine._legacy_arrays
+    host_arrays: Optional[Dict[str, np.ndarray]] = None
+    #: the lazily-shipped legacy argument dict (host_arrays ∪ arrays)
+    legacy_cache: Optional[Dict[str, Any]] = None
 
 
 class DeviceEngine:
@@ -768,6 +776,25 @@ class DeviceEngine:
             "ectx_host": padrows(table.host),
         }, strings
 
+    @staticmethod
+    def record_device_bytes(arrays: Mapping[str, Any]) -> int:
+        """Publish the resident table footprint: one
+        ``snapshot.device_bytes`` gauge plus a per-table breakdown
+        (``snapshot.device_bytes.<table>``) — /metrics and trace spans
+        then report HBM residency live, not just at bench time."""
+        total = 0
+        # drop the previous snapshot's per-table entries first: a delta
+        # prepare can remove tables (despec'd offset anchors), and a
+        # stale gauge would break breakdown-sums-to-total
+        metrics.default.clear_gauges("snapshot.device_bytes.")
+        for k, v in arrays.items():
+            nb = int(getattr(v, "nbytes", 0))
+            total += nb
+            metrics.default.set_gauge(f"snapshot.device_bytes.{k}", nb)
+        metrics.default.set_gauge("snapshot.device_bytes", total)
+        _trace.event_if_active("snapshot.device_bytes", total=total)
+        return total
+
     def prepare(
         self, snap: Snapshot, prev: Optional[DeviceSnapshot] = None
     ) -> DeviceSnapshot:
@@ -789,6 +816,7 @@ class DeviceEngine:
         flat_meta = None
         fold_state = None
         closure_state = None
+        host_arrays = None
         if self.config.use_flat:
             from .flat import build_flat_arrays
 
@@ -796,10 +824,25 @@ class DeviceEngine:
             if built is not None:  # unpackable graphs use the legacy path
                 flat_arrays, flat_meta, fold_state, closure_state = built
                 arrays.update(flat_arrays)
+                if self.config.packed_on() and flat_meta.blockslice:
+                    # HBM-lean: the blockslice kernel reads none of the
+                    # raw O(E) columns — keep them host-side and ship
+                    # them lazily iff the legacy fallback ever fires
+                    from .packed import narrow_nodes
+
+                    host_arrays = {
+                        k: arrays.pop(k)
+                        for k in self.ARRAY_COLUMN_KEYS
+                        if k != "node_type" and k in arrays
+                    }
+                    arrays["node_type"] = narrow_nodes(
+                        arrays["node_type"], snap.interner.num_types
+                    )
         with metrics.default.timer("prepare.h2d_s"):
             # one batched transfer (the runtime can pipeline leaves)
             # instead of per-array jnp.asarray round trips
             arrays = jax.device_put(arrays)
+        self.record_device_bytes(arrays)
         tid_map = np.full(max(self.plan.num_schema_types, 1), -1, dtype=np.int32)
         for tname, tid in self.compiled.type_ids.items():
             tid_map[tid] = snap.interner.type_lookup(tname)
@@ -834,6 +877,7 @@ class DeviceEngine:
             flat_meta=flat_meta,
             fold_state=fold_state,
             closure_state=closure_state,
+            host_arrays=host_arrays,
         )
 
     def _delta_prev_ok(self, prev: DeviceSnapshot) -> bool:
@@ -893,18 +937,28 @@ class DeviceEngine:
             NN = int(prev.arrays["node_type"].shape[0])
             if snap.num_nodes > NN:
                 return None  # node bucket outgrown: every node shape moves
-            arrays["node_type"] = self._place_replicated(
-                _pad_payload(snap.node_type, NN, -1)
-            )
+            nt = _pad_payload(snap.node_type, NN, -1)
+            prev_dt = prev.arrays["node_type"].dtype
+            if prev_dt != nt.dtype:
+                # the base narrowed node_type (HBM-lean); fresh interner
+                # type ids past the narrow dtype's range would WRAP —
+                # bail to a full prepare, which re-derives the width
+                if int(nt.max(initial=0)) > np.iinfo(prev_dt).max:
+                    return None
+                nt = nt.astype(prev_dt)
+            arrays["node_type"] = self._place_replicated(nt)
         arrays.update(
             {k: self._place_replicated(v) for k, v in dl_arrays.items()}
         )
+        for k in extras.get("drop_keys", ()):
+            arrays.pop(k, None)  # despec'd packed-offset anchors
         # an empty collapsed delta (or one that cancelled out) compiles as
         # the plain base kernel — don't pay a retrace for DeltaMeta()
         meta = _dc_replace(
             prev.flat_meta, delta=dmeta if dl_arrays else None,
             **extras.get("meta_up", {}),
         )
+        self.record_device_bytes(arrays)
         return DeviceSnapshot(
             revision=snap.revision,
             arrays=arrays,
@@ -915,6 +969,7 @@ class DeviceEngine:
             delta_acc=acc,
             fold_state=prev.fold_state,
             closure_state=extras.get("closure_state"),
+            host_arrays=prev.host_arrays,
         )
 
     # -- query lowering --------------------------------------------------
@@ -1123,6 +1178,19 @@ class DeviceEngine:
     #: recompiles but can't grow device/host memory without bound)
     FLAT_FN_CACHE_MAX = 16
 
+    def _legacy_arrays(self, dsnap: DeviceSnapshot) -> Dict[str, Any]:
+        """Argument dict for the legacy (non-flat) kernel.  HBM-lean
+        snapshots keep the raw O(E) columns host-side; the first legacy
+        fallback ships them once and caches the merged dict on the
+        snapshot."""
+        if dsnap.host_arrays is None:
+            return dsnap.arrays
+        if dsnap.legacy_cache is None:
+            merged = dict(dsnap.arrays)
+            merged.update(jax.device_put(dsnap.host_arrays))
+            dsnap.legacy_cache = merged
+        return dsnap.legacy_cache
+
     def _flat_fn_for(self, slots: Tuple[int, ...], meta):
         key = (slots, meta)
         fn = self._flat_fns.get(key)
@@ -1300,7 +1368,7 @@ class DeviceEngine:
             now = jnp.int32(snap.now_rel32(now_us))
             with _trace.annotate_dispatch(span):
                 d, p, ovf = self._fn(
-                    dsnap.arrays, dsnap.tid_map, now,
+                    self._legacy_arrays(dsnap), dsnap.tid_map, now,
                     jnp.asarray(u_subj), jnp.asarray(u_srel), jnp.asarray(u_wc),
                     jnp.asarray(u_qctx),
                     padq(queries["q_res"], -1), padq(queries["q_perm"], -1),
@@ -1465,7 +1533,7 @@ class DeviceEngine:
 
         now = jnp.int32(snap.now_rel32(now_us))
         d, p, ovf = self._fn(
-            dsnap.arrays, dsnap.tid_map, now,
+            self._legacy_arrays(dsnap), dsnap.tid_map, now,
             jnp.asarray(u[:, 0]), jnp.asarray(u[:, 1]), jnp.asarray(u[:, 2]),
             jnp.asarray(u[:, 3]),
             padq(q_res, -1), padq(q_perm, -1), padq(q_subj, -1),

@@ -60,6 +60,7 @@ from .hash import (
     slice_blocks,
     take_in_bounds,
 )
+from .packed import decode_block as _pk_decode
 from .plan import DevicePlan, EngineConfig, ExprIR, _eval_cyclic_pairs
 
 
@@ -251,10 +252,21 @@ class FlatMeta:
     #: with one contiguous [cap, w] slice per query — see engine/hash.py)
     blockslice: bool = False
     #: bucket-ALIGNED tables (engine/hash.py build_aligned): per aligned
-    #: table, (tbl_key, cap, w, spill_cap) — arrays ``{tbl_key}_al`` (and
-    #: ``{tbl_key}_als`` when spill_cap > 0) replace the off+interleave
-    #: pair, and a probe is ONE row gather (+ one salted spill gather)
-    aligned: Tuple[Tuple[str, int, int, int], ...] = ()
+    #: table, (tbl_key, w, caps) — ``caps`` is the width-stratum ladder:
+    #: arrays ``{tbl_key}_al`` / ``{tbl_key}_als`` / ``{tbl_key}_als2``…
+    #: replace the off+interleave pair, and a probe is one row gather
+    #: per level (each salted by its level index)
+    aligned: Tuple[Tuple[str, int, Tuple[int, ...]], ...] = ()
+    #: HBM-lean bit-packed tables (engine/packed.py): (tbl_key, spec)
+    #: per packed table — the named array holds uint16 lanes and every
+    #: probe site decodes with fused shift/mask ops right after its
+    #: gather.  Specs derive from geometry + replicated domains, so the
+    #: partitioned multihost build agrees on them before building
+    packed: Tuple[Tuple[str, Tuple], ...] = ()
+    #: packed bucket-offset arrays: (off_key, anchor_shift) — the named
+    #: array holds uint16 residuals and ``{off_key}_a`` the int32 block
+    #: anchors; off[i] == anchor[i >> shift] + residual[i]
+    packed_off: Tuple[Tuple[str, int], ...] = ()
     #: LSM delta level riding on this snapshot's base tables (None = the
     #: snapshot was fully prepared)
     delta: Optional[DeltaMeta] = None
@@ -914,6 +926,7 @@ def _pf_view_tables(
     u_k1, u_gk, u_until, u_fan,
     cl_k1, cl_k2, cl_d, cl_p, s_fan,
     *, maps: SlotMaps, N: int, S1: int, fold_slots, config: EngineConfig,
+    hk: Optional[Dict] = None,
 ):
     """Single-chip pf_u / csr view tables: SPLIT 1-wide row columns
     (narrow contiguous slices vectorize ~15× better than wide ones on
@@ -947,6 +960,7 @@ def _pf_view_tables(
         pf_direct=u_direct, pf_s_direct=s_direct,
         pf_u_alllive=u_alllive, pf_s_alllive=s_alllive,
     )
+    hk = hk or {}
     if u_direct:
         # remap fold slots to a compact id so pfu_start spans only
         # fold-slots·N entries (the full active-k1 domain would be ~3×)
@@ -956,7 +970,7 @@ def _pf_view_tables(
         u64 = u_k1.astype(np.int64)
         out["pfu_start"] = _pf_starts(fidx[u64 // N] * N + u64 % N, n_f * N)
     else:
-        pfu = build_range_hash(u_k1)
+        pfu = build_range_hash(u_k1, **hk)
         out["pfu_off"] = pfu.index.off
         out["pfugx"] = interleave_buckets(
             pfu.index, [pfu.gk, pfu.glo, pfu.ghi]
@@ -965,13 +979,248 @@ def _pf_view_tables(
     if s_direct:
         out["csr_start"] = _pf_starts(cl_k1.astype(np.int64), N * S1)
     else:
-        csr = build_range_hash(cl_k1)
+        csr = build_range_hash(cl_k1, **hk)
         out["csr_off"] = csr.index.off
         out["csrgx"] = interleave_buckets(
             csr.index, [csr.gk, csr.glo, csr.ghi]
         )
         kw.update(pf_s_cap=_round_cap(csr.index.cap))
     return out, kw
+
+
+# ---------------------------------------------------------------------------
+# HBM-lean packing (engine/packed.py): spec derivation + post-pass
+# ---------------------------------------------------------------------------
+
+
+def _al_key(tbl_key: str, lvl: int) -> str:
+    """Device-array name of one aligned width-stratum level."""
+    if lvl == 0:
+        return tbl_key + "_al"
+    return tbl_key + "_als" + ("" if lvl == 1 else str(lvl))
+
+
+def _until_dom(*arrays) -> Optional[Tuple[int, ...]]:
+    """Dictionary domain of until-value columns: the closure semiring
+    only ever emits {NEVER, NO_EXP, real timestamps}; almost every world
+    has no expiring membership edges, so the whole column fits a 2-bit
+    dictionary over {NEVER, -1 (pad), 0, NO_EXP}.  Returns None when
+    real timestamps appear (the column stays a 32-bit field)."""
+    from ..store.closure import NEVER, NO_EXP
+
+    cand = np.asarray(
+        sorted({int(NEVER), -1, 0, int(NO_EXP)}), np.int64
+    )
+    for a in arrays:
+        if a is None or a.shape[0] == 0:
+            continue
+        v = a.astype(np.int64, copy=False)
+        if not bool(np.isin(v, cand).all()):
+            return None
+    return tuple(int(c) for c in cand)
+
+
+def _pack_domains(snap, config: EngineConfig) -> Dict:
+    """Replicated per-world pack domains every build path derives
+    identically (raw snapshot columns are process-replicated even under
+    the multihost partitioned feed — only built TABLES are sharded):
+    gate-column value bounds.  Until dictionaries and fan bounds join
+    per builder at the sites that compute those arrays globally."""
+    mx = lambda *cols: max(
+        [int(c.max()) for c in cols if c is not None and c.shape[0]] or [0]
+    )
+    return {
+        "max_cav": mx(snap.e_caveat, snap.us_caveat, snap.ar_caveat),
+        "max_ctx": mx(snap.e_ctx, snap.us_ctx, snap.ar_ctx),
+        "until": {},
+        "fan": {},
+    }
+
+
+#: group tables and the row views their (glo, ghi) ranges index into —
+#: candidates per table because the single-chip fold keeps split 1-wide
+#: row columns instead of an interleaved view
+_PACK_GROUPS = {
+    "usgx": ("usx",),
+    "argx": ("arx",),
+    "pfugx": ("pfux", "pfu_gk"),
+    "csrgx": ("csrx", "csr_gk"),
+}
+
+
+def _pack_descs(name: str, meta: FlatMeta, dom: Dict, out: Dict):
+    """Column descriptors of one packable table, derived from geometry
+    (radices, layout flags, shapes) + the replicated domains — never
+    from scanning the built table, so partitioned shard builds agree."""
+    from . import packed as pk
+
+    N, S1 = meta.N, meta.S1
+    n_k1 = max(int(x) for x in meta.k1_dense) + 1 if meta.k1_dense else 1
+    K1 = pk.col_range(-1, max(n_k1, 1) * N - 1)  # (slot, res) point keys
+    K2 = pk.col_range(-1, N * S1 - 1)  # (subj, srel1) / closure keys
+    NODE = pk.col_range(-1, N - 1)
+    I32 = pk.col_range(-(2 ** 31), 2 ** 31 - 1)
+
+    def until(key: str):
+        d = dom["until"].get(key)
+        return pk.col_dict(d) if d is not None else I32
+
+    def gates(prefix_cav: bool, prefix_exp: bool):
+        g = []
+        if prefix_cav:
+            g += [pk.col_range(-1, dom["max_cav"]),
+                  pk.col_range(-1, dom["max_ctx"])]
+        if prefix_exp:
+            # rel32 expiry stamps are signed (already-expired edges sit
+            # below the epoch): full int32 — no byte win on this field,
+            # but every OTHER field in the row still packs, and the
+            # domain stays provably sound for owned-subset shard builds
+            # (a spec must never commit on one process and fail on
+            # another — the agreement-before-build contract)
+            g += [I32]
+        return g
+
+    if name == "ehx":
+        return [K1, K2] + gates(meta.e_hascav, meta.e_hasexp)
+    if name == "tx":
+        return [K1, K2, until("tx"), until("tx")]
+    if name == "clx":
+        return [K2, K2, until("clx"), until("clx")]
+    if name == "pfx":
+        return (
+            [K1, K2]
+            + gates(meta.pf_hascav, False)
+            + ([until("pfx")] if meta.pf_hasuntil else [])
+        )
+    if name in _PACK_GROUPS:
+        rows_len = max(
+            [int(out[r].shape[0]) for r in _PACK_GROUPS[name] if r in out]
+            or [1]
+        )
+        gk = {"usgx": K1, "argx": K1, "pfugx": K1, "csrgx": K2}[name]
+        fan = int(dom["fan"].get(name, 0))
+        return [gk, pk.col_range(-1, rows_len - 1), pk.col_delta(0, fan, 1)]
+    if name.startswith("rc") and name.endswith("gx"):
+        rows_len = int(out[name[:-2] + "x"].shape[0])
+        fan = int(dom["fan"].get(name, 0))
+        return [NODE, pk.col_range(-1, rows_len - 1), pk.col_delta(0, fan, 1)]
+    if name == "usx":
+        return (
+            [NODE, pk.col_range(-1, S1 - 2)]
+            + gates(meta.us_hascav, meta.us_hasexp)
+            + ([pk.col_range(-1, 1)] if meta.us_hasperm else [])
+        )
+    if name == "arx":
+        return [NODE] + gates(meta.ar_hascav, meta.ar_hasexp)
+    if name == "pfux":
+        return [K2, until("pfux")]
+    if name == "csrx":
+        return [K2, until("clx"), until("clx")]
+    if name.startswith("rc") and name.endswith("x"):
+        return [NODE, until(name), until(name)]
+    return None
+
+
+#: point-table offset arrays eligible for the anchor+residual encoding
+#: (single-chip layouts; stacked offs stay int32 — a shard cannot
+#: verify other shards' residual bounds before building)
+_PACK_OFF_KEYS = (
+    "eh_off", "th_off", "pfh_off", "clh_off", "usr_off", "arr_off",
+    "pfu_off", "csr_off", "push_off", "ovfh_off",
+)
+
+
+def _pack_flat(
+    out: Dict[str, np.ndarray], meta: FlatMeta, config: EngineConfig,
+    dom: Dict, *, pack_off: bool,
+) -> Dict:
+    """The HBM-lean post-pass: bit-pack every eligible table in ``out``
+    in place (chunked — no full-width intermediate copy) and return the
+    FlatMeta field overrides ({} when packing is off or nothing won).
+    Aligned width-stratum levels share their table's one spec."""
+    if not config.packed_on():
+        return {}
+    from . import packed as pk
+
+    names = (
+        ["ehx", "clx", "pfx", "tx", "usx", "arx", "pfux", "csrx",
+         "usgx", "argx", "pfugx", "csrgx"]
+        + [k for k in out if k.startswith("rc") and k.endswith(("x", "gx"))
+           and not k.endswith("_off")]
+    )
+    specs: List[Tuple[str, Tuple]] = []
+    for name in names:
+        tgt = [k for k in (
+            [name] + [_al_key(name, l) for l in range(16)]
+        ) if k in out]
+        if not tgt:
+            continue
+        descs = _pack_descs(name, meta, dom, out)
+        if descs is None:
+            continue
+        spec = pk.make_spec(descs)
+        if spec is None:
+            continue
+        w, lanes = spec[0], spec[1]
+        ok = True
+        packed_arrays = {}
+        try:
+            for k in tgt:
+                a = out[k]
+                if k == name:
+                    if len(a.shape) != 2 or a.shape[1] != w:
+                        ok = False
+                        break
+                    if hasattr(a, "map_blocks"):  # multihost ShardSlices
+                        # a PackError here must FAIL LOUDLY: each process
+                        # validates only its owned blocks, and a silent
+                        # local despec would diverge FlatMeta across the
+                        # processes of one collective program
+                        packed_arrays[k] = a.map_blocks(
+                            lambda b: pk.pack_rows(b, spec), lanes,
+                            np.uint16,
+                        )
+                    else:
+                        packed_arrays[k] = pk.pack_rows(a, spec)
+                else:
+                    # aligned level: rows are cap*w int32 → cap*lanes
+                    size, roww = a.shape
+                    cap = roww // w
+                    packed_arrays[k] = pk.pack_rows(
+                        a.reshape(size * cap, w), spec
+                    ).reshape(size, cap * lanes)
+        except pk.PackError:
+            if any(hasattr(out[k], "map_blocks") for k in tgt):
+                raise  # multihost: local despec would diverge the mesh
+            ok = False
+        if not ok:
+            continue
+        out.update(packed_arrays)
+        specs.append((name, spec))
+    off_specs: List[Tuple[str, int]] = []
+    if pack_off:
+        off_keys = list(_PACK_OFF_KEYS) + [
+            k for k in out if k.startswith("rc") and k.endswith("_off")
+        ]
+        for ok_ in off_keys:
+            a = out.get(ok_)
+            if a is None or a.dtype != np.int32:
+                continue
+            got = pk.pack_off(a)
+            if got is None:
+                continue
+            res, anchor = got
+            if res.nbytes + anchor.nbytes >= a.nbytes:
+                continue
+            out[ok_] = res
+            out[ok_ + "_a"] = anchor
+            off_specs.append((ok_, pk.OFF_ANCHOR_SHIFT))
+    up: Dict = {}
+    if specs:
+        up["packed"] = tuple(sorted(specs))
+    if off_specs:
+        up["packed_off"] = tuple(sorted(off_specs))
+    return up
 
 
 def build_flat_arrays(
@@ -1051,10 +1300,22 @@ def build_flat_arrays(
         ovf_k = _pack(cl.ovf_src, S1, _m_srel1(maps, cl.ovf_srel1))
 
     _t_hash = time.perf_counter()
-    usr = build_range_hash(us_gk)
-    arr = build_range_hash(ar_gk)
-    push = build_hash([pus_k])
-    ovfh = build_hash([ovf_k])
+    # HBM-lean mode: bucket growth bounded (a deeper probe cap costs a
+    # few fused compares; 8x offsets cost hundreds of MB), and the pack
+    # domains collected alongside the global joins below
+    PKD = config.packed_on()
+    hk = (
+        {"max_factor": config.flat_packed_max_factor, "lean": True}
+        if PKD else {}
+    )
+    dom = _pack_domains(snap, config)
+    dom["until"]["clx"] = _until_dom(cl.c_d_until, cl.c_p_until)
+    usr = build_range_hash(us_gk, **hk)
+    arr = build_range_hash(ar_gk, **hk)
+    push = build_hash([pus_k], **hk)
+    ovfh = build_hash([ovf_k], **hk)
+    dom["fan"]["usgx"] = usr.max_run
+    dom["fan"]["argx"] = arr.max_run
     eh = clh = None  # big indexes: built lazily (skipped when aligned)
 
     out: Dict[str, np.ndarray] = {}
@@ -1100,13 +1361,13 @@ def build_flat_arrays(
         when the legacy layout was emitted, else None."""
         if AL:
             ai = build_aligned(
-                key_cols, cols, max_bytes=config.flat_aligned_max_bytes
+                key_cols, cols, max_bytes=config.flat_aligned_max_bytes,
+                cover=config.flat_aligned_cover,
             )
             if ai is not None:
-                out[tbl_key + "_al"] = ai.tbl
-                if ai.spill is not None:
-                    out[tbl_key + "_als"] = ai.spill
-                al_meta.append((tbl_key, ai.cap, ai.w, ai.spill_cap))
+                for lvl, (tbl, _cap) in enumerate(ai.levels):
+                    out[_al_key(tbl_key, lvl)] = tbl
+                al_meta.append((tbl_key, ai.w, ai.caps))
                 return None
         if callable(h):
             h = h()
@@ -1121,7 +1382,8 @@ def build_flat_arrays(
         # by bucket and the row view interleaved in its existing
         # key-sorted order
         eh = put_block(
-            "ehx", "eh_off", lambda: build_hash([e_k1, e_k2]), [e_k1, e_k2],
+            "ehx", "eh_off", lambda: build_hash([e_k1, e_k2], **hk),
+            [e_k1, e_k2],
             [e_k1, e_k2]
             + ([snap.e_caveat, snap.e_ctx] if e_hascav else [])
             + ([snap.e_exp] if e_hasexp else []),
@@ -1150,7 +1412,7 @@ def build_flat_arrays(
             pad=max(64, config.arrow_fanout),
         )
         clh = put_block(
-            "clx", "clh_off", lambda: build_hash([cl_k1, cl_k2]),
+            "clx", "clh_off", lambda: build_hash([cl_k1, cl_k2], **hk),
             [cl_k1, cl_k2],
             [cl_k1, cl_k2, cl.c_d_until, cl.c_p_until],
         )
@@ -1190,10 +1452,11 @@ def build_flat_arrays(
     tj = _tindex_join(snap, config, cl, us_gk, cl_k1, cl_k2, pus_k, maps)
     if tj is not None:
         T_k1, T_k2, T_d, T_p, t_slots = tj
+        dom["until"]["tx"] = _until_dom(T_d, T_p)
         th = None
         if BS:
             th = put_block(
-                "tx", "th_off", lambda: build_hash([T_k1, T_k2]),
+                "tx", "th_off", lambda: build_hash([T_k1, T_k2], **hk),
                 [T_k1, T_k2], [T_k1, T_k2, T_d, T_p],
             )
         else:
@@ -1221,7 +1484,7 @@ def build_flat_arrays(
         for ts_slot, (src, anc, d_u, p_u, fan) in _rc_build(
             snap, config, plan, ar_dd
         ).items():
-            ri = build_range_hash(src)
+            ri = build_range_hash(src, **hk)
             put_block(
                 f"rc{ts_slot}gx", f"rc{ts_slot}_off", ri.index,
                 [ri.gk], [ri.gk, ri.glo, ri.ghi],
@@ -1229,6 +1492,8 @@ def build_flat_arrays(
             out[f"rc{ts_slot}x"] = interleave_rows(
                 [anc, d_u, p_u], pad=max(64, fan)
             )
+            dom["until"][f"rc{ts_slot}x"] = _until_dom(d_u, p_u)
+            dom["fan"][f"rc{ts_slot}gx"] = fan
             rc_list.append((int(ts_slot), _round_cap(ri.index.cap), fan))
         rc_kw = dict(rc_slots=tuple(sorted(rc_list)))
 
@@ -1247,18 +1512,23 @@ def build_flat_arrays(
     if got is not None:
         pf_k1, pf_k2, pf_subj, (u_k1, u_gk, u_until, u_fan), pff = got
         pfh = put_block(
-            "pfx", "pfh_off", lambda: build_hash([pf_k1, pf_k2]),
+            "pfx", "pfh_off", lambda: build_hash([pf_k1, pf_k2], **hk),
             [pf_k1, pf_k2],
             [pf_k1, pf_k2]
             + ([fr.e_cav, fr.e_ctx] if pff["pf_hascav"] else [])
             + ([fr.e_until] if pff["pf_hasuntil"] else []),
         )
+        dom["until"]["pfx"] = _until_dom(fr.e_until)
+        dom["until"]["pfux"] = _until_dom(u_until)
         s_fan = _round_fan(max(s_run, 1))
         fold_slots = tuple(sorted({s for _, s in fr.pairs}))
+        dom["fan"]["pfugx"] = u_fan
+        dom["fan"]["csrgx"] = s_fan
         pf_arrays, pf_kw = _pf_view_tables(
             u_k1, u_gk, u_until, u_fan,
             cl_k1, cl_k2, cl.c_d_until, cl.c_p_until, s_fan,
             maps=maps, N=N, S1=S1, fold_slots=fold_slots, config=config,
+            hk=hk,
         )
         out.update(pf_arrays)
         fold_kw = dict(
@@ -1320,6 +1590,13 @@ def build_flat_arrays(
             or np.isin(cl.ovf_src[cl.ovf_srel1 == 0], wc_nodes).any()
         ),
     )
+    if PKD:
+        with _mt.timer("prepare.pack_lanes_s"):
+            pk_up = _pack_flat(out, meta, config, dom, pack_off=True)
+        if pk_up:
+            from dataclasses import replace as _dc_replace
+
+            meta = _dc_replace(meta, **pk_up)
     cstate = (
         _closure_host_state(snap, cl, config, us_gk, t_kw.get("t_slots", ()))
         if config.closure_delta and BS
@@ -1581,9 +1858,17 @@ def build_flat_arrays_sharded(
         )
     _t_part = time.perf_counter()
 
-    clh = build_hash([cl_k1, cl_k2], min_size=ms)
-    push = build_hash([pus_k], min_size=ms)
-    ovfh = build_hash([ovf_k], min_size=ms)
+    PKD = config.packed_on()
+    hk = (
+        {"max_factor": config.flat_packed_max_factor, "lean": True}
+        if PKD else {}
+    )
+    dom = _pack_domains(snap, config)
+    dom["until"]["clx"] = _until_dom(cl.c_d_until, cl.c_p_until)
+
+    clh = build_hash([cl_k1, cl_k2], min_size=ms, **hk)
+    push = build_hash([pus_k], min_size=ms, **hk)
+    ovfh = build_hash([ovf_k], min_size=ms, **hk)
 
     out: Dict[str, np.ndarray] = {}
     e_gates = (
@@ -1595,7 +1880,9 @@ def build_flat_arrays_sharded(
             snap.e_rel, snap.e_res, snap.e_subj, snap.e_srel1,
             maps, N, S1, config.flat_partition_chunk,
         )
-        ge, e_ord = point_geom(h_e, M, min_size=ms, return_order=True)
+        ge, e_ord = point_geom(
+            h_e, M, min_size=ms, return_order=True, **hk
+        )
         out["eh_off"], out["ehx"] = stack_point(
             h_e, _e_cols_at(snap, maps, N, S1, e_gates), ge,
             2 + len(e_gates), order=e_ord,
@@ -1605,7 +1892,7 @@ def build_flat_arrays_sharded(
     else:
         e_k1 = _pack(maps.k1[snap.e_rel], N, snap.e_res)
         e_k2 = _pack(snap.e_subj, S1, _m_srel1(maps, snap.e_srel1))
-        eh = build_hash([e_k1, e_k2], min_size=ms)
+        eh = build_hash([e_k1, e_k2], min_size=ms, **hk)
         out["eh_off"], out["ehx"] = _stack_point(eh, [e_k1, e_k2] + e_gates, M)
         eh_cap, eh_n = eh.cap, eh.n
     out["clh_off"], out["clx"] = _stack_point(
@@ -1632,32 +1919,36 @@ def build_flat_arrays_sharded(
         h_usg = _hash_cols([us_gkg])
         gus = range_geom(
             us_gkg, us_ghi - us_glo, h_usg, M, min_size=ms,
-            fan_pad=max(64, config.us_leaf_cap),
+            fan_pad=max(64, config.us_leaf_cap), **hk,
         )
         out["usr_off"], out["usgx"], out["usx"] = stack_range(
             us_gkg, us_glo, us_ghi - us_glo, h_usg,
             gather_cols(us_cols), gus, len(us_cols),
         )
         usr_cap = gus.cap
+        dom["fan"]["usgx"] = gus.max_run
         h_arg = _hash_cols([ar_gkg])
         gar = range_geom(
             ar_gkg, ar_ghi - ar_glo, h_arg, M, min_size=ms,
-            fan_pad=max(64, config.arrow_fanout),
+            fan_pad=max(64, config.arrow_fanout), **hk,
         )
         out["arr_off"], out["argx"], out["arx"] = stack_range(
             ar_gkg, ar_glo, ar_ghi - ar_glo, h_arg,
             gather_cols(ar_cols), gar, len(ar_cols),
         )
         arr_cap = gar.cap
+        dom["fan"]["argx"] = gar.max_run
     else:
-        usr = build_range_hash(us_gk, min_size=ms)
-        arr = build_range_hash(ar_gk, min_size=ms)
+        usr = build_range_hash(us_gk, min_size=ms, **hk)
+        arr = build_range_hash(ar_gk, min_size=ms, **hk)
         out["usr_off"], out["usgx"], out["usx"], usr_cap = _stack_range(
             usr, us_cols, M, max(64, config.us_leaf_cap),
         )
         out["arr_off"], out["argx"], out["arx"], arr_cap = _stack_range(
             arr, ar_cols, M, max(64, config.arrow_fanout),
         )
+        dom["fan"]["usgx"] = usr.max_run
+        dom["fan"]["argx"] = arr.max_run
         # the RangeIndexes already hold the group arrays: reuse them for
         # the per-slot fanout meta instead of a second sorted-runs pass
         us_gkg, us_glo, us_ghi = usr.gk, usr.glo, usr.ghi
@@ -1667,16 +1958,19 @@ def build_flat_arrays_sharded(
     tj = _tindex_join(snap, config, cl, us_gk, cl_k1, cl_k2, pus_k, maps)
     if tj is not None:
         T_k1, T_k2, T_d, T_p, t_slots = tj
+        dom["until"]["tx"] = _until_dom(T_d, T_p)
         if PART:
             h_T = _hash_cols([T_k1, T_k2])
-            gT, t_ord = point_geom(h_T, M, min_size=ms, return_order=True)
+            gT, t_ord = point_geom(
+                h_T, M, min_size=ms, return_order=True, **hk
+            )
             out["th_off"], out["tx"] = stack_point(
                 h_T, gather_cols([T_k1, T_k2, T_d, T_p]), gT, 4,
                 order=t_ord,
             )
             th_cap, th_n = gT.cap, gT.n
         else:
-            th = build_hash([T_k1, T_k2], min_size=ms)
+            th = build_hash([T_k1, T_k2], min_size=ms, **hk)
             out["th_off"], out["tx"] = _stack_point(
                 th, [T_k1, T_k2, T_d, T_p], M
             )
@@ -1692,7 +1986,7 @@ def build_flat_arrays_sharded(
     fold_kw: Dict = {}
     got = _fold_packed(fr, snap, maps, N, config) if fr is not None else None
     if got is not None:
-        csr = build_range_hash(cl_k1, min_size=ms)
+        csr = build_range_hash(cl_k1, min_size=ms, **hk)
         if int(csr.max_run) > config.flat_fold_subj_fan_cap:
             got = None
     if got is not None:
@@ -1702,16 +1996,20 @@ def build_flat_arrays_sharded(
             + ([fr.e_cav, fr.e_ctx] if pff["pf_hascav"] else [])
             + ([fr.e_until] if pff["pf_hasuntil"] else [])
         )
+        dom["until"]["pfx"] = _until_dom(fr.e_until)
+        dom["until"]["pfux"] = _until_dom(u_until)
         if PART:
             h_pf = _hash_cols([pf_k1, pf_k2])
-            gpf, pf_ord = point_geom(h_pf, M, min_size=ms, return_order=True)
+            gpf, pf_ord = point_geom(
+                h_pf, M, min_size=ms, return_order=True, **hk
+            )
             out["pfh_off"], out["pfx"] = stack_point(
                 h_pf, gather_cols(pf_cols), gpf, len(pf_cols),
                 order=pf_ord,
             )
             pfh_cap = gpf.cap
         else:
-            pfh = build_hash([pf_k1, pf_k2], min_size=ms)
+            pfh = build_hash([pf_k1, pf_k2], min_size=ms, **hk)
             out["pfh_off"], out["pfx"] = _stack_point(pfh, pf_cols, M)
             pfh_cap = pfh.cap
         if PART:
@@ -1721,7 +2019,7 @@ def build_flat_arrays_sharded(
             h_pfu = _hash_cols([pfu_gk])
             gpfu = range_geom(
                 pfu_gk, pfu_ghi - pfu_glo, h_pfu, M, min_size=ms,
-                fan_pad=max(64, u_fan),
+                fan_pad=max(64, u_fan), **hk,
             )
             out["pfu_off"], out["pfugx"], out["pfux"] = stack_range(
                 pfu_gk, pfu_glo, pfu_ghi - pfu_glo, h_pfu,
@@ -1729,11 +2027,13 @@ def build_flat_arrays_sharded(
             )
             pfu_cap = gpfu.cap
         else:
-            pfu = build_range_hash(u_k1, min_size=ms)
+            pfu = build_range_hash(u_k1, min_size=ms, **hk)
             out["pfu_off"], out["pfugx"], out["pfux"], pfu_cap = _stack_range(
                 pfu, [u_gk, u_until], M, max(64, u_fan)
             )
         s_fan = _round_fan(max(int(csr.max_run), 1))
+        dom["fan"]["pfugx"] = u_fan
+        dom["fan"]["csrgx"] = s_fan
         out["csr_off"], out["csrgx"], out["csrx"], csr_cap = _stack_range(
             csr, [cl_k2, cl.c_d_until, cl.c_p_until], M, max(64, s_fan)
         )
@@ -1760,6 +2060,8 @@ def build_flat_arrays_sharded(
     for ts_slot, (src, anc, d_u, p_u, fan) in _rc_build(
         snap, config, plan, ar_dd
     ).items():
+        dom["until"][f"rc{ts_slot}x"] = _until_dom(d_u, p_u)
+        dom["fan"][f"rc{ts_slot}gx"] = fan
         if PART:
             # ancestor-closure view (src arrives sorted): partitioned
             # group stacking — O(rc/M) fill scratch per shard
@@ -1767,7 +2069,7 @@ def build_flat_arrays_sharded(
             h_rc = _hash_cols([rc_gk])
             grc = range_geom(
                 rc_gk, rc_ghi - rc_glo, h_rc, M, min_size=ms,
-                fan_pad=max(64, fan),
+                fan_pad=max(64, fan), **hk,
             )
             (
                 out[f"rc{ts_slot}_off"],
@@ -1779,7 +2081,7 @@ def build_flat_arrays_sharded(
             )
             gcap = grc.cap
         else:
-            ri = build_range_hash(src, min_size=ms)
+            ri = build_range_hash(src, min_size=ms, **hk)
             (
                 out[f"rc{ts_slot}_off"],
                 out[f"rc{ts_slot}gx"],
@@ -1825,6 +2127,13 @@ def build_flat_arrays_sharded(
             or np.isin(cl.ovf_src[cl.ovf_srel1 == 0], wc_nodes).any()
         ),
     )
+    if PKD:
+        with metrics.default.timer("prepare.pack_lanes_s"):
+            pk_up = _pack_flat(out, meta, config, dom, pack_off=False)
+        if pk_up:
+            from dataclasses import replace as _dc_replace
+
+            meta = _dc_replace(meta, **pk_up)
     # closure-delta maintenance is single-chip for now: the sharded
     # incremental prepare bails to a full rebuild on membership rows
     return out, meta, fstate, None
@@ -2087,6 +2396,57 @@ def build_delta_arrays(
     out: Dict[str, np.ndarray] = {}
     meta_up: Dict = {}
     new_chs = chs
+    # packed-base maintenance: reshipped closure-derived tables repack
+    # with the BASE spec (no retrace) when their values still fit; a
+    # value outside the pinned domain (e.g. a fresh expiring membership
+    # edge under a {NEVER, NO_EXP} dictionary) DESPECS that one table —
+    # the kernel reads it raw for the rest of the chain (one retrace,
+    # never a wrong decode)
+    from . import packed as _pkm
+
+    pk_map = dict(meta.packed)
+    pko_map = dict(meta.packed_off)
+    pk_drop: set = set()
+    pko_drop: set = set()
+    drop_keys: List[str] = []
+    hk = (
+        {"max_factor": config.flat_packed_max_factor, "lean": True}
+        if config.packed_on() else {}
+    )
+
+    def _repack_tbl(tbl_key: str, tbl: np.ndarray) -> np.ndarray:
+        spec = pk_map.get(tbl_key)
+        if spec is None or tbl_key in pk_drop:
+            return tbl
+        try:
+            return _pkm.pack_rows(tbl, spec)
+        except _pkm.PackError:
+            pk_drop.add(tbl_key)
+            return tbl
+
+    def _reship_off(off_key: str, off: np.ndarray) -> None:
+        if off_key in pko_map and off_key not in pko_drop:
+            got = _pkm.pack_off(off)
+            if got is not None:
+                out[off_key], out[off_key + "_a"] = got
+                return
+            pko_drop.add(off_key)
+            drop_keys.append(off_key + "_a")
+        out[off_key] = off
+
+    def _extras() -> Dict:
+        if pk_drop:
+            meta_up["packed"] = tuple(
+                t for t in meta.packed if t[0] not in pk_drop
+            )
+        if pko_drop:
+            meta_up["packed_off"] = tuple(
+                t for t in meta.packed_off if t[0] not in pko_drop
+            )
+        return {
+            "meta_up": meta_up, "closure_state": new_chs,
+            "drop_keys": drop_keys,
+        }
 
     # ---- membership-closure advance ------------------------------------
     if mem_any:
@@ -2158,28 +2518,46 @@ def build_delta_arrays(
         cl_k2 = (
             new_cl.c_g.astype(np.int64) * S1 + grel_d + 1
         ).astype(np.int32)
-        aligned_tbls = {t[0]: (t[1], t[2], t[3]) for t in meta.aligned}
+        aligned_tbls = {t[0]: (t[1], t[2]) for t in meta.aligned}
 
         def reship_point(tbl_key, off_key, key_cols, cols,
                          cap_key, n_key):
             """Rebuild one closure-derived point table in the base
             layout.  Aligned tables must reproduce their exact geometry
-            (cap/width/spill are part of the compiled kernel) — a
+            (width/cap ladder are part of the compiled kernel) — a
             mismatch rebuilds; the legacy layout just re-buckets and
-            records the (pow2-stable) cap/size in meta_up."""
+            records the (pow2-stable) cap/size in meta_up.  Packed
+            tables repack under the base spec (despec'd on misfit)."""
             if tbl_key in aligned_tbls and tbl_key + "_al" in prev_dsnap.arrays:
                 ai = build_aligned(
-                    key_cols, cols, max_bytes=config.flat_aligned_max_bytes
+                    key_cols, cols, max_bytes=config.flat_aligned_max_bytes,
+                    cover=config.flat_aligned_cover,
                 )
-                if ai is None or (ai.cap, ai.w, ai.spill_cap) != aligned_tbls[tbl_key]:
+                if ai is None or (ai.w, ai.caps) != aligned_tbls[tbl_key]:
                     return False
-                out[tbl_key + "_al"] = ai.tbl
-                if ai.spill is not None:
-                    out[tbl_key + "_als"] = ai.spill
+                spec = pk_map.get(tbl_key)
+                packed_lvls = []
+                if spec is not None and tbl_key not in pk_drop:
+                    try:
+                        for tbl, _c in ai.levels:
+                            size, roww = tbl.shape
+                            cap = roww // ai.w
+                            packed_lvls.append(_pkm.pack_rows(
+                                tbl.reshape(size * cap, ai.w), spec
+                            ).reshape(size, cap * spec[1]))
+                    except _pkm.PackError:
+                        pk_drop.add(tbl_key)
+                        packed_lvls = []
+                if packed_lvls:
+                    for lvl, tbl in enumerate(packed_lvls):
+                        out[_al_key(tbl_key, lvl)] = tbl
+                else:
+                    for lvl, (tbl, _c) in enumerate(ai.levels):
+                        out[_al_key(tbl_key, lvl)] = tbl
                 return True
-            h = build_hash(key_cols)
-            out[off_key] = h.off
-            out[tbl_key] = interleave_buckets(h, cols)
+            h = build_hash(key_cols, **hk)
+            _reship_off(off_key, h.off)
+            out[tbl_key] = _repack_tbl(tbl_key, interleave_buckets(h, cols))
             meta_up[cap_key] = _round_cap(h.cap)
             meta_up[n_key] = _ceil_pow2(max(h.n, 1))
             return True
@@ -2232,11 +2610,11 @@ def build_delta_arrays(
             # offset array per revision costs more host time + H2D than
             # the whole write budget; the probe-side hash penalty only
             # applies until the next full prepare restores direct
-            csr = build_range_hash(cl_k1)
-            out["csr_off"] = csr.index.off
-            out["csrgx"] = interleave_buckets(
+            csr = build_range_hash(cl_k1, **hk)
+            _reship_off("csr_off", csr.index.off)
+            out["csrgx"] = _repack_tbl("csrgx", interleave_buckets(
                 csr.index, [csr.gk, csr.glo, csr.ghi]
-            )
+            ))
             meta_up["pf_s_cap"] = _round_cap(csr.index.cap)
             meta_up["pf_s_direct"] = False
 
@@ -2456,7 +2834,7 @@ def build_delta_arrays(
         if got is None:
             acc["pf_off"] = True
             kw.update(pf_off=True)
-            return out, DeltaMeta(**kw), acc, {"meta_up": meta_up, "closure_state": new_chs}
+            return out, DeltaMeta(**kw), acc, _extras()
         dirty_k1, ovl = got
         if dirty_k1.shape[0]:
             pdh = floored_hash([dirty_k1])
@@ -2472,7 +2850,7 @@ def build_delta_arrays(
                 # pf_off — folded pairs walk until compaction re-folds)
                 acc["pf_off"] = True
                 kw.update(pf_off=True)
-                return out, DeltaMeta(**kw), acc, {"meta_up": meta_up, "closure_state": new_chs}
+                return out, DeltaMeta(**kw), acc, _extras()
             pf_k1, pf_k2, pf_subj, (u_k1, u_gk, u_until, u_fan), pff = packed
             if pf_k1.shape[0]:
                 peh = floored_hash([pf_k1, pf_k2])
@@ -2512,7 +2890,7 @@ def build_delta_arrays(
                     pfo_u_fan=fan,
                 )
 
-    return out, DeltaMeta(**kw), acc, {"meta_up": meta_up, "closure_state": new_chs}
+    return out, DeltaMeta(**kw), acc, _extras()
 
 
 # ---------------------------------------------------------------------------
@@ -2686,6 +3064,30 @@ def make_flat_fn(
         BS = meta.blockslice
         eL, usL, arL = e_layout(meta), us_layout(meta), ar_layout(meta)
 
+        # HBM-lean packed tables (engine/packed.py): uint16-lane arrays
+        # decode with shift/mask ops fused into the consuming compares;
+        # packed offset arrays read anchor + residual.  Both maps are
+        # empty on unpacked snapshots and every helper then passes
+        # through untouched — one code path serves both layouts
+        PK = dict(meta.packed)
+        PKO = dict(meta.packed_off)
+
+        def _dec(tbl_key: str, blk):
+            spec = PK.get(tbl_key)
+            return blk if spec is None else _pk_decode(blk, spec)
+
+        def off_read(off_key: str, idx):
+            A = PKO.get(off_key)
+            if A is None:
+                return tk(arrs[off_key], idx)
+            return tk(arrs[off_key + "_a"], idx >> A) + tk(
+                arrs[off_key], idx
+            ).astype(jnp.int32)
+
+        def sblock(tbl_key: str, lo, cap: int):
+            """slice_blocks through the packed decode."""
+            return _dec(tbl_key, slice_blocks(arrs[tbl_key], lo, cap))
+
         _view_flags = {
             "e": (meta.e_hascav, meta.e_hasexp),
             "us": (meta.us_hascav, meta.us_hasexp),
@@ -2786,27 +3188,38 @@ def make_flat_fn(
                 h = h & mine[..., None]
             return h
 
-        ALD = {k: (c, w, s) for (k, c, w, s) in meta.aligned}
+        ALD = {k: (w, caps) for (k, w, caps) in meta.aligned}
 
         def pblock(off_key: str, tbl_key: str, cap: int, q_cols):
-            """Layout-dispatched bucket probe: (blk, mine).
+            """Layout-dispatched bucket probe: (blk, mine) — the block
+            already DECODED to logical int32 columns when the table is
+            packed.
 
             Bucket-ALIGNED tables (``{tbl_key}_al`` present, unsharded
-            base layout) probe with ONE row gather (+ salted spill);
-            otherwise the off+interleave block slice.  Sharded tables
-            derive bpd from the LOCAL off length (shapes inside shard_map
-            are per-shard)."""
+            base layout) probe with one row gather per width-stratum
+            level; otherwise the off+interleave block slice.  Sharded
+            tables derive bpd from the LOCAL off length (shapes inside
+            shard_map are per-shard)."""
             if not SH:
                 al = ALD.get(tbl_key)
                 if al is not None and tbl_key + "_al" in arrs:
-                    c, w_, sc = al
-                    return probe_aligned(
-                        arrs[tbl_key + "_al"], arrs.get(tbl_key + "_als"),
-                        c, w_, sc, q_cols,
-                    ), None
-                return probe_block(
-                    arrs[off_key], arrs[tbl_key], cap, q_cols
-                ), None
+                    w_, caps = al
+                    spec = PK.get(tbl_key)
+                    sw = w_ if spec is None else spec[1]
+                    tbls = [
+                        arrs[_al_key(tbl_key, lvl)]
+                        for lvl in range(len(caps))
+                        if _al_key(tbl_key, lvl) in arrs
+                    ]
+                    return _dec(tbl_key, probe_aligned(
+                        tbls, caps[: len(tbls)], sw, q_cols
+                    )), None
+                size = arrs[off_key].shape[0] - 1
+                h = (
+                    mix32(q_cols, jnp) & jnp.uint32(size - 1)
+                ).astype(jnp.int32)
+                start = off_read(off_key, h)
+                return sblock(tbl_key, start, cap), None
             off, tbl = arrs[off_key], arrs[tbl_key]
             if PART and tbl_key not in PART_SHARDED_TBLS:
                 # whole-resident stacked table: resolve the owner shard's
@@ -2824,7 +3237,7 @@ def make_flat_fn(
                 start = take_in_bounds(
                     off, s * jnp.int32(bpd + 1) + (h & jnp.int32(bpd - 1))
                 ) + s * R_pad
-                return slice_blocks(tbl, start, cap), None
+                return sblock(tbl_key, start, cap), None
             bpd = off.shape[0] - 1
             h = (
                 mix32(q_cols, jnp) & jnp.uint32(bpd * model_size - 1)
@@ -2834,10 +3247,10 @@ def make_flat_fn(
             # construction — no mask, no psum at the site
             if routed:
                 start = take_in_bounds(off, h & jnp.int32(bpd - 1))
-                return slice_blocks(tbl, start, cap), None
+                return sblock(tbl_key, start, cap), None
             mine = (h // jnp.int32(bpd)) == me
             start = take_in_bounds(off, h & jnp.int32(bpd - 1))
-            return slice_blocks(tbl, start, cap), mine
+            return sblock(tbl_key, start, cap), mine
 
         def range_probe(off_key: str, tbl_key: str, cap: int, q,
                         rep: bool = False, rows_key: Optional[str] = None):
@@ -2984,7 +3397,7 @@ def make_flat_fn(
                     jnp.arange(fanS, dtype=jnp.int32) < (hi - lo)[..., None]
                 ) & ok[..., None]
                 if not split:
-                    blk = slice_blocks(arrs["csrx"], lo, fanS)
+                    blk = sblock("csrx", lo, fanS)
                     blk = vbcast(valid[..., None], blk)
                     valid = por(valid)
                     gk = jnp.where(valid, blk[..., 0], -1)
@@ -3113,7 +3526,7 @@ def make_flat_fn(
                     jnp.arange(fanU, dtype=jnp.int32) < (hi - lo)[..., None]
                 ) & exists[..., None]
                 if not split_u:
-                    ublk = slice_blocks(arrs["pfux"], lo, fanU)
+                    ublk = sblock("pfux", lo, fanU)
                     ublk = vbcast(valid[..., None], ublk)
                     valid = por(valid)
                     gk = jnp.where(valid, ublk[..., 0], -1)
@@ -3352,8 +3765,10 @@ def make_flat_fn(
                 valid = (
                     jnp.arange(fan, dtype=jnp.int32) < (hi - lo)[..., None]
                 ) & exists[..., None]
-                tbl = arrs["usx" if not rep else "dl_usx"]
-                ublk = slice_blocks(tbl, lo, fan)
+                key = "usx" if not rep else "dl_usx"
+                ublk = sblock(key, lo, fan) if not rep else slice_blocks(
+                    arrs[key], lo, fan
+                )
                 if SH and not rep:
                     ublk = vbcast(valid[..., None], ublk)
                     valid = por(valid)
@@ -3496,7 +3911,9 @@ def make_flat_fn(
             if progs:
                 ntype = jnp.where(
                 nodes >= 0,
-                tk(node_type, jnp.clip(nodes, 0, node_type.shape[0] - 1)),
+                tk(
+                    node_type, jnp.clip(nodes, 0, node_type.shape[0] - 1)
+                ).astype(jnp.int32),
                 -1,
             )
             width = 1
@@ -3553,7 +3970,7 @@ def make_flat_fn(
             valid = (
                 jnp.arange(fan, dtype=jnp.int32) < (hi - lo)[..., None]
             ) & exists[..., None]
-            blk = slice_blocks(arrs[f"rc{ts_slot}x"], lo, fan)
+            blk = sblock(f"rc{ts_slot}x", lo, fan)
             if SH:
                 blk = vbcast(valid[..., None], blk)
                 valid = por(valid)
@@ -3653,7 +4070,7 @@ def make_flat_fn(
                     children = jnp.full(nodes.shape + (0,), -1, jnp.int32)
                     gd = gp = jnp.zeros(nodes.shape + (0,), bool)
                 elif BS:
-                    ablk = slice_blocks(arrs["arx"], lo, Ks)
+                    ablk = sblock("arx", lo, Ks)
                     if SH:
                         # the owning shard's rows broadcast; every shard
                         # then recurses on the SAME children lattice

@@ -30,7 +30,7 @@ replacement.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -76,6 +76,7 @@ def build_hash(
     target_cap: int = 4,
     min_size: int = 8,
     max_factor: int = 8,
+    lean: bool = False,
 ) -> HashIndex:
     """Index the rows of lock-step int32 key columns by hash bucket.
 
@@ -101,7 +102,9 @@ def build_hash(
     h_full = mix32_native(cols)
     if h_full is None:
         h_full = mix32(cols, np)
-    size = _ceil_pow2(2 * n, min_size)
+    # lean (HBM-packed) sizing starts at ~1 entry/bucket instead of 0.5:
+    # the probe cap absorbs the deeper buckets, the offsets array halves
+    size = _ceil_pow2(n if lean else 2 * n, min_size)
     # growth chases a small max bucket, but the max of n Poisson draws
     # grows with log n: beyond ~16M rows target_cap=4 is statistically
     # unreachable and doubling would only balloon the offsets array (the
@@ -363,20 +366,55 @@ def slice_blocks(tbl, start, cap: int):
 _SPILL_SALT = np.int32(np.uint32(0x9E3779B9).astype(np.int32))
 
 
+def _level_salt(lvl: int) -> np.int32:
+    """Per-stratum probe salt (level 0 unsalted; level 1 == the classic
+    spill salt).  uint32 wrap-around so deep ladders don't overflow."""
+    return np.int32(
+        np.uint32((0x9E3779B9 * lvl) & 0xFFFFFFFF).astype(np.int32)
+    )
+
+
 @dataclass
 class AlignedIndex:
-    """Bucket-aligned probe table (+ optional spill level)."""
+    """Bucket-aligned probe table: a ladder of WIDTH-STRATIFIED levels.
 
-    tbl: np.ndarray  # int32[size, cap*w]
-    cap: int
+    Level 0 holds a cap covering most entries; whatever overflows
+    re-hashes (salted) into the next, much smaller level with its own
+    cap — per-bucket width classes instead of one table-wide row width
+    set by the fullest bucket (the round-5 99.9%-cover trick
+    generalized; ``build_aligned``'s ``cover`` ladder picks the caps at
+    prepare time).  The classic layout is the 2-level instance
+    (primary + spill); ``tbl``/``cap``/``spill``/``spill_cap`` remain
+    as views of levels 0/1 for it."""
+
+    levels: List[Tuple[np.ndarray, int]]  # [(int32[size_i, cap_i*w], cap_i)]
     w: int
-    spill: Optional[np.ndarray]  # int32[size2, spill_cap*w] or None
-    spill_cap: int  # 0 when spill is None
     n: int
 
     @property
+    def tbl(self) -> np.ndarray:
+        return self.levels[0][0]
+
+    @property
+    def cap(self) -> int:
+        return self.levels[0][1]
+
+    @property
+    def spill(self) -> Optional[np.ndarray]:
+        return self.levels[1][0] if len(self.levels) > 1 else None
+
+    @property
+    def spill_cap(self) -> int:
+        return self.levels[1][1] if len(self.levels) > 1 else 0
+
+    @property
+    def caps(self) -> Tuple[int, ...]:
+        """The width-class ladder (probe geometry; rides FlatMeta)."""
+        return tuple(c for _, c in self.levels)
+
+    @property
     def nbytes(self) -> int:
-        return self.tbl.nbytes + (0 if self.spill is None else self.spill.nbytes)
+        return sum(t.nbytes for t, _ in self.levels)
 
 
 def _aligned_fill(
@@ -416,6 +454,23 @@ def _aligned_fill(
     return tbl, order[~fits]
 
 
+def _cover_cap(counts: np.ndarray, n: int, start_cap: int, bound: int,
+               q: float) -> int:
+    """Smallest cap ≥ ``start_cap`` whose buckets hold ≥ q of the n
+    entries, bounded — the per-level width-class choice."""
+    cap_need = int(counts.max()) if counts.size else 1
+    if cap_need <= start_cap:
+        return min(start_cap, max(cap_need, 1)) if start_cap else 1
+    hist = np.bincount(np.minimum(counts, cap_need))
+    ge = np.cumsum(hist[::-1])[::-1]  # ge[j] = #buckets with count>=j
+    coverage = np.cumsum(ge[1:])  # coverage[c-1] = entries held at cap c
+    bound = min(bound, cap_need)
+    c = max(start_cap, 1)
+    while c < bound and coverage[c - 1] < q * n:
+        c += 1
+    return c
+
+
 def build_aligned(
     key_cols: Sequence[np.ndarray],
     cols: Sequence[np.ndarray],
@@ -424,92 +479,110 @@ def build_aligned(
     spill_max_cap: int = 16,
     min_size: int = 8,
     max_bytes: Optional[int] = None,
+    cover: Sequence[float] = (0.999,),
 ) -> Optional[AlignedIndex]:
     """Bucket-aligned index over lock-step int32 columns (``key_cols``
     must be a prefix of ``cols`` — the probe compares them in order).
-    Returns None when the layout doesn't fit (spill tail too deep for
-    ``spill_max_cap`` — e.g. one full key duplicated >cap+spill_cap
-    times — or ``max_bytes`` exceeded): callers fall back to the
-    off+interleave layout."""
+
+    ``cover`` is the width-stratification ladder: level i's cap is the
+    smallest covering ``cover[i]`` of its entries; whatever overflows
+    re-hashes (level-salted) into the next level, and a FINAL fit-all
+    level closes the ladder.  ``cover=(0.999,)`` is the classic
+    primary+spill pair; ``(0.99, 0.999)`` trades a narrower primary row
+    (most of the table's bytes) for one extra mid level that still
+    probes with a single row gather.  Returns None when the layout
+    doesn't fit (final-level tail too deep for ``spill_max_cap`` — e.g.
+    one full key duplicated beyond every cap — or ``max_bytes``
+    exceeded): callers fall back to the off+interleave layout."""
     w = max(len(cols), 1)
     n = int(cols[0].shape[0]) if cols else 0
     if n == 0:
         return AlignedIndex(
-            tbl=np.full((min_size, target_cap * w), -1, np.int32),
-            cap=target_cap, w=w, spill=None, spill_cap=0, n=0,
+            levels=[(np.full((min_size, target_cap * w), -1, np.int32),
+                     target_cap)],
+            w=w, n=0,
         )
     ckey = [np.ascontiguousarray(c, np.int32) for c in key_cols]
-    h_full = mix32(ckey, np)
+    ccols = [np.ascontiguousarray(c, np.int32) for c in cols]
     size = _ceil_pow2(max(min_size, (2 * n) // max(target_cap, 1)))
     if max_bytes is not None and size * target_cap * w * 4 > max_bytes:
         return None
-    h = (h_full & np.uint32(size - 1)).astype(np.int64)
-    # probe cost is LATENCY-bound on TPU (one ~64-256B row fetch per
-    # level), so a somewhat wider primary row that holds ~all entries in
-    # ONE gather beats primary+spill's two.  Widen to the smallest cap
-    # covering 99.9% of ENTRIES, bounded to 3x target_cap — a single hot
-    # key (or the deepest Poisson bucket) must never set the whole
-    # table's row width; whatever still overflows takes the spill level
-    counts = np.bincount(h, minlength=size)
-    cap_need = int(counts.max())
-    if cap_need > target_cap:
-        hist = np.bincount(np.minimum(counts, cap_need))
-        ge = np.cumsum(hist[::-1])[::-1]  # ge[j] = #buckets with count>=j
-        coverage = np.cumsum(ge[1:])  # coverage[c-1] = entries held at cap c
-        bound = min(spill_max_cap, 3 * target_cap, cap_need)
-        c = target_cap
-        while c < bound and coverage[c - 1] < 0.999 * n:
-            c += 1
-        if max_bytes is None or size * c * w * 4 <= max_bytes:
-            target_cap = c
-    tbl, left = _aligned_fill(h, cols, size, target_cap, counts=counts)
-    spill = None
-    spill_cap = 0
-    if left.shape[0]:
-        ckey2 = [ckey[0][left] ^ _SPILL_SALT] + [c[left] for c in ckey[1:]]
-        h2_full = mix32(ckey2, np)
-        n2 = int(left.shape[0])
-        size2 = _ceil_pow2(max(min_size, n2))
-        cols2 = [np.ascontiguousarray(c, np.int32)[left] for c in cols]
-        while True:
-            h2 = (h2_full & np.uint32(size2 - 1)).astype(np.int64)
-            cap2 = int(np.bincount(h2, minlength=size2).max())
-            if cap2 <= spill_max_cap:
+    levels: List[Tuple[np.ndarray, int]] = []
+    left = np.arange(0, 0, dtype=np.int64)  # current leftover row ids
+    cur_key, cur_cols, cur_n = ckey, ccols, n
+    for lvl, q in enumerate(tuple(cover) + (None,)):
+        if lvl > 0:
+            cur_key = [ckey[0][left] ^ _level_salt(lvl)] + [
+                c[left] for c in ckey[1:]
+            ]
+            cur_cols = [c[left] for c in ccols]
+            cur_n = int(left.shape[0])
+            if cur_n == 0:
                 break
-            if size2 >= _ceil_pow2(8 * n2):
-                return None  # duplicate-heavy tail: aligned layout unfit
-            size2 <<= 1
-        spill, left2 = _aligned_fill(h2, cols2, size2, cap2)
-        if left2.shape[0]:
-            return None
-        spill_cap = cap2
-    out = AlignedIndex(
-        tbl=tbl, cap=target_cap, w=w, spill=spill, spill_cap=spill_cap, n=n
-    )
+            size = _ceil_pow2(max(min_size, cur_n))
+        h_full = mix32(cur_key, np)
+        if q is None:
+            # final level: must hold every remaining entry (grow until
+            # the fullest bucket fits spill_max_cap, else unfit)
+            while True:
+                h = (h_full & np.uint32(size - 1)).astype(np.int64)
+                cap = int(np.bincount(h, minlength=size).max())
+                if cap <= spill_max_cap:
+                    break
+                if size >= _ceil_pow2(8 * cur_n):
+                    return None  # duplicate-heavy tail: aligned unfit
+                size <<= 1
+            tbl, over = _aligned_fill(h, cur_cols, size, cap)
+            if over.shape[0]:
+                return None
+            levels.append((tbl, cap))
+            break
+        h = (h_full & np.uint32(size - 1)).astype(np.int64)
+        counts = np.bincount(h, minlength=size)
+        # level 0 keeps the classic hot-key bound (3x target); deeper
+        # levels start at 1 — their whole point is a narrow width class
+        cap = _cover_cap(
+            counts, cur_n,
+            target_cap if lvl == 0 else 1,
+            spill_max_cap if lvl else min(spill_max_cap, 3 * target_cap),
+            q,
+        )
+        if lvl == 0 and max_bytes is not None and size * cap * w * 4 > max_bytes:
+            cap = target_cap
+        tbl, over = _aligned_fill(h, cur_cols, size, cap, counts=counts)
+        levels.append((tbl, cap))
+        left = left[over] if lvl > 0 else over
+        if left.shape[0] == 0:
+            break
+    out = AlignedIndex(levels=levels, w=w, n=n)
     if max_bytes is not None and out.nbytes > max_bytes:
         return None
     return out
 
 
-def probe_aligned(tbl, spill, cap: int, w: int, spill_cap: int, q_cols):
-    """Candidate block int32[..., cap (+ spill_cap), w] for the bucket of
-    ``q_cols`` — ONE row gather (+ one salted spill gather).  Padded slots
-    hold -1 and match nothing; same-key entries land in the same bucket
-    (or its spill row), so callers just compare key columns exactly."""
+def probe_aligned(tbls: Sequence, caps: Sequence[int], w: int, q_cols):
+    """Candidate block int32[..., sum(caps), w] for the bucket of
+    ``q_cols`` — ONE row gather per width-stratum level (each salted
+    with its level index).  Padded slots hold -1 and match nothing;
+    same-key entries land in the same bucket of SOME level, so callers
+    just compare key columns exactly."""
     import jax.numpy as jnp
 
-    h = (mix32(q_cols, jnp) & jnp.uint32(tbl.shape[0] - 1)).astype(jnp.int32)
-    blk = take_in_bounds(tbl, h).reshape(jnp.shape(h) + (cap, w))
-    if spill is not None:
-        q2 = (q_cols[0] ^ _SPILL_SALT,) + tuple(q_cols[1:])
-        h2 = (
-            mix32(q2, jnp) & jnp.uint32(spill.shape[0] - 1)
+    blks = []
+    for lvl, (tbl, cap) in enumerate(zip(tbls, caps)):
+        if lvl == 0:
+            qs = tuple(q_cols)
+        else:
+            qs = (q_cols[0] ^ jnp.int32(_level_salt(lvl)),) + tuple(
+                q_cols[1:]
+            )
+        h = (
+            mix32(qs, jnp) & jnp.uint32(tbl.shape[0] - 1)
         ).astype(jnp.int32)
-        b2 = take_in_bounds(spill, h2).reshape(
-            jnp.shape(h2) + (spill_cap, w)
+        blks.append(
+            take_in_bounds(tbl, h).reshape(jnp.shape(h) + (cap, w))
         )
-        blk = jnp.concatenate([blk, b2], axis=-2)
-    return blk
+    return blks[0] if len(blks) == 1 else jnp.concatenate(blks, axis=-2)
 
 
 def probe_block(off, tbl, cap: int, q_cols: Sequence):

@@ -87,6 +87,7 @@ def point_geom(
     target_cap: int = 4,
     min_size: int = 8,
     max_factor: int = 8,
+    lean: bool = False,
     pad: int = 64,
     return_order: bool = False,
 ):
@@ -106,7 +107,7 @@ def point_geom(
             R_pad=_ceil_pow2(max(pad, 1)),
         )
         return (geom, None) if return_order else geom
-    size = _ceil_pow2(2 * n, min_size)
+    size = _ceil_pow2(n if lean else 2 * n, min_size)
     if n > (1 << 24):
         # growth frozen (build_hash's own rule): the final size is known
         # up front, so cap comes from per-shard O(size/M) histograms over
@@ -175,12 +176,16 @@ def range_geom(
     *,
     min_size: int = 8,
     fan_pad: int = 64,
+    max_factor: int = 8,
+    lean: bool = False,
 ) -> RangeGeom:
     """Geometry from the distinct group keys' hashes + group lengths:
     per-shard row totals come from one weighted owner histogram (a
     bucket's groups — and hence their rows — live entirely in one
     shard), no partition pass."""
-    gh = point_geom(h_g, M, min_size=min_size, pad=64)
+    gh = point_geom(
+        h_g, M, min_size=min_size, pad=64, max_factor=max_factor, lean=lean
+    )
     G = int(gk.shape[0])
     if G:
         owner = shard_owner(h_g, gh.size, M).astype(np.int64)
@@ -289,6 +294,17 @@ class ShardSlices:
         for s in range(M):
             out[s * self.per : (s + 1) * self.per] = self.blocks[s]
         return out
+
+    def map_blocks(self, fn, w: int, dtype) -> "ShardSlices":
+        """A new ShardSlices with every owned block transformed (the
+        HBM-lean pack applies per block — each process packs only the
+        slices it owns, with the globally-agreed spec)."""
+        return ShardSlices(
+            shape=(self.shape[0], w) if len(self.shape) > 1 else self.shape,
+            dtype=np.dtype(dtype),
+            per=self.per,
+            blocks={s: fn(b) for s, b in self.blocks.items()},
+        )
 
     @property
     def nbytes(self) -> int:
@@ -604,6 +620,8 @@ def partition_feed(
     from .flat import (
         FlatMeta,
         _active_maps,
+        _pack_flat,
+        _until_dom,
         _arrow_data_depth,
         _ceil_pow2,
         _e_cols_at,
@@ -656,6 +674,22 @@ def partition_feed(
     cols.clear()
 
     num_slots = max(compiled.num_slots, 1)
+    # HBM-lean mode: the same bounded bucket growth as the reference
+    # builders (parity-critical), and the pack domains — all derived
+    # from replicated inputs (the raw feed + membership subgraph), so
+    # every process of a multihost build agrees on the packed layout
+    # before any table exists
+    PKD = config.packed_on()
+    hk = (
+        {"max_factor": config.flat_packed_max_factor, "lean": True}
+        if PKD else {}
+    )
+    _mx = lambda *cs: max(
+        [int(c.max()) for c in cs if c is not None and c.shape[0]] or [0]
+    )
+    dom: Dict = {
+        "max_cav": _mx(caveat), "max_ctx": _mx(ctx), "until": {}, "fan": {},
+    }
 
     # ---- replicated membership snapshot: userset rows ∪ feeders --------
     us_mask = srel1 > 0
@@ -785,7 +819,7 @@ def partition_feed(
     got = _fold_packed(fr, stub, maps, N, config) if fr is not None else None
     csr = None
     if got is not None:
-        csr = build_range_hash(cl_k1, min_size=ms)
+        csr = build_range_hash(cl_k1, min_size=ms, **hk)
         if int(csr.max_run) > config.flat_fold_subj_fan_cap:
             got = None
     rc_built = _rc_build(stub, config, plan, ar_dd)
@@ -795,7 +829,7 @@ def partition_feed(
         rel, res, subj, srel1, maps, N, S1,
         max(int(config.flat_partition_chunk), 1),
     )
-    ge = point_geom(h_e, M, min_size=ms)
+    ge = point_geom(h_e, M, min_size=ms, **hk)
     e_own_rows = np.flatnonzero(
         _owned_mask_of(shard_owner(h_e, ge.size, M), M, owned_t)
     )
@@ -823,12 +857,14 @@ def partition_feed(
     h_arg = _hash_cols([ar_gkg])
     gus = range_geom(
         us_gkg, us_ghi - us_glo, h_usg, M, min_size=ms,
-        fan_pad=max(64, config.us_leaf_cap),
+        fan_pad=max(64, config.us_leaf_cap), **hk,
     )
+    dom["fan"]["usgx"] = gus.max_run
     gar = range_geom(
         ar_gkg, ar_ghi - ar_glo, h_arg, M, min_size=ms,
-        fan_pad=max(64, config.arrow_fanout),
+        fan_pad=max(64, config.arrow_fanout), **hk,
     )
+    dom["fan"]["argx"] = gar.max_run
     us_fanouts = _run_maxes(us_gkg, us_glo, us_ghi, N, maps.k1_raw)
     ar_fanouts = _run_maxes(ar_gkg, ar_glo, ar_ghi, N, maps.k1_raw)
 
@@ -947,8 +983,9 @@ def partition_feed(
     t_kw = dict(has_tindex=False, t_cap=4, t_n=8, t_slots=())
     if tj is not None:
         T_k1, T_k2, T_d, T_p, t_slots = tj
+        dom["until"]["tx"] = _until_dom(T_d, T_p)
         h_T = _hash_cols([T_k1, T_k2])
-        gT = point_geom(h_T, M, min_size=ms)
+        gT = point_geom(h_T, M, min_size=ms, **hk)
         # owned slices on BOTH layouts: the T join is O(E·fold)-scale —
         # the largest table after the primary — so the routed placement
         # model-splits it like ehx/pfx.  Its bucket geometry differs
@@ -970,9 +1007,10 @@ def partition_feed(
 
     # globally-small tables: full stacked build on every process (their
     # inputs are the replicated closure / pus derivations)
-    clh = build_hash([cl_k1, cl_k2], min_size=ms)
-    push = build_hash([pus_k], min_size=ms)
-    ovfh = build_hash([ovf_k], min_size=ms)
+    dom["until"]["clx"] = _until_dom(cl.c_d_until, cl.c_p_until)
+    clh = build_hash([cl_k1, cl_k2], min_size=ms, **hk)
+    push = build_hash([pus_k], min_size=ms, **hk)
+    ovfh = build_hash([ovf_k], min_size=ms, **hk)
     out["clh_off"], out["clx"] = _stack_point(
         clh, [cl_k1, cl_k2, cl.c_d_until, cl.c_p_until], M
     )
@@ -990,12 +1028,16 @@ def partition_feed(
             + ([fr.e_cav, fr.e_ctx] if pff["pf_hascav"] else [])
             + ([fr.e_until] if pff["pf_hasuntil"] else [])
         )
+        dom["until"]["pfx"] = _until_dom(fr.e_until)
+        dom["until"]["pfux"] = _until_dom(u_until)
         h_pf = _hash_cols([pf_k1, pf_k2])
-        gpf = point_geom(h_pf, M, min_size=ms)
+        gpf = point_geom(h_pf, M, min_size=ms, **hk)
         out["pfh_off"], out["pfx"] = stack_point(
             h_pf, gather_cols(pf_cols), gpf, len(pf_cols), owned=owned_t
         )
         s_fan = _round_fan(max(int(csr.max_run), 1))
+        dom["fan"]["pfugx"] = u_fan
+        dom["fan"]["csrgx"] = s_fan
         extra: Dict = {}
         direct_ok = False
         if routed:
@@ -1025,7 +1067,7 @@ def partition_feed(
             h_pfu = _hash_cols([pfu_gkg])
             gpfu = range_geom(
                 pfu_gkg, pfu_ghi - pfu_glo, h_pfu, M, min_size=ms,
-                fan_pad=max(64, u_fan),
+                fan_pad=max(64, u_fan), **hk,
             )
             out["pfu_off"], out["pfugx"], out["pfux"] = stack_range(
                 pfu_gkg, pfu_glo, pfu_ghi - pfu_glo, h_pfu,
@@ -1062,9 +1104,11 @@ def partition_feed(
     for ts_slot, (src, anc, d_u, p_u, fan) in rc_built.items():
         rc_gk, rc_glo, rc_ghi = _groups_of(src)
         h_rc = _hash_cols([rc_gk])
+        dom["until"][f"rc{ts_slot}x"] = _until_dom(d_u, p_u)
+        dom["fan"][f"rc{ts_slot}gx"] = fan
         grc = range_geom(
             rc_gk, rc_ghi - rc_glo, h_rc, M, min_size=ms,
-            fan_pad=max(64, fan),
+            fan_pad=max(64, fan), **hk,
         )
         (
             out[f"rc{ts_slot}_off"],
@@ -1116,6 +1160,13 @@ def partition_feed(
             or np.isin(cl.ovf_src[cl.ovf_srel1 == 0], wc_nodes).any()
         ),
     )
+    if PKD:
+        with metrics.default.timer("prepare.pack_lanes_s"):
+            pk_up = _pack_flat(out, meta, config, dom, pack_off=False)
+        if pk_up:
+            from dataclasses import replace as _dc_replace
+
+            meta = _dc_replace(meta, **pk_up)
     metrics.default.observe(
         "prepare.partition_s", _time.perf_counter() - _t0
     )

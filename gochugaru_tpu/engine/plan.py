@@ -146,6 +146,39 @@ class EngineConfig:
     #: per-table byte budget for the aligned layout; tables whose aligned
     #: form exceeds it keep the off+interleave layout
     flat_aligned_max_bytes: int = 3 << 30
+    #: width-stratification ladder for the aligned layout
+    #: (engine/hash.py build_aligned ``cover``): level i's row width is
+    #: the smallest cap covering this share of its entries, overflow
+    #: cascades to the next (salted) level, and a fit-all level closes
+    #: the ladder.  The 1-entry default is the classic primary+spill
+    #: pair; (0.99, 0.999) buys a narrower primary row — most of the
+    #: table's bytes — for one extra single-gather level
+    flat_aligned_cover: Tuple[float, ...] = (0.999,)
+    # -- HBM-lean packed tables (engine/packed.py) -----------------------
+    #: bit-packed device tables: logical int32 columns share uint16
+    #: lanes (keys at their radix widths, caveat/ctx ids at their count
+    #: widths, range ends as delta-run lengths, until-values as small
+    #: dictionaries), bucket offsets split into int32 anchors + uint16
+    #: residuals, and point-table bucket growth is bounded by
+    #: ``flat_packed_max_factor`` instead of chasing cap ≤ 4 through 8x
+    #: offsets.  The kernel decodes with shift/mask ops fused into the
+    #: existing block gathers — bitwise-identical query results, ~3-6x
+    #: fewer resident table bytes (BENCHMARKS.md "HBM-lean tables").
+    #: None = auto (on whenever the blockslice layout is); False is the
+    #: parity oracle (the exact pre-packing layout)
+    flat_packed: Optional[bool] = None
+    #: bucket-count growth bound for the packed layout's hash builds
+    #: (size ≤ this x pow2(2n)): a deeper probe cap costs a few fused
+    #: compares; an 8x offsets array costs hundreds of MB of HBM
+    flat_packed_max_factor: int = 2
+
+    def packed_on(self) -> bool:
+        """The resolved flat_packed flag (None = auto: packed whenever
+        the blockslice layout is active — the scattered layout keeps
+        full-width columns)."""
+        if self.flat_packed is not None:
+            return bool(self.flat_packed) and self.flat_blockslice
+        return self.flat_blockslice
     #: partition-first stacked builds (engine/partition.py): hash keys to
     #: bucket shards FIRST, then build each model shard's slice of the
     #: stacked tables independently — bitwise-identical output with

@@ -248,6 +248,7 @@ class ShardedEngine(DeviceEngine):
                     )
                     for k, v in host.items()
                 }
+                self.record_device_bytes(arrays)
                 tid_map = np.full(
                     max(self.plan.num_schema_types, 1), -1, dtype=np.int32
                 )
@@ -304,6 +305,7 @@ class ShardedEngine(DeviceEngine):
                 # buffers directly and is collective-free by design
                 cb = (lambda v: lambda index: v[index])(v)
             arrays[k] = jax.make_array_from_callback(v.shape, sh, cb)
+        self.record_device_bytes(arrays)
         tid_map = np.full(
             max(self.plan.num_schema_types, 1), -1, dtype=np.int32
         )

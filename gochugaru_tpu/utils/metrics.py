@@ -80,6 +80,14 @@ class Metrics:
         with self._lock:
             return self._gauges.get(name, default)
 
+    def clear_gauges(self, prefix: str) -> None:
+        """Drop every gauge under ``prefix`` (per-snapshot breakdowns
+        republished wholesale each prepare — stale keys would survive a
+        table being dropped from the snapshot)."""
+        with self._lock:
+            for k in [k for k in self._gauges if k.startswith(prefix)]:
+                del self._gauges[k]
+
     def observe(self, name: str, seconds: float) -> None:
         with self._lock:
             t = self._timings[name]

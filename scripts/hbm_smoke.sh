@@ -1,0 +1,103 @@
+#!/usr/bin/env bash
+# HBM-lean smoke: packed-vs-unpacked parity + bytes-reduction bar on a
+# small world, CI-runnable.  Builds the config-2-shaped world twice —
+# flat_packed=True vs the unpacked parity oracle — asserts bit-for-bit
+# dispatch equality over a mixed batch (throughput path AND the pinned
+# latency tier), asserts the resident-table-bytes reduction clears the
+# smoke bar, then serves an owner-routed partitioned batch off the
+# PACKED layout and asserts it matches too.  Prints HBM-SMOKE-OK on
+# success, mirroring chaos/telemetry/partition smokes.  Emits one JSON
+# metric line for benchmarks/run_all.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python - <<'EOF'
+import json
+import sys
+import time
+
+import numpy as np
+
+from gochugaru_tpu.utils.platform import force_cpu_platform
+
+force_cpu_platform(8)
+
+sys.path.insert(0, ".")
+from bench import build_world
+from benchmarks.common import est_bytes_per_check, table_bytes
+from gochugaru_tpu.engine.device import DeviceEngine
+from gochugaru_tpu.engine.plan import EngineConfig
+from gochugaru_tpu.parallel import ShardedEngine, make_mesh
+
+t0 = time.time()
+# the small-world bar is looser than bench7's 2.5x at config 3: pow2
+# padding floors dominate tiny tables — the smoke guards the MECHANISM
+# (packing engaged, bytes strictly shrink by a sane margin), the full
+# bar lives in benchmarks/bench7_hbm.py
+SMOKE_BYTES_BAR = 1.5
+NOWUS = 1_700_000_000_000_000
+
+cs, snap, users, repos, slot = build_world(n_repos=1500, n_users=400)
+
+eng_p = DeviceEngine(cs, EngineConfig.for_schema(cs, flat_packed=True))
+eng_u = DeviceEngine(cs, EngineConfig.for_schema(cs, flat_packed=False))
+ds_p = eng_p.prepare(snap)
+ds_u = eng_u.prepare(snap)
+assert ds_p.flat_meta.packed, "packing did not engage"
+assert ds_p.flat_meta.packed_off, "offset packing did not engage"
+assert not ds_u.flat_meta.packed
+
+bp, bu = table_bytes(ds_p), table_bytes(ds_u)
+reduction = bu / max(bp, 1)
+assert reduction >= SMOKE_BYTES_BAR, (
+    f"bytes reduction {reduction:.2f}x under the smoke bar"
+    f" {SMOKE_BYTES_BAR}x ({bu} -> {bp})"
+)
+print(f"bytes: {bu} -> {bp} ({reduction:.2f}x)", file=sys.stderr)
+
+rng = np.random.default_rng(3)
+B = 8192
+q_res = rng.choice(repos, B).astype(np.int32)
+q_perm = rng.choice(np.array([slot["read"], slot["admin"]], np.int32), B)
+q_subj = rng.choice(users, B).astype(np.int32)
+
+d0, p0, o0 = eng_u.check_columns(ds_u, q_res, q_perm, q_subj, now_us=NOWUS)
+d1, p1, o1 = eng_p.check_columns(ds_p, q_res, q_perm, q_subj, now_us=NOWUS)
+assert np.array_equal(d0, d1) and np.array_equal(p0, p1)
+assert np.array_equal(o0, o1)
+assert 0 < int(d1.sum()) < B
+print(f"throughput-path parity: {B} checks (granted={int(d1.sum())})",
+      file=sys.stderr)
+
+# pinned latency tier serves the packed layout identically
+SB = 1024
+dl, pl, ol = eng_p.check_columns_latency(
+    ds_p, q_res[:SB].copy(), q_perm[:SB].copy(), q_subj[:SB].copy(),
+    now_us=NOWUS,
+)
+assert np.array_equal(dl, d0[:SB]) and np.array_equal(pl, p0[:SB])
+print("latency-tier parity: ok", file=sys.stderr)
+
+# owner-routed partitioned serve off the PACKED layout
+M = 2
+sharded = ShardedEngine(cs, make_mesh(1, M), EngineConfig.for_schema(
+    cs, flat_packed=True
+))
+ds_r = sharded.prepare_snapshot_partitioned(snap)
+assert ds_r.flat_meta is not None and ds_r.flat_meta.packed
+d2, p2, o2 = sharded.check_columns(ds_r, q_res, q_perm, q_subj, now_us=NOWUS)
+assert np.array_equal(d0, np.asarray(d2)) and np.array_equal(p0, np.asarray(p2))
+assert np.array_equal(o0, np.asarray(o2))
+print(f"routed partitioned parity on packed tables: ok", file=sys.stderr)
+
+print(json.dumps({
+    "metric": "hbm_smoke", "value": round(reduction, 2),
+    "unit": "x bytes reduction",
+    "edges": int(snap.num_edges), "batch": B,
+    "table_bytes_packed": bp, "table_bytes_unpacked": bu,
+    "bytes_per_check": round(est_bytes_per_check(ds_p), 1),
+    "granted": int(d1.sum()), "wall_s": round(time.time() - t0, 1),
+}))
+EOF
+
+echo "HBM-SMOKE-OK"

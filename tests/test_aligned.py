@@ -119,17 +119,16 @@ def test_probe_aligned_roundtrip_with_spill():
     import jax.numpy as jnp
 
     qi = rng.integers(0, n, 2048)
+    tbls = [jnp.asarray(t) for t, _ in ai.levels]
     blk = probe_aligned(
-        jnp.asarray(ai.tbl), jnp.asarray(ai.spill),
-        ai.cap, ai.w, ai.spill_cap,
+        tbls, ai.caps, ai.w,
         (jnp.asarray(k1[qi]), jnp.asarray(k2[qi])),
     )
     hit = (blk[..., 0] == k1[qi][:, None]) & (blk[..., 1] == k2[qi][:, None])
     assert bool(hit.any(axis=-1).all()), "an inserted key failed to probe"
     # a key that was never inserted must miss everywhere
     miss = probe_aligned(
-        jnp.asarray(ai.tbl), jnp.asarray(ai.spill),
-        ai.cap, ai.w, ai.spill_cap,
+        tbls, ai.caps, ai.w,
         (jnp.full(64, n + 7, jnp.int32), jnp.full(64, -2, jnp.int32)),
     )
     mh = (miss[..., 0] == (n + 7)) & (miss[..., 1] == -2)

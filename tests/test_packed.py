@@ -1,0 +1,413 @@
+"""Differential tests for the HBM-lean packed layout (engine/packed.py,
+wired through engine/flat.py _pack_flat + the kernel's decode sites).
+
+Contract: packing is an ENCODING of the exact same tables — every
+dispatch result is bit-for-bit identical to the unpacked layout (the
+parity oracle, ``flat_packed=False``), across caveats/contexts,
+wildcards, expirations, closure overflow, the T-index, delta chains,
+the pinned latency tier, and the routed partitioned serve — while the
+resident table bytes shrink.  The pack pass itself must never
+materialize a full-width intermediate copy (alloc-guard assertion)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from gochugaru_tpu.engine import packed as pk
+from tests.test_flat_engine import (
+    FEATURES,
+    NOW,
+    assert_sound_cascade,
+    build_feature_world,
+    world,
+)
+from tests.test_aligned import _all_checks
+
+
+# ---------------------------------------------------------------------------
+# unit: spec/pack/decode roundtrips
+# ---------------------------------------------------------------------------
+
+
+def test_pack_roundtrip_randomized():
+    rng = np.random.default_rng(3)
+    for trial in range(30):
+        descs = []
+        cols = []
+        n = int(rng.integers(1, 5000))
+        for _ in range(int(rng.integers(1, 6))):
+            kind = rng.integers(0, 4)
+            if kind == 0:  # plain range, random width incl. >16 bits
+                lo = int(rng.integers(-5, 2))
+                hi = lo + int(rng.integers(1, 1 << int(rng.integers(1, 25))))
+                descs.append(pk.col_range(lo, hi))
+                cols.append(rng.integers(lo, hi + 1, n))
+            elif kind == 1:  # constant
+                v = int(rng.integers(-3, 100))
+                descs.append(pk.col_const(v))
+                cols.append(np.full(n, v))
+            elif kind == 2:  # dictionary (until-style sentinels)
+                vals = [-(2 ** 31), -1, 0, 2 ** 31 - 1, 777]
+                descs.append(pk.col_dict(vals))
+                cols.append(rng.choice(np.asarray(vals), n))
+            else:  # full 32-bit field
+                descs.append(pk.col_range(-(2 ** 31), 2 ** 31 - 1))
+                cols.append(rng.integers(-(2 ** 31), 2 ** 31, n))
+        spec = pk.make_spec(descs)
+        if spec is None:
+            continue  # no byte win for this shape: packing declined
+        tbl = np.stack([c.astype(np.int32) for c in cols], axis=1)
+        packed = pk.pack_rows(tbl, spec)
+        assert packed.dtype == np.uint16
+        assert packed.nbytes < tbl.nbytes
+        back = pk.unpack_rows(packed, spec)
+        assert np.array_equal(back, tbl), f"trial {trial} roundtrip broke"
+
+        # the jnp decode agrees with the host decode bit-for-bit
+        import jax.numpy as jnp
+
+        dev = np.asarray(pk.decode_block(jnp.asarray(packed), spec))
+        assert np.array_equal(dev, tbl)
+
+
+def test_pack_delta_run_field():
+    """(gk, glo, ghi) group rows: ghi stored as a run length."""
+    rng = np.random.default_rng(5)
+    n = 4096
+    glo = np.sort(rng.integers(0, 1 << 20, n)).astype(np.int32)
+    lens = rng.integers(0, 16, n).astype(np.int32)
+    tbl = np.stack([rng.integers(-1, 1 << 22, n).astype(np.int32),
+                    glo, glo + lens], axis=1)
+    spec = pk.make_spec([
+        pk.col_range(-1, (1 << 22) - 1),
+        pk.col_range(-1, (1 << 20) - 1),
+        pk.col_delta(0, 16, 1),
+    ])
+    assert spec is not None
+    assert np.array_equal(pk.unpack_rows(pk.pack_rows(tbl, spec), spec), tbl)
+
+
+def test_pack_range_violation_raises():
+    spec = pk.make_spec([pk.col_range(-1, 100), pk.col_range(0, 7)])
+    bad = np.asarray([[5, 3], [200, 1]], np.int32)  # 200 > 100
+    with pytest.raises(pk.PackError):
+        pk.pack_rows(bad, spec)
+    bad2 = np.asarray([[5, 3], [7, 9]], np.int32)  # 9 > 7
+    with pytest.raises(pk.PackError):
+        pk.pack_rows(bad2, spec)
+
+
+def test_pack_off_roundtrip():
+    rng = np.random.default_rng(11)
+    counts = rng.poisson(2.0, 1 << 16)
+    off = np.zeros(counts.shape[0] + 1, np.int64)
+    np.cumsum(counts, out=off[1:])
+    off = off.astype(np.int32)
+    got = pk.pack_off(off)
+    assert got is not None
+    res, anchor = got
+    assert res.dtype == np.uint16
+    A = pk.OFF_ANCHOR_SHIFT
+    idx = np.arange(off.shape[0])
+    back = anchor[idx >> A].astype(np.int64) + res
+    assert np.array_equal(back, off)
+    # a block spanning >= 2^16 rows cannot pack (2048 buckets x 64 rows)
+    steep = np.arange(0, 1 << 23, 1 << 6, dtype=np.int32)
+    assert pk.pack_off(steep) is None
+
+
+# ---------------------------------------------------------------------------
+# world-level parity: packed vs the unpacked oracle layout
+# ---------------------------------------------------------------------------
+
+
+def _parity(checks, **over):
+    eng_p, ds_p, oracle = world(FEATURES, build_feature_world(random.Random(7)),
+                                flat_packed=True, **over)
+    eng_u, ds_u, _ = world(FEATURES, build_feature_world(random.Random(7)),
+                           flat_packed=False, **over)
+    assert ds_p.flat_meta.packed, "packing did not engage"
+    assert not ds_u.flat_meta.packed
+    dp, pp_, op = eng_p.check_batch(ds_p, checks, now_us=NOW)
+    du, pu, ou = eng_u.check_batch(ds_u, checks, now_us=NOW)
+    assert np.array_equal(np.asarray(dp), np.asarray(du))
+    assert np.array_equal(np.asarray(pp_), np.asarray(pu))
+    assert np.array_equal(np.asarray(op), np.asarray(ou))
+    assert_sound_cascade(eng_p, ds_p, oracle, checks)
+    return eng_p, ds_p, eng_u, ds_u
+
+
+def _device_bytes(ds):
+    return sum(int(np.asarray(v).nbytes) for v in ds.arrays.values())
+
+
+def test_packed_matches_unpacked_and_oracle():
+    """Caveats+contexts, wildcards, expirations, closure overflow and the
+    T-join all dispatch bit-for-bit between the layouts, and the packed
+    snapshot is resident-smaller (raw columns live host-side, tables in
+    uint16 lanes)."""
+    checks = _all_checks(random.Random(3), k=250)
+    eng_p, ds_p, _eng_u, ds_u = _parity(checks)
+    assert ds_p.host_arrays is not None  # raw O(E) columns stayed host-side
+    assert _device_bytes(ds_p) < _device_bytes(ds_u)
+
+
+def test_packed_overflow_worlds_parity():
+    """Closure-overflow (cap=4) worlds keep the ovf probe + host routing
+    identical under packing."""
+    checks = _all_checks(random.Random(9), k=200)
+    _parity(checks, closure_source_cap=4)
+
+
+def test_packed_aligned_strata_parity():
+    """Width-stratified aligned ladder under packing: same results (the
+    tiny CI world usually fits level 0 whole — the deep-ladder geometry
+    itself is covered by test_build_aligned_strata_levels)."""
+    checks = _all_checks(random.Random(5), k=200)
+    _eng_p, ds_p, _eng_u, _ds_u = _parity(
+        checks, flat_aligned=True, flat_aligned_cover=(0.99, 0.999),
+    )
+    assert ds_p.flat_meta.aligned
+
+
+def test_build_aligned_strata_levels():
+    """A coverage ladder steep enough to leave overflow at every level
+    builds >= 3 width strata, and every inserted key still probes."""
+    from gochugaru_tpu.engine.hash import build_aligned, probe_aligned
+
+    rng = np.random.default_rng(17)
+    n = 120_000
+    # zipf-ish duplicate keys: deep buckets at every level
+    k1 = (rng.zipf(1.3, n) % 5000).astype(np.int32)
+    k2 = rng.integers(0, 1 << 18, n).astype(np.int32)
+    pay = rng.integers(0, 1 << 30, n).astype(np.int32)
+    ai = build_aligned([k1, k2], [k1, k2, pay], cover=(0.5, 0.9))
+    assert ai is not None and len(ai.levels) >= 3, ai and ai.caps
+    # level 0's width class is narrower than a fit-all cap would be
+    assert ai.caps[0] <= ai.caps[-1] or ai.caps[0] <= 12
+
+    import jax.numpy as jnp
+
+    qi = rng.integers(0, n, 4096)
+    blk = probe_aligned(
+        [jnp.asarray(t) for t, _ in ai.levels], ai.caps, ai.w,
+        (jnp.asarray(k1[qi]), jnp.asarray(k2[qi])),
+    )
+    hit = (blk[..., 0] == k1[qi][:, None]) & (blk[..., 1] == k2[qi][:, None])
+    assert bool(hit.any(axis=-1).all()), "an inserted key failed to probe"
+
+
+def test_packed_delta_chain_parity():
+    """Watch-driven incremental prepares ride the packed base tables:
+    overlays stay unpacked, reshipped closure tables repack under the
+    base spec, results match the oracle each revision."""
+    from gochugaru_tpu import rel
+    from gochugaru_tpu.engine.oracle import Oracle
+    from gochugaru_tpu.store.delta import apply_delta
+
+    rng = random.Random(11)
+    rels = build_feature_world(rng)
+    eng, ds, oracle = world(FEATURES, rels, flat_packed=True)
+    assert ds.flat_meta.packed
+
+    adds1 = [
+        rel.must_from_tuple("doc:d0#reader", "user:u9"),
+        rel.must_from_tuple("doc:d1#banned", "user:u2"),
+    ]
+    snap2 = apply_delta(ds.snapshot, 2, adds1, [], interner=ds.snapshot.interner)
+    ds2 = eng.prepare(snap2, prev=ds)
+    assert ds2.flat_meta.delta is not None, "delta path not taken"
+    assert ds2.flat_meta.packed, "packed meta lost across delta"
+    rels2 = rels + adds1
+    oracle2 = Oracle(eng.compiled, rels2, {}, now_us=NOW)
+    checks = _all_checks(random.Random(4), k=150) + adds1
+    assert_sound_cascade(eng, ds2, oracle2, checks)
+
+    # a MEMBERSHIP delta advances the closure and reships clx packed
+    adds2 = [rel.must_from_tuple("group:g1#member", "user:u7")]
+    snap3 = apply_delta(snap2, 3, adds2, [], interner=ds.snapshot.interner)
+    ds3 = eng.prepare(snap3, prev=ds2)
+    if ds3.flat_meta is not None and ds3.flat_meta.delta is not None:
+        oracle3 = Oracle(eng.compiled, rels2 + adds2, {}, now_us=NOW)
+        assert_sound_cascade(eng, ds3, oracle3, checks + adds2)
+
+
+def test_packed_delta_despec_on_dict_misfit():
+    """A base world with NO expirations pins {NEVER, pad, NO_EXP}
+    dictionaries on the closure until-columns; a later delta that
+    introduces an expiring MEMBERSHIP edge pushes a real timestamp into
+    the advanced closure — the reshipped table must despec (or the
+    chain must rebuild), never alias a value through a stale dict."""
+    import datetime as dt
+
+    from gochugaru_tpu import rel
+    from gochugaru_tpu.engine.oracle import Oracle
+    from gochugaru_tpu.store.delta import apply_delta
+
+    rels = []
+    for g in range(4):
+        for u in range(3):
+            rels.append(
+                rel.must_from_tuple(f"group:g{g}#member", f"user:u{u}")
+            )
+    for d in range(8):
+        rels.append(
+            rel.must_from_tuple(f"doc:d{d}#reader", f"group:g{d % 4}#member")
+        )
+    eng, ds, _oracle = world(FEATURES, rels, flat_packed=True)
+    assert ds.flat_meta.packed
+    pk_map = dict(ds.flat_meta.packed)
+    if "clx" in pk_map:
+        assert pk_map["clx"][3], "expected dictionary until-columns"
+
+    r = rel.must_from_tuple("group:g1#member", "user:u9").with_expiration(
+        dt.datetime.fromtimestamp(NOW / 1e6 + 900, tz=dt.timezone.utc)
+    )
+    snap2 = apply_delta(ds.snapshot, 2, [r], [], interner=ds.snapshot.interner)
+    ds2 = eng.prepare(snap2, prev=ds)
+    oracle2 = Oracle(eng.compiled, rels + [r], {}, now_us=NOW)
+    checks = rels + [r] + [
+        rel.must_from_tuple(f"doc:d{d}#reader", "user:u9") for d in range(8)
+    ]
+    if ds2.flat_meta is not None and ds2.flat_meta.delta is not None:
+        # incremental path taken: clx must have despec'd
+        assert "clx" not in dict(ds2.flat_meta.packed)
+    assert_sound_cascade(eng, ds2, oracle2, checks)
+
+
+def test_packed_latency_tier_parity():
+    """The pinned latency path serves packed snapshots: same answers as
+    the packed throughput path and as the unpacked latency path."""
+    eng_p, ds_p, oracle = world(FEATURES, build_feature_world(random.Random(7)),
+                                flat_packed=True)
+    checks = _all_checks(random.Random(6), k=100)
+    d0, p0, o0 = eng_p.check_batch(ds_p, checks, now_us=NOW)
+    d1, p1, o1 = eng_p.check_batch(ds_p, checks, now_us=NOW, latency=True)
+    assert np.array_equal(np.asarray(d0), np.asarray(d1))
+    assert np.array_equal(np.asarray(p0), np.asarray(p1))
+    assert np.array_equal(np.asarray(o0), np.asarray(o1))
+
+
+def test_packed_legacy_fallback_slot_spill():
+    """A batch with more distinct permissions than flat_max_slots falls
+    back to the legacy kernel, which lazily ships the host-side raw
+    columns — answers stay oracle-correct."""
+    eng, ds, oracle = world(
+        FEATURES, build_feature_world(random.Random(7)),
+        flat_packed=True, flat_max_slots=1,
+    )
+    assert ds.host_arrays is not None
+    checks = _all_checks(random.Random(8), k=60)
+    assert_sound_cascade(eng, ds, oracle, checks)
+    assert ds.legacy_cache is not None, "legacy fallback never shipped"
+
+
+def test_device_bytes_gauge_live():
+    """prepare publishes the resident footprint as live gauges: one
+    total plus a per-table breakdown, visible through typed_snapshot
+    (what /metrics renders) — not just at bench time."""
+    from gochugaru_tpu.utils import metrics
+
+    metrics.default.reset()
+    _eng, ds, _oracle = world(
+        FEATURES, build_feature_world(random.Random(7)), flat_packed=True
+    )
+    total = metrics.default.gauge("snapshot.device_bytes")
+    assert total > 0
+    assert total == _device_bytes(ds)
+    _counters, gauges, _timers = metrics.default.typed_snapshot()
+    per = {
+        k: v for k, v in gauges.items()
+        if k.startswith("snapshot.device_bytes.")
+    }
+    assert per, "no per-table breakdown gauges"
+    assert abs(sum(per.values()) - total) < 1e-6
+    assert any(k.endswith(".ehx") or k.endswith(".ehx_al") for k in per)
+
+
+# ---------------------------------------------------------------------------
+# allocation discipline: no full-width intermediate in the pack pass
+# ---------------------------------------------------------------------------
+
+
+def test_pack_rows_is_chunked(monkeypatch):
+    """pack_rows walks the source in CHUNK windows: with the guard armed
+    just above the chunk temporaries (and far below the table), a 200k-
+    row pack succeeds — any full-width temporary would trip it."""
+    monkeypatch.setattr(pk, "CHUNK", 1 << 12)
+    rng = np.random.default_rng(2)
+    n = 200_000
+    tbl = np.stack([
+        rng.integers(-1, 1 << 24, n), rng.integers(-1, 1 << 23, n),
+        rng.integers(-1, 4, n),
+    ], axis=1).astype(np.int32)
+    spec = pk.make_spec([
+        pk.col_range(-1, (1 << 24) - 1), pk.col_range(-1, (1 << 23) - 1),
+        pk.col_range(-1, 3),
+    ])
+    with pk.alloc_guard(32 * (1 << 12)):
+        packed = pk.pack_rows(tbl, spec)
+    assert np.array_equal(pk.unpack_rows(packed, spec), tbl)
+
+
+def test_packed_prepare_alloc_guarded(monkeypatch):
+    """With the chunk shrunk far below the table sizes, arm the alloc
+    guard under the full-width table bytes: the chunked pack pass must
+    prepare without a single full-width temporary."""
+    monkeypatch.setattr(pk, "CHUNK", 1 << 10)
+    rng = random.Random(7)
+    rels = build_feature_world(rng, n_users=40, n_groups=12, n_docs=120)
+    # guard: far above chunk-sized temps (a few x CHUNK x 8B), far below
+    # any full table copy (the biggest tables here are > 2^15 rows)
+    with pk.alloc_guard(64 * (1 << 10)):
+        eng, ds, oracle = world(FEATURES, rels, flat_packed=True)
+    assert ds.flat_meta.packed
+    checks = _all_checks(rng, n_users=40, n_groups=12, n_docs=120, k=120)
+    assert_sound_cascade(eng, ds, oracle, checks)
+
+
+def test_packed_sharded_and_routed_parity():
+    """The stacked (psum) layout and the owner-routed partitioned serve
+    both dispatch the packed layout bit-for-bit against single-chip."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the 8-device CPU suite (conftest XLA_FLAGS)")
+    from gochugaru_tpu.parallel import ShardedEngine, make_mesh
+    from gochugaru_tpu.schema import compile_schema, parse_schema
+    from gochugaru_tpu.store.interner import Interner
+    from gochugaru_tpu.store.snapshot import build_snapshot
+    from gochugaru_tpu.engine.device import DeviceEngine
+    from gochugaru_tpu.engine.plan import EngineConfig
+
+    rng = random.Random(13)
+    rels = build_feature_world(rng)
+    cs = compile_schema(parse_schema(FEATURES))
+    snap = build_snapshot(1, cs, Interner(), rels, epoch_us=NOW)
+    cfg = EngineConfig.for_schema(
+        cs, flat_packed=True, flat_recursion=3, flat_max_width=32
+    )
+
+    single = DeviceEngine(cs, cfg)
+    ds1 = single.prepare(snap)
+    checks = _all_checks(random.Random(2), k=160)
+    d1, p1, o1 = single.check_batch(ds1, checks, now_us=NOW)
+
+    sharded = ShardedEngine(cs, make_mesh(1, 4), cfg)
+    ds_s = sharded.prepare(snap)
+    assert ds_s.flat_meta is not None and ds_s.flat_meta.packed
+    d2, p2, o2 = sharded.check_batch(ds_s, checks, now_us=NOW)
+    assert np.array_equal(np.asarray(d1), np.asarray(d2))
+    assert np.array_equal(np.asarray(p1), np.asarray(p2))
+    assert np.array_equal(np.asarray(o1), np.asarray(o2))
+
+    # owner-routed partitioned serve over the same snapshot
+    ds_r = sharded.prepare_snapshot_partitioned(snap)
+    assert ds_r.flat_meta is not None
+    if ds_r.flat_meta.part_serve:
+        assert ds_r.flat_meta.packed, "routed serve lost the packed layout"
+    d3, p3, o3 = sharded.check_batch(ds_r, checks, now_us=NOW)
+    assert np.array_equal(np.asarray(d1), np.asarray(d3))
+    assert np.array_equal(np.asarray(p1), np.asarray(p3))
+    assert np.array_equal(np.asarray(o1), np.asarray(o3))

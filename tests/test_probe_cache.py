@@ -1,0 +1,107 @@
+"""bench.py backend-probe verdict cache: a standalone bench run must not
+re-pay the 75 s hung-TPU probe timeout when a previous run on the same
+jaxlib/TPU environment already learned the answer (the on-disk
+counterpart of run_all.py's GOCHUGARU_BACKEND_PROBED parent-inherit)."""
+
+import json
+import subprocess
+
+import pytest
+
+
+@pytest.fixture()
+def bench_mod(monkeypatch, tmp_path):
+    import bench
+
+    # neutralize every probe-skipping env short-circuit so the cache
+    # path itself is what's under test
+    for k in ("JAX_PLATFORMS", "GOCHUGARU_FORCE_CPU",
+              "GOCHUGARU_BACKEND_PROBED", "GOCHUGARU_PROBE_CACHE"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setattr(bench, "PROBE_CACHE_PATH", str(tmp_path / "probe.json"))
+    monkeypatch.setattr(bench, "_PROBE_VERDICT", [])
+    return bench
+
+
+def test_probe_failure_verdict_is_cached(bench_mod, monkeypatch):
+    bench = bench_mod
+    calls = []
+
+    def fake_run(*a, **kw):
+        calls.append(1)
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=75)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    reason = bench._probe_backend()
+    assert reason and "timed out" in reason
+    assert len(calls) == 1
+    with open(bench.PROBE_CACHE_PATH) as f:
+        blob = json.load(f)
+    assert "timed out" in blob["reason"]
+
+    # a fresh process (memo cleared) reads the cache: no subprocess
+    monkeypatch.setattr(bench, "_PROBE_VERDICT", [])
+    reason2 = bench._probe_backend()
+    assert len(calls) == 1, "cached verdict did not skip the probe"
+    assert "cached verdict" in reason2
+
+
+def test_probe_success_verdict_is_cached(bench_mod, monkeypatch):
+    bench = bench_mod
+    calls = []
+
+    class R:
+        returncode = 0
+        stdout = "1 tpu\n"
+        stderr = ""
+
+    def fake_run(*a, **kw):
+        calls.append(1)
+        return R()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    assert bench._probe_backend() is None
+    monkeypatch.setattr(bench, "_PROBE_VERDICT", [])
+    assert bench._probe_backend() is None  # from cache
+    assert len(calls) == 1
+
+
+def test_probe_cache_keyed_by_environment(bench_mod, monkeypatch):
+    """A stale verdict from a different jaxlib/TPU env must NOT be
+    reused — the key mismatch forces a fresh probe."""
+    bench = bench_mod
+    with open(bench.PROBE_CACHE_PATH, "w") as f:
+        json.dump({"key": "jaxlib=0.0.0;stale", "reason": "old failure"}, f)
+    calls = []
+
+    class R:
+        returncode = 0
+        stdout = "1 tpu\n"
+        stderr = ""
+
+    def fake_run(*a, **kw):
+        calls.append(1)
+        return R()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    assert bench._probe_backend() is None
+    assert len(calls) == 1, "stale cache was trusted"
+
+
+def test_probe_cache_disabled(bench_mod, monkeypatch):
+    bench = bench_mod
+    monkeypatch.setenv("GOCHUGARU_PROBE_CACHE", "0")
+    calls = []
+
+    def fake_run(*a, **kw):
+        calls.append(1)
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=75)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    bench._probe_backend()
+    monkeypatch.setattr(bench, "_PROBE_VERDICT", [])
+    bench._probe_backend()
+    assert len(calls) == 2, "cache engaged despite GOCHUGARU_PROBE_CACHE=0"
+    import os
+
+    assert not os.path.exists(bench.PROBE_CACHE_PATH)

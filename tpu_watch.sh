@@ -48,6 +48,13 @@ while true; do
       timeout 560 python benchmarks/bench_tpu_harvest.py --ab \
         > "tpu_attempts/ab_${TS}.out" 2> "tpu_attempts/ab_${TS}.err"
       log "aligned-vs-legacy A/B rc=$? → tpu_attempts/ab_${TS}.out"
+      # priority 3.5: packed-vs-unpacked A/B (HBM-lean tables): the
+      # roofline question — does the shift/mask decode hide under
+      # gather latency on real silicon? — plus measured table bytes
+      # (bench7 emits both layouts' true rates + bytes/check columns)
+      timeout 700 python benchmarks/bench7_hbm.py --scale 0.2 \
+        > "tpu_attempts/hbm_${TS}.out" 2> "tpu_attempts/hbm_${TS}.err"
+      log "packed-vs-unpacked A/B rc=$? → tpu_attempts/hbm_${TS}.out"
       # priority 4: the wider ladder while the window lasts
       timeout 420 python benchmarks/bench1_founders.py \
         > "tpu_attempts/b1_${TS}.out" 2> "tpu_attempts/b1_${TS}.err"
