@@ -21,6 +21,7 @@ from benchmarks.common import (
     NORTH_STAR_RATE,
     emit,
     emit_small_batch_row,
+    join_lookup_prewarm,
     latency_percentiles,
     note,
     time_steady,
@@ -144,15 +145,7 @@ def main() -> None:
     note(f"edges={snap.num_edges} nodes={snap.num_nodes}")
     engine = DeviceEngine(cs)
     dsnap = engine.prepare(snap)
-    # measurement hygiene: prepare spawns the lookup-prewarm thread
-    # (engine/device.py); on the ONE-core host its O(E log E) build
-    # steals ~half the core from the first seconds of the throughput
-    # window — join it (bounded) before timing anything
-    import threading
-
-    for t in threading.enumerate():
-        if t.name == "gochugaru-lookup-prewarm":
-            t.join(timeout=300)
+    join_lookup_prewarm()
 
     rng = np.random.default_rng(7)
     B = 1 << (BATCH - 1).bit_length()
@@ -220,36 +213,11 @@ def main() -> None:
     except Exception as e:  # optional row must never cost the main ones
         note(f"small-batch latency section failed: {type(e).__name__}: {e}")
 
-    # device-lookup latency at config-3 scale: backs engine/lookup.py's
-    # "at 1M docs this is milliseconds of device time" claim with a number
-    import time
-
-    from gochugaru_tpu.engine.lookup import lookup_resources_device
-    from gochugaru_tpu.engine.oracle import SnapshotOracle
-
-    oracle = SnapshotOracle(snap, {})
-    uid = snap.interner.key_of(int(users[0]))[1]
-    t0 = time.perf_counter()
-    ids = lookup_resources_device(
-        engine, dsnap, "document", "view", "user", uid,
-        now_us=EPOCH, oracle_factory=lambda: oracle,
-    )
-    warm_build = (time.perf_counter() - t0) * 1000
-    ts = []
-    for i in (1, 2, 3):
-        uid = snap.interner.key_of(int(users[i]))[1]
-        t0 = time.perf_counter()
-        ids = lookup_resources_device(
-            engine, dsnap, "document", "view", "user", uid,
-            now_us=EPOCH, oracle_factory=lambda: oracle,
-        )
-        ts.append((time.perf_counter() - t0) * 1000)
-    warm = float(np.median(ts))
-    emit("docs_lookup_resources_latency", warm, "ms", NORTH_STAR_P99_MS / max(warm, 1e-9))
-    note(
-        f"lookup_resources @1M docs: first={warm_build:.0f}ms (builds the"
-        f" transposed index), warm={warm:.1f}ms, |result|={len(ids)}"
-    )
+    # the lookup surface has its own bench now: benchmarks/bench8_lookup.py
+    # (candidate-resources/s TRUE rate, first-result latency, full-answer
+    # throughput — the ad-hoc docs_lookup_resources_latency probe that
+    # lived here is superseded by those columns)
+    note("lookup columns: see bench8_lookup.py (run_all config 11)")
 
 
 if __name__ == "__main__":

@@ -118,14 +118,9 @@ def main() -> None:
     engine = DeviceEngine(cs)
     assert not engine.caveat_plan.host_only[cs.caveat_ids["same_tenant"]]
     dsnap = engine.prepare(snap)
-    # measurement hygiene: join the lookup-prewarm thread before any
-    # timing — its O(E log E) background build at 100M edges otherwise
-    # steals ~half the one-core host from the measurement window
-    import threading
+    from benchmarks.common import join_lookup_prewarm
 
-    for t in threading.enumerate():
-        if t.name == "gochugaru-lookup-prewarm":
-            t.join(timeout=600)
+    join_lookup_prewarm(timeout=600)
 
     rng = np.random.default_rng(3)
     B = 1 << (args.batch - 1).bit_length()

@@ -469,6 +469,20 @@ def peak_rss_mb() -> float:
     return _impl()
 
 
+def join_lookup_prewarm(timeout: float = 300.0) -> None:
+    """Measurement hygiene: a full prepare may spawn the lookup-prewarm
+    thread (engine/device.py, walker-serving layouts only); on a
+    one-core host its O(E log E) build steals ~half the core from the
+    first seconds of any throughput window — join it (bounded) before
+    timing anything.  Shared by bench3/bench4/bench8 instead of three
+    copies of the loop."""
+    import threading
+
+    for t in threading.enumerate():
+        if t.name == "gochugaru-lookup-prewarm":
+            t.join(timeout=timeout)
+
+
 def maybe_emit_metrics_snapshot() -> None:
     """Gated by GOCHUGARU_BENCH_METRICS=1 (run_all.py --metrics sets
     it): append one ``metrics_snapshot`` JSON line carrying the child's

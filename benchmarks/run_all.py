@@ -164,6 +164,18 @@ def main() -> int:
         ["bash", "scripts/hbm_smoke.sh"],
         600,
     ))
+    configs.append((
+        "11 — bulk lookup: frontier SpMV candidates/s @ config 3"
+        + (" (quick, 5% scale)" if q else ""),
+        [py, "benchmarks/bench8_lookup.py"]
+        + (["--scale", "0.05"] if q else []),
+        2400,
+    ))
+    configs.append((
+        "12 — lookup smoke (walker parity + paginated answer + routed shards)",
+        ["bash", "scripts/lookup_smoke.sh"],
+        600,
+    ))
     if not q:
         # Leopard-scale CPU proxy (VERDICT r04 item 3): the same Watch
         # re-index loop at a 100M-edge base — BASELINE config 5's

@@ -32,6 +32,7 @@ from typing import (
     Iterable,
     Iterator,
     List,
+    NamedTuple,
     Optional,
     Sequence,
     Tuple,
@@ -82,6 +83,15 @@ DELETE_BATCH = 10_000
 #: edges (the chunk list holds references, not copies — the transient
 #: cost is the flush's own O(buffer) columns).
 IMPORT_BUFFER = 2_097_152
+
+
+class LookupPage(NamedTuple):
+    """One page of a cursored lookup (lookup_resources_page /
+    lookup_subjects_page): result ids in stable stream order, plus the
+    opaque resume cursor (None = stream exhausted)."""
+
+    ids: List[str]
+    cursor: Optional[str]
 
 
 class _Options:
@@ -907,9 +917,12 @@ class Client:
         ``permission`` = "type#perm", ``subject`` = "type:id[#rel]"
         (client/client.go:501-552).
 
-        Device path: reverse candidate expansion + one batched forward
-        check (engine/lookup.py); host-oracle scan only for schemas the
-        device can't evaluate."""
+        Device path: masked frontier SpMV over the reverse-CSR tables
+        (engine/spmv.py; host-walker fallback for layouts without them)
+        + batched exact forward checks; host-oracle scan only for
+        schemas the device can't evaluate.  Transient dispatch faults
+        (``lookup.dispatch`` site) retry under the reference's backoff
+        envelope like checks do."""
         self._check_overlap(ctx)
         subj_type, subj_id, subj_rel = parse_object_set(subject)
         obj_type, obj_rel = parse_typed_relation(permission)
@@ -919,10 +932,13 @@ class Client:
             from .engine.lookup import lookup_resources_device
 
             self._metrics.inc("lookups.resources_device")
-            ids = lookup_resources_device(
-                engine, self._dsnap_for(engine, snap),
-                obj_type, obj_rel, subj_type, subj_id, subj_rel,
-                oracle_factory=lambda: self._oracle_for(snap),
+            ids = retry_retriable_errors(
+                ctx,
+                lambda: lookup_resources_device(
+                    engine, self._dsnap_for(engine, snap),
+                    obj_type, obj_rel, subj_type, subj_id, subj_rel,
+                    oracle_factory=lambda: self._oracle_for(snap),
+                ),
             )
         else:
             self._metrics.inc("lookups.resources_oracle")
@@ -942,9 +958,8 @@ class Client:
         ``resource`` = "type:id", ``subject`` = "type[#rel]"
         (client/client.go:554-599).
 
-        Device path mirrors lookup_resources: forward arrow/membership
-        expansion bounds the candidates, one batched device check
-        filters them exactly."""
+        Device path mirrors lookup_resources: forward frontier expansion
+        bounds the candidates, batched device checks filter exactly."""
         self._check_overlap(ctx)
         res_type, res_id, _ = parse_object_set(resource)
         subj_type, _, subj_rel = subject.partition("#")
@@ -954,10 +969,13 @@ class Client:
             from .engine.lookup import lookup_subjects_device
 
             self._metrics.inc("lookups.subjects_device")
-            ids = lookup_subjects_device(
-                engine, self._dsnap_for(engine, snap),
-                res_type, res_id, permission, subj_type, subj_rel,
-                oracle_factory=lambda: self._oracle_for(snap),
+            ids = retry_retriable_errors(
+                ctx,
+                lambda: lookup_subjects_device(
+                    engine, self._dsnap_for(engine, snap),
+                    res_type, res_id, permission, subj_type, subj_rel,
+                    oracle_factory=lambda: self._oracle_for(snap),
+                ),
             )
         else:
             self._metrics.inc("lookups.subjects_oracle")
@@ -969,6 +987,149 @@ class Client:
             if err is not None:
                 raise err
             yield sid
+
+    def lookup_resources_page(
+        self, ctx: Context, cs: Strategy, permission: str, subject: str,
+        *, page_size: int = 1_000, cursor: Optional[str] = None,
+    ) -> "LookupPage":
+        """One cursor-paginated page of LookupResources — the reference's
+        cursored lookup surface (SURVEY §2).  Results arrive in stable
+        discovery order as the frontier expands, so the first page of a
+        huge answer returns before the fixpoint completes; the returned
+        ``cursor`` is revision-pinned and resumes EXACTLY (no duplicate
+        or lost IDs), as long as the pinned revision's prepared snapshot
+        is still resident (``PreconditionFailedError`` otherwise)."""
+        self._check_overlap(ctx)
+        subj_type, subj_id, subj_rel = parse_object_set(subject)
+        obj_type, obj_rel = parse_typed_relation(permission)
+
+        def run_page(engine, dsnap, snap, cur):
+            from .engine.lookup import lookup_resources_page as page
+
+            return page(
+                engine, dsnap, obj_type, obj_rel, subj_type, subj_id,
+                subj_rel, page_size=page_size, cursor=cur,
+                oracle_factory=lambda: self._oracle_for(snap),
+            )
+
+        return self._lookup_page(
+            ctx, cs, cursor, "lookup_resources_page",
+            ("res", obj_type, obj_rel, subj_type, subj_id, subj_rel),
+            run_page,
+            lambda snap, now_us: self._pinned_oracle(
+                snap, now_us
+            ).lookup_resources(
+                obj_type, obj_rel, subj_type, subj_id, subj_rel
+            ),
+            page_size,
+        )
+
+    def lookup_subjects_page(
+        self, ctx: Context, cs: Strategy, resource: str, permission: str,
+        subject: str, *, page_size: int = 1_000,
+        cursor: Optional[str] = None,
+    ) -> "LookupPage":
+        """One cursor-paginated page of LookupSubjects (see
+        lookup_resources_page for the cursor contract)."""
+        self._check_overlap(ctx)
+        res_type, res_id, _ = parse_object_set(resource)
+        subj_type, _, subj_rel = subject.partition("#")
+
+        def run_page(engine, dsnap, snap, cur):
+            from .engine.lookup import lookup_subjects_page as page
+
+            return page(
+                engine, dsnap, res_type, res_id, permission, subj_type,
+                subj_rel, page_size=page_size, cursor=cur,
+                oracle_factory=lambda: self._oracle_for(snap),
+            )
+
+        return self._lookup_page(
+            ctx, cs, cursor, "lookup_subjects_page",
+            ("subj", res_type, res_id, permission, subj_type, subj_rel),
+            run_page,
+            lambda snap, now_us: self._pinned_oracle(
+                snap, now_us
+            ).lookup_subjects(
+                res_type, res_id, permission, subj_type, subj_rel
+            ),
+            page_size,
+        )
+
+    def _pinned_oracle(self, snap: Snapshot, now_us: int) -> Oracle:
+        """A SnapshotOracle pinned to one evaluation time (cursor-paged
+        oracle fallbacks) — the shared LRU oracle stays wall-clocked for
+        ordinary conditional-check fallbacks."""
+        return SnapshotOracle(
+            snap,
+            {
+                name: self._store.caveat_program(name)
+                for name in snap.compiled.schema.caveats
+            },
+            now_us=now_us,
+        )
+
+    def _lookup_page(self, ctx, cs, cursor, metric, token_parts, run_page,
+                     run_oracle, page_size):
+        """Shared paged-lookup plumbing: cursor decode + revision
+        pinning, the retry envelope around the device dispatch, and a
+        sorted-scan fallback for engine-less schemas."""
+        from .engine.spmv import LookupCursor, query_token
+        from .utils.errors import PreconditionFailedError
+
+        cur = LookupCursor.decode(cursor) if cursor is not None else None
+        snap = self._store.snapshot_for(cs)
+        if cur is not None and cur.revision != snap.revision:
+            # revision-pinned resume: serve from the pinned revision's
+            # still-resident prepared snapshot, never silently from a
+            # different revision
+            with self._lock:
+                ds = self._lru_get(self._dsnap_cache, cur.revision)
+            if ds is None:
+                raise PreconditionFailedError(
+                    f"lookup cursor pinned to revision {cur.revision},"
+                    " which is no longer resident — restart the lookup"
+                )
+            snap = ds.source_snapshot or ds.snapshot
+        engine = self._engine_for(snap)
+        self._metrics.inc(f"lookups.{metric}")
+        if engine is None:
+            # oracle fallback: deterministic sorted scan, cursor = offset.
+            # The evaluation time resolves ONCE and rides the token +
+            # cursor (a resume after cache eviction must slice the SAME
+            # list, not one recomputed at a later wall clock), and the
+            # full answer caches on the snapshot keyed by the token —
+            # paging a 100k-result answer must not re-run the oracle
+            # scan + sort once per page
+            from .engine.spmv import resolve_now_us
+
+            now_us = resolve_now_us(cur, None)
+            token = query_token("oracle", snap.revision, now_us,
+                                *token_parts)
+            if cur is not None and cur.token != token:
+                raise PreconditionFailedError(
+                    "lookup cursor does not match this query"
+                )
+            pages = snap.__dict__.setdefault("_oracle_lookup_pages", {})
+            ids_all = pages.get(token)
+            if ids_all is None:
+                ids_all = sorted(run_oracle(snap, now_us))
+                pages[token] = ids_all
+                while len(pages) > 4:
+                    pages.pop(next(iter(pages)))
+            pos = cur.pos if cur is not None else 0
+            ids = ids_all[pos : pos + page_size]
+            nxt = None
+            if pos + len(ids) < len(ids_all):
+                nxt = LookupCursor(
+                    snap.revision, token, pos + len(ids), now_us
+                )
+            return LookupPage(ids, nxt.encode() if nxt else None)
+        dsnap = self._dsnap_for(engine, snap)
+        ids, nxt = retry_retriable_errors(
+            ctx, lambda: run_page(engine, dsnap, snap, cur)
+        )
+        return LookupPage(ids, nxt.encode() if nxt is not None else None)
 
 
 # ---------------------------------------------------------------------------

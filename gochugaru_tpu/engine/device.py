@@ -846,25 +846,13 @@ class DeviceEngine:
         tid_map = np.full(max(self.plan.num_schema_types, 1), -1, dtype=np.int32)
         for tname, tid in self.compiled.type_ids.items():
             tid_map[tid] = snap.interner.type_lookup(tname)
-        if (
-            self.config.lookup_prewarm
-            and snap.num_edges >= LOOKUP_PREWARM_MIN_EDGES
-            and getattr(snap, "_lookup_index", None) is None
-        ):
-            # build the transposed lookup index off-thread (numpy sorts
-            # release the GIL): the first lookup_resources at 1M+ docs
-            # then joins a mostly-finished build instead of paying the
-            # whole O(E log E) cold start inside a user-facing query
-            # (/root/reference/client/client.go:508-552 is the surface)
-            import threading
-
-            from .lookup import lookup_index
-
-            threading.Thread(
-                target=lookup_index, args=(snap,),
-                kwargs={"mark_used": False},
-                name="gochugaru-lookup-prewarm", daemon=True,
-            ).start()
+        if not self._frontier_will_serve(flat_meta, snap):
+            # snapshots carrying the reverse-CSR index (within the
+            # frontier's seen-set budget) answer lookups on the device
+            # frontier path (engine/spmv.py) — the O(E log E) transposed
+            # host index would be dead weight there; everything else
+            # still walker-serves and wants the background build
+            self._maybe_prewarm_walker_index(snap)
         metrics.default.observe(
             "prepare.total_s", _time.perf_counter() - _t0
         )
@@ -879,6 +867,47 @@ class DeviceEngine:
             closure_state=closure_state,
             host_arrays=host_arrays,
         )
+
+    @staticmethod
+    def _frontier_will_serve(flat_meta, snap) -> bool:
+        """Whether lookups on this snapshot take the device frontier
+        path (engine/spmv.py) — ONE shared predicate with frontier_ok's
+        static half, so the prewarm decision cannot drift from the
+        actual lookup routing."""
+        from .spmv import frontier_static_ok
+
+        return frontier_static_ok(flat_meta, snap)
+
+    def _maybe_prewarm_walker_index(self, snap: Snapshot) -> None:
+        """Build the transposed lookup index off-thread (numpy sorts
+        release the GIL): the first walker-served lookup_resources at
+        1M+ docs then joins a mostly-finished build instead of paying
+        the whole O(E log E) sort inside a user-facing query.  One
+        in-flight build per engine — a Watch chain of delta prepares
+        must not stack O(E log E) threads (once the first build lands,
+        the chain-advance machinery carries it forward in O(D))."""
+        if not (
+            self.config.lookup_prewarm
+            and snap.num_edges >= LOOKUP_PREWARM_MIN_EDGES
+            and getattr(snap, "_lookup_index", None) is None
+            and not self.__dict__.get("_prewarm_inflight")
+        ):
+            return
+        import threading
+
+        from .lookup import lookup_index
+
+        self._prewarm_inflight = True
+
+        def run():
+            try:
+                lookup_index(snap, mark_used=False)
+            finally:
+                self._prewarm_inflight = False
+
+        threading.Thread(
+            target=run, name="gochugaru-lookup-prewarm", daemon=True
+        ).start()
 
     def _delta_prev_ok(self, prev: DeviceSnapshot) -> bool:
         """Layout eligibility of ``prev`` for the incremental prepare —
@@ -959,6 +988,14 @@ class DeviceEngine:
             **extras.get("meta_up", {}),
         )
         self.record_device_bytes(arrays)
+        if meta.delta is not None:
+            # an LSM delta level declines the device frontier
+            # (engine/spmv.py frontier_ok), so lookups on this chain
+            # walker-serve: start the transposed-index build in the
+            # background NOW instead of paying it inside the first
+            # post-delta lookup (one in-flight build per engine; the
+            # chain-advance machinery carries it forward afterwards)
+            self._maybe_prewarm_walker_index(snap)
         return DeviceSnapshot(
             revision=snap.revision,
             arrays=arrays,

@@ -267,6 +267,18 @@ class FlatMeta:
     #: array holds uint16 residuals and ``{off_key}_a`` the int32 block
     #: anchors; off[i] == anchor[i >> shift] + residual[i]
     packed_off: Tuple[Tuple[str, int], ...] = ()
+    #: reverse-CSR lookup index (engine/rev.py; the frontier-SpMV tables
+    #: engine/spmv.py hops over): ``rvx``/``rv_off`` (all edges keyed by
+    #: k2 — reverse reachability), ``rax``/``ra_off`` (arrow rows keyed
+    #: by child — reverse tupleset traversal), and ``fwx``/``fw_off``
+    #: (all edges keyed by k1 — forward enumeration for LookupSubjects).
+    #: Caps are pow2 max bucket occupancies — the frontier kernel's
+    #: in-bucket bisect depth, not probe unroll counts
+    has_rev: bool = False
+    has_fw: bool = False
+    rv_cap: int = 4
+    ra_cap: int = 4
+    fw_cap: int = 4
     #: LSM delta level riding on this snapshot's base tables (None = the
     #: snapshot was fully prepared)
     delta: Optional[DeltaMeta] = None
@@ -1104,6 +1116,12 @@ def _pack_descs(name: str, meta: FlatMeta, dom: Dict, out: Dict):
         rows_len = int(out[name[:-2] + "x"].shape[0])
         fan = int(dom["fan"].get(name, 0))
         return [NODE, pk.col_range(-1, rows_len - 1), pk.col_delta(0, fan, 1)]
+    if name == "rvx":
+        return [K2, K1] + gates(meta.e_hascav, meta.e_hasexp)
+    if name == "fwx":
+        return [K1, K2] + gates(meta.e_hascav, meta.e_hasexp)
+    if name == "rax":
+        return [NODE, K1] + gates(meta.ar_hascav, meta.ar_hasexp)
     if name == "usx":
         return (
             [NODE, pk.col_range(-1, S1 - 2)]
@@ -1123,10 +1141,17 @@ def _pack_descs(name: str, meta: FlatMeta, dom: Dict, out: Dict):
 
 #: point-table offset arrays eligible for the anchor+residual encoding
 #: (single-chip layouts; stacked offs stay int32 — a shard cannot
-#: verify other shards' residual bounds before building)
+#: verify other shards' residual bounds before building).  The fold's
+#: DIRECT offset arrays (pfu_start/csr_start — dense-key-indexed, not
+#: bucket-indexed) pack under the same scheme: they are monotone row
+#: offsets like every other entry here, and the kernel's off_read
+#: decodes them identically (ROADMAP "pack the fold's direct offset
+#: arrays" follow-on)
 _PACK_OFF_KEYS = (
     "eh_off", "th_off", "pfh_off", "clh_off", "usr_off", "arr_off",
     "pfu_off", "csr_off", "push_off", "ovfh_off",
+    "pfu_start", "csr_start",
+    "rv_off", "ra_off", "fw_off",
 )
 
 
@@ -1144,7 +1169,7 @@ def _pack_flat(
 
     names = (
         ["ehx", "clx", "pfx", "tx", "usx", "arx", "pfux", "csrx",
-         "usgx", "argx", "pfugx", "csrgx"]
+         "usgx", "argx", "pfugx", "csrgx", "rvx", "fwx", "rax"]
         + [k for k in out if k.startswith("rc") and k.endswith(("x", "gx"))
            and not k.endswith("_off")]
     )
@@ -1352,13 +1377,16 @@ def build_flat_arrays(
         AL = jax.default_backend() == "tpu"
     al_meta: List[Tuple[str, int, int, int]] = []
 
-    def put_block(tbl_key: str, off_key: str, h, key_cols, cols):
+    def put_block(tbl_key: str, off_key: str, h, key_cols, cols,
+                  row_quantum: Optional[int] = None):
         """One point-probe table: bucket-aligned when enabled and it
         fits the byte budget, else bucket offsets + interleaved rows.
         ``h`` is a HashIndex or a zero-arg thunk building one (the
         legacy index is skipped entirely — including its size-doubling
         scan — when the aligned layout lands); returns the HashIndex
-        when the legacy layout was emitted, else None."""
+        when the legacy layout was emitted, else None.  ``row_quantum``
+        trims the rows table's pow2 padding to a multiple (the T join's
+        up-to-2x waste; see interleave_buckets)."""
         if AL:
             ai = build_aligned(
                 key_cols, cols, max_bytes=config.flat_aligned_max_bytes,
@@ -1372,9 +1400,17 @@ def build_flat_arrays(
         if callable(h):
             h = h()
         out[off_key] = h.off
-        out[tbl_key] = interleave_buckets(h, cols)
+        out[tbl_key] = interleave_buckets(h, cols, quantum=row_quantum)
         return h
 
+    e_gates = (
+        ([snap.e_caveat, snap.e_ctx] if e_hascav else [])
+        + ([snap.e_exp] if e_hasexp else [])
+    )
+    ar_gates = (
+        ([snap.ar_caveat, snap.ar_ctx] if ar_hascav else [])
+        + ([snap.ar_exp] if ar_hasexp else [])
+    )
     if BS:
         # block-slice layout: per point-probe table, the bucket offsets +
         # ONE bucket-ordered interleaved matrix (keys ++ payloads) — or
@@ -1384,9 +1420,7 @@ def build_flat_arrays(
         eh = put_block(
             "ehx", "eh_off", lambda: build_hash([e_k1, e_k2], **hk),
             [e_k1, e_k2],
-            [e_k1, e_k2]
-            + ([snap.e_caveat, snap.e_ctx] if e_hascav else [])
-            + ([snap.e_exp] if e_hasexp else []),
+            [e_k1, e_k2] + e_gates,
         )
         put_block(
             "usgx", "usr_off", usr.index, [usr.gk],
@@ -1455,9 +1489,15 @@ def build_flat_arrays(
         dom["until"]["tx"] = _until_dom(T_d, T_p)
         th = None
         if BS:
+            # row_quantum: the T join is the largest rebuilt-per-prepare
+            # rows table (~80% of packed bytes at config 3) — round its
+            # rows to a 4096 quantum instead of pow2 (ROADMAP "trim the
+            # pow2 row padding on the T join"); snapshot.device_bytes.tx
+            # shows the reduction live
             th = put_block(
                 "tx", "th_off", lambda: build_hash([T_k1, T_k2], **hk),
                 [T_k1, T_k2], [T_k1, T_k2, T_d, T_p],
+                row_quantum=4096,
             )
         else:
             th = build_hash([T_k1, T_k2])
@@ -1474,6 +1514,38 @@ def build_flat_arrays(
             t_slots=t_slots,
         )
     _mt.observe("prepare.tindex_s", time.perf_counter() - _t_tindex)
+
+    # ---- reverse-CSR lookup index (engine/rev.py) ----------------------
+    # the frontier-SpMV tables LookupResources/LookupSubjects hop over
+    # (engine/spmv.py): edges re-keyed by k2 (reverse), by k1 (forward),
+    # and arrow rows by child — built from the SAME packed key columns
+    # as the forward tables, M=1 stacked layout
+    rev_kw: Dict = {}
+    if BS and config.flat_rev_index:
+        _t_rev = time.perf_counter()
+        from .partition import _hash_cols
+        from .rev import build_rev_full, rev_geom, rev_meta_kw
+
+        h_rv = _hash_cols([e_k2])
+        ge_rv = rev_geom(h_rv, 1)
+        rv_cols = [e_k2, e_k1] + e_gates
+        out["rv_off"], out["rvx"] = build_rev_full(
+            h_rv, rv_cols, ge_rv, len(rv_cols)
+        )
+        h_ra = _hash_cols([snap.ar_child])
+        ge_ra = rev_geom(h_ra, 1)
+        ra_cols = [snap.ar_child, ar_gk] + ar_gates
+        out["ra_off"], out["rax"] = build_rev_full(
+            h_ra, ra_cols, ge_ra, len(ra_cols)
+        )
+        h_fw = _hash_cols([e_k1])
+        ge_fw = rev_geom(h_fw, 1)
+        fw_cols = [e_k1, e_k2] + e_gates
+        out["fw_off"], out["fwx"] = build_rev_full(
+            h_fw, fw_cols, ge_fw, len(fw_cols)
+        )
+        rev_kw = rev_meta_kw(ge_rv, ge_ra, ge_fw)
+        _mt.observe("prepare.rev_s", time.perf_counter() - _t_rev)
 
     # resource-side Leopard index: flattened ancestor closures for
     # self-recursive arrow hierarchies (block-slice layout only)
@@ -1555,6 +1627,7 @@ def build_flat_arrays(
         k2_dense=tuple(int(x) for x in maps.k2),
         **rc_kw,
         **fold_kw,
+        **rev_kw,
         e_cap=_round_cap(eh.cap) if eh is not None else 4,
         e_n=_ceil_pow2(max(eh.n, 1)) if eh is not None else 8,
         usr_cap=_round_cap(usr.index.cap),
@@ -1791,6 +1864,49 @@ def _e_cols_at(snap, maps: SlotMaps, N: int, S1: int, gates):
     return at
 
 
+def _rev_key_hash_chunked(
+    snap, maps: SlotMaps, N: int, S1: int, chunk: int, which: str
+):
+    """uint32 bucket hash of every primary row's single-column reverse-
+    index key (``which`` = "k2" for the reverse view, "k1" for the
+    forward view), computed in bounded row chunks — the reverse index's
+    ownership pass materializes no full-size packed key column, same
+    contract as _primary_hash_chunked."""
+    from .partition import _hash_cols
+
+    n = int(snap.e_rel.shape[0])
+    h = np.empty(n, np.uint32)
+    for at in range(0, n, max(chunk, 1)):
+        sl = slice(at, min(at + chunk, n))
+        if which == "k2":
+            k = _pack(snap.e_subj[sl], S1, _m_srel1(maps, snap.e_srel1[sl]))
+        else:
+            k = _pack(maps.k1[snap.e_rel[sl]], N, snap.e_res[sl])
+        h[sl] = _hash_cols([k])
+    return h
+
+
+def _rev_cols_at(snap, maps: SlotMaps, N: int, S1: int, gates, which: str):
+    """Partition-local reverse-index row columns ([key, other-key] +
+    gates), packed per shard — the rv/fw counterpart of _e_cols_at."""
+    from ..native.sort import take32
+
+    def at(rows: np.ndarray):
+        idx = np.ascontiguousarray(rows, np.int64)
+        k1 = _pack(
+            maps.k1[take32(snap.e_rel, idx)], N, take32(snap.e_res, idx)
+        )
+        k2 = _pack(
+            take32(snap.e_subj, idx), S1,
+            _m_srel1(maps, take32(snap.e_srel1, idx)),
+        )
+        cols = [k2, k1] if which == "k2" else [k1, k2]
+        cols.extend(take32(g, idx) for g in gates)
+        return cols
+
+    return at
+
+
 def build_flat_arrays_sharded(
     snap, config: EngineConfig, model_size: int,
     plan: Optional[DevicePlan] = None,
@@ -1982,6 +2098,64 @@ def build_flat_arrays_sharded(
             t_slots=t_slots,
         )
 
+    # ---- reverse-CSR lookup index (engine/rev.py), stacked M ways ------
+    # partition-first on the PART path (owner shard from the key hash,
+    # O(E/M) sort/gather scratch per shard — the allocation shim in
+    # tests/test_sharded_memory.py covers these calls); the legacy path
+    # builds full-then-stack (build_rev_full), the bitwise parity oracle
+    rev_kw: Dict = {}
+    if config.flat_rev_index:
+        from .partition import _hash_cols as _rvh
+        from .rev import (
+            build_rev_full, build_rev_partitioned, rev_geom, rev_meta_kw,
+        )
+
+        _t_rev = time.perf_counter()
+        ra_cols_full = [snap.ar_child, ar_gk] + ar_cols[1:]
+        if PART:
+            ck = config.flat_partition_chunk
+            h_rv = _rev_key_hash_chunked(snap, maps, N, S1, ck, "k2")
+            ge_rv = rev_geom(h_rv, M)
+            w_rv = 2 + len(e_gates)
+            out["rv_off"], out["rvx"] = build_rev_partitioned(
+                h_rv, _rev_cols_at(snap, maps, N, S1, e_gates, "k2"),
+                ge_rv, w_rv,
+            )
+            del h_rv
+            h_ra = _rvh([snap.ar_child])
+            ge_ra = rev_geom(h_ra, M)
+            out["ra_off"], out["rax"] = build_rev_partitioned(
+                h_ra, gather_cols(ra_cols_full), ge_ra, len(ra_cols_full)
+            )
+            del h_ra
+            h_fw = _rev_key_hash_chunked(snap, maps, N, S1, ck, "k1")
+            ge_fw = rev_geom(h_fw, M)
+            out["fw_off"], out["fwx"] = build_rev_partitioned(
+                h_fw, _rev_cols_at(snap, maps, N, S1, e_gates, "k1"),
+                ge_fw, w_rv,
+            )
+            del h_fw
+        else:
+            h_rv = _rvh([e_k2])
+            ge_rv = rev_geom(h_rv, M)
+            out["rv_off"], out["rvx"] = build_rev_full(
+                h_rv, [e_k2, e_k1] + e_gates, ge_rv, 2 + len(e_gates)
+            )
+            h_ra = _rvh([snap.ar_child])
+            ge_ra = rev_geom(h_ra, M)
+            out["ra_off"], out["rax"] = build_rev_full(
+                h_ra, ra_cols_full, ge_ra, len(ra_cols_full)
+            )
+            h_fw = _rvh([e_k1])
+            ge_fw = rev_geom(h_fw, M)
+            out["fw_off"], out["fwx"] = build_rev_full(
+                h_fw, [e_k1, e_k2] + e_gates, ge_fw, 2 + len(e_gates)
+            )
+        rev_kw = rev_meta_kw(ge_rv, ge_ra, ge_fw)
+        metrics.default.observe(
+            "prepare.rev_s", time.perf_counter() - _t_rev
+        )
+
     wc_nodes = snap.wildcard_node_of_type[snap.wildcard_node_of_type >= 0]
     fold_kw: Dict = {}
     got = _fold_packed(fr, snap, maps, N, config) if fr is not None else None
@@ -2099,6 +2273,7 @@ def build_flat_arrays_sharded(
         k1_dense=tuple(int(x) for x in maps.k1),
         k2_dense=tuple(int(x) for x in maps.k2),
         **fold_kw,
+        **rev_kw,
         rc_slots=tuple(sorted(rc_list)),
         e_cap=_round_cap(eh_cap), e_n=_ceil_pow2(max(eh_n, 1)),
         usr_cap=_round_cap(usr_cap),
@@ -2616,6 +2791,13 @@ def build_delta_arrays(
                 csr.index, [csr.gk, csr.glo, csr.ghi]
             ))
             meta_up["pf_s_cap"] = _round_cap(csr.index.cap)
+            if meta.pf_s_direct:
+                # the direct offset array (and its packed anchor, when
+                # the base packed it) is dead for the rest of the chain:
+                # drop it so device_bytes stays honest
+                drop_keys.extend(["csr_start", "csr_start_a"])
+                if "csr_start" in pko_map:
+                    pko_drop.add("csr_start")
             meta_up["pf_s_direct"] = False
 
         # stale baked T rows: every T-covered userset row whose group's
@@ -3386,8 +3568,8 @@ def make_flat_fn(
                 split = (not SH) or (PART and meta.pf_s_direct)
                 if split and meta.pf_s_direct:
                     kc = jnp.where(ok, k, 0)
-                    lo = tk(arrs["csr_start"], kc)
-                    hi = jnp.where(ok, tk(arrs["csr_start"], kc + 1), lo)
+                    lo = off_read("csr_start", kc)
+                    hi = jnp.where(ok, off_read("csr_start", kc + 1), lo)
                 else:
                     lo, hi = range_probe(
                         "csr_off", "csrgx", meta.pf_s_cap, k,
@@ -3515,8 +3697,8 @@ def make_flat_fn(
                     )
                     ok = exists & (fc >= 0)
                     base = jnp.where(ok, fc * Nc + nodes, 0)
-                    lo = tk(arrs["pfu_start"], base)
-                    hi = jnp.where(ok, tk(arrs["pfu_start"], base + 1), lo)
+                    lo = off_read("pfu_start", base)
+                    hi = jnp.where(ok, off_read("pfu_start", base + 1), lo)
                 else:
                     lo, hi = range_probe(
                         "pfu_off", "pfugx", meta.pf_u_cap, k1,

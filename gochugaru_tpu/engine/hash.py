@@ -253,7 +253,8 @@ def probe_range(ri_arrays, cap: int, n: int, q):
 
 
 def interleave_buckets(
-    h: HashIndex, cols: Sequence[np.ndarray], pad: int = 64
+    h: HashIndex, cols: Sequence[np.ndarray], pad: int = 64,
+    quantum: Optional[int] = None,
 ) -> np.ndarray:
     """Bucket-ordered interleaved matrix int32[n_pad, w]: row j holds
     ``cols[:][h.rows[j]]``.  Padded to pow2(n + max(pad, h.cap)) rows of -1
@@ -261,12 +262,23 @@ def interleave_buckets(
     bucket offset stays in bounds without clipping (padded keys are -1 and
     match nothing).  Callers slicing more than ``h.cap`` rows must pass
     their slice cap as ``pad`` — slice_blocks' clamp would otherwise SHIFT
-    the block and break the lane↔row mapping."""
+    the block and break the lane↔row mapping.
+
+    ``quantum`` replaces the pow2 round with round-up-to-a-multiple (the
+    slice-safety pad is kept either way): big rebuilt-per-prepare tables
+    (the T join — up to 2x pow2 waste at tens of millions of rows) trade
+    the coarse shape bucketing for near-exact residency; delta chains
+    never reshape base tables, so the retrace bound this table pays is
+    one compile per FULL prepare — which a fresh pow2 shape would
+    usually pay anyway."""
     from ..native.sort import fill_interleaved
 
     w = max(len(cols), 1)
     n = int(h.rows.shape[0]) if h.n else 0
-    n_pad = _ceil_pow2(max(n, 1) + max(pad, h.cap))
+    need = max(n, 1) + max(pad, h.cap)
+    n_pad = (
+        _ceil_pow2(need) if quantum is None else -(-need // quantum) * quantum
+    )
     # pad rows get -1; data rows are fully overwritten below, so only the
     # tail needs the fill (a 2-col 30M-row table skips a 256MB memset)
     out = np.empty((n_pad, w), np.int32)

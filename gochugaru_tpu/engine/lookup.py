@@ -229,41 +229,20 @@ def _exact_filter(
     return np.asarray(granted, np.int64)
 
 
-def lookup_resources_device(
-    engine,
-    dsnap,
-    resource_type: str,
-    permission: str,
-    subject_type: str,
-    subject_id: str,
-    subject_relation: str = "",
-    *,
-    now_us: Optional[int] = None,
-    oracle_factory: Optional[Callable[[], object]] = None,
-) -> List[str]:
-    """Resource ids of ``resource_type`` the subject definitively holds
-    ``permission`` on, sorted — reverse worklist expansion + device exact
-    filter.  Matches oracle.lookup_resources output exactly.
-
-    The worklist is over *subject-occurrence keys* packed
-    (node, srel1): scanning a key yields every edge where that userset
-    (or direct subject / wildcard) appears as the subject; each hit's
-    resource becomes a candidate, is closed under reverse arrows, and
-    contributes new keys — (res, rel+1) for the granted relation (the
-    membership chain, generalizing the device's Phase-A closure) and,
-    for schemas with permission-valued usersets, (n, p+1) for every
-    permission p on each new node n (the subject may hold p on n, so
-    edges granted to n#p may be granted to the subject)."""
+def _resolve_resources(dsnap, resource_type, permission, subject_type,
+                       subject_id, subject_relation):
+    """Shared query lowering of a LookupResources call: (rtid,
+    perm_slot, srel_slot, subj_node, wc_node) or None when the answer is
+    [] by construction (unknown names)."""
     snap: Snapshot = dsnap.snapshot
     interner = snap.interner
     compiled = snap.compiled
-    NS1 = snap.num_slots + 1
     perm_slot = compiled.slot_of_name.get(permission)
     rtid = interner.type_lookup(resource_type)
     if perm_slot is None or rtid < 0:
-        return []
+        return None
     if subject_relation and subject_relation not in compiled.slot_of_name:
-        return []
+        return None
     srel_slot = compiled.slot_of_name[subject_relation] if subject_relation else -1
     subj_node = interner.lookup(subject_type, subject_id)
     stid = interner.type_lookup(subject_type)
@@ -275,8 +254,30 @@ def lookup_resources_device(
     ):
         wc_node = int(snap.wildcard_node_of_type[stid])
     if subj_node < 0 and wc_node < 0:
-        return []
+        return None
+    return rtid, perm_slot, srel_slot, subj_node, wc_node
 
+
+def _walk_resource_candidates(
+    snap: Snapshot, subj_node: int, srel_slot: int, wc_node: int
+) -> np.ndarray:
+    """The host walker's reverse worklist expansion: every node on a
+    positive reverse path from the subject — the PARITY ORACLE of the
+    device frontier path (engine/spmv.py), and the serving fallback for
+    snapshots without the reverse-CSR index (legacy layouts, LSM delta
+    chains — whose advance_lookup_index machinery keeps this exact).
+
+    The worklist is over *subject-occurrence keys* packed
+    (node, srel1): scanning a key yields every edge where that userset
+    (or direct subject / wildcard) appears as the subject; each hit's
+    resource becomes a candidate, is closed under reverse arrows, and
+    contributes new keys — (res, rel+1) for the granted relation (the
+    membership chain, generalizing the device's Phase-A closure) and,
+    for schemas with permission-valued usersets, (n, p+1) for every
+    permission p on each new node n (the subject may hold p on n, so
+    edges granted to n#p may be granted to the subject)."""
+    compiled = snap.compiled
+    NS1 = snap.num_slots + 1
     idx = lookup_index(snap)
     perm_chains = bool(compiled.has_permission_usersets)
 
@@ -339,53 +340,37 @@ def lookup_resources_device(
         else:
             key_frontier = np.empty(0, np.int64)
 
-    cand = seen_nodes[snap.node_type[seen_nodes] == rtid]
-    if cand.size == 0:
-        return []
-
-    B = cand.shape[0]
-    oracle = None
-
-    def oracle_check(node: int) -> bool:
-        nonlocal oracle
-        if oracle is None:
-            oracle = oracle_factory()
-        from .oracle import T
-
-        _, rid = interner.key_of(node)
-        return oracle.check(
-            resource_type, rid, permission,
-            subject_type, subject_id, subject_relation,
-        ) == T
-
-    granted = _exact_filter(
-        engine, dsnap, cand,
-        q_res=cand.astype(np.int32),
-        q_perm=np.full(B, perm_slot, np.int32),
-        q_subj=np.full(B, subj_node, np.int32),
-        q_srel=np.full(B, srel_slot, np.int32),
-        q_wc=np.full(B, wc_node, np.int32),
-        now_us=now_us,
-        oracle_check=oracle_check,
-    )
-    return sorted(interner.key_of(int(n))[1] for n in granted)
+    return seen_nodes
 
 
-def lookup_subjects_device(
-    engine,
-    dsnap,
-    resource_type: str,
-    resource_id: str,
-    permission: str,
-    subject_type: str,
-    subject_relation: str = "",
-    *,
-    now_us: Optional[int] = None,
-    oracle_factory: Optional[Callable[[], object]] = None,
-) -> List[str]:
-    """Subject ids of ``subject_type`` definitively holding ``permission``
-    on the resource, sorted — forward worklist expansion + device exact
-    filter.  Matches oracle.lookup_subjects output exactly.
+def _resolve_subjects(dsnap, resource_type, resource_id, permission,
+                      subject_type, subject_relation):
+    """Shared query lowering of a LookupSubjects call: (res_node,
+    perm_slot, srel_slot, stid, wc_node) or None when the answer is []
+    by construction."""
+    snap: Snapshot = dsnap.snapshot
+    interner = snap.interner
+    compiled = snap.compiled
+    perm_slot = compiled.slot_of_name.get(permission)
+    res_node = interner.lookup(resource_type, resource_id)
+    stid = interner.type_lookup(subject_type)
+    if perm_slot is None or res_node < 0 or stid < 0:
+        return None
+    if subject_relation and subject_relation not in compiled.slot_of_name:
+        return None
+    srel_slot = compiled.slot_of_name[subject_relation] if subject_relation else -1
+    wc_node = -1
+    if 0 <= stid < snap.wildcard_node_of_type.shape[0]:
+        wc_node = int(snap.wildcard_node_of_type[stid])
+    return res_node, perm_slot, srel_slot, stid, wc_node
+
+
+def _walk_subject_candidates(
+    snap: Snapshot, res_node: int, stid: int, srel_slot: int, wc_node: int
+) -> np.ndarray:
+    """The host walker's forward worklist expansion — the parity oracle
+    of the device forward-frontier path and the fallback for layouts
+    without the reverse-CSR index.
 
     The worklist alternates nodes and userset pairs: a node contributes
     its arrow subgraph and every edge hanging off it (direct subjects →
@@ -393,22 +378,8 @@ def lookup_subjects_device(
     members when r is a relation (edges (r, g)), or puts g back on the
     node worklist when r is a *permission* — holders of r on g are found
     by expanding g itself (superset; the forward check is exact)."""
-    snap: Snapshot = dsnap.snapshot
-    interner = snap.interner
     compiled = snap.compiled
     NS = snap.num_slots
-    perm_slot = compiled.slot_of_name.get(permission)
-    res_node = interner.lookup(resource_type, resource_id)
-    stid = interner.type_lookup(subject_type)
-    if perm_slot is None or res_node < 0 or stid < 0:
-        return []
-    if subject_relation and subject_relation not in compiled.slot_of_name:
-        return []
-    srel_slot = compiled.slot_of_name[subject_relation] if subject_relation else -1
-    wc_node = -1
-    if 0 <= stid < snap.wildcard_node_of_type.shape[0]:
-        wc_node = int(snap.wildcard_node_of_type[stid])
-
     idx = lookup_index(snap)
     ts_slots = np.asarray(sorted(compiled.tupleset_slots), np.int64)
 
@@ -505,42 +476,286 @@ def lookup_subjects_device(
         cand_parts.append(all_subj[snap.node_type[all_subj] == stid])
 
     if not cand_parts:
-        return []
-    cand = np.unique(np.concatenate(cand_parts))
-    if cand.size == 0:
-        return []
+        return np.empty(0, np.int64)
+    return np.unique(np.concatenate(cand_parts))
 
-    B = cand.shape[0]
-    q_wc = np.full(B, -1, np.int32)
-    if srel_slot < 0 and wc_node >= 0:
-        # a candidate that IS the wildcard node checks as itself, not
-        # against the wildcard (oracle: subject_id != WILDCARD guard)
-        q_wc = np.where(cand == wc_node, -1, wc_node).astype(np.int32)
-    oracle = None
+
+# ---------------------------------------------------------------------------
+# dispatch: device frontier SpMV (engine/spmv.py) with walker fallback,
+# cursor-paginated streaming
+# ---------------------------------------------------------------------------
+
+
+def _res_filter(engine, dsnap, resolved, names, now_us, oracle_factory):
+    """(filter_fn, id_of) of one LookupResources query — exact device
+    forward check over a candidate block, oracle re-checks for
+    overflow/possible (shared by the frontier and walker streams)."""
+    rtid, perm_slot, srel_slot, subj_node, wc_node = resolved
+    resource_type, permission, subject_type, subject_id, subject_relation = names
+    interner = dsnap.snapshot.interner
+    oracle = [None]
 
     def oracle_check(node: int) -> bool:
-        nonlocal oracle
-        if oracle is None:
-            oracle = oracle_factory()
+        if oracle[0] is None:
+            oracle[0] = oracle_factory()
+        from .oracle import T
+
+        _, rid = interner.key_of(node)
+        # now_us pins the re-check to the stream's evaluation time — a
+        # recompute-resume must not re-gate expirations at a later clock
+        return oracle[0].check(
+            resource_type, rid, permission,
+            subject_type, subject_id, subject_relation,
+            now_us=now_us,
+        ) == T
+
+    def filt(cand: np.ndarray) -> np.ndarray:
+        B = cand.shape[0]
+        return _exact_filter(
+            engine, dsnap, cand,
+            q_res=cand.astype(np.int32),
+            q_perm=np.full(B, perm_slot, np.int32),
+            q_subj=np.full(B, subj_node, np.int32),
+            q_srel=np.full(B, srel_slot, np.int32),
+            q_wc=np.full(B, wc_node, np.int32),
+            now_us=now_us,
+            oracle_check=oracle_check,
+        )
+
+    return filt, (lambda n: interner.key_of(n)[1])
+
+
+def _subj_filter(engine, dsnap, resolved, names, now_us, oracle_factory):
+    res_node, perm_slot, srel_slot, stid, wc_node = resolved
+    resource_type, resource_id, permission, subject_type, subject_relation = names
+    interner = dsnap.snapshot.interner
+    oracle = [None]
+
+    def oracle_check(node: int) -> bool:
+        if oracle[0] is None:
+            oracle[0] = oracle_factory()
         from .oracle import T
 
         _, sid = interner.key_of(node)
-        return oracle.check(
+        return oracle[0].check(
             resource_type, resource_id, permission,
             subject_type, sid, subject_relation,
+            now_us=now_us,
         ) == T
 
-    granted = _exact_filter(
-        engine, dsnap, cand,
-        q_res=np.full(B, res_node, np.int32),
-        q_perm=np.full(B, perm_slot, np.int32),
-        q_subj=cand.astype(np.int32),
-        q_srel=np.full(B, srel_slot, np.int32),
-        q_wc=q_wc,
-        now_us=now_us,
-        oracle_check=oracle_check,
+    def filt(cand: np.ndarray) -> np.ndarray:
+        B = cand.shape[0]
+        q_wc = np.full(B, -1, np.int32)
+        if srel_slot < 0 and wc_node >= 0:
+            # a candidate that IS the wildcard node checks as itself, not
+            # against the wildcard (oracle: subject_id != WILDCARD guard)
+            q_wc = np.where(cand == wc_node, -1, wc_node).astype(np.int32)
+        return _exact_filter(
+            engine, dsnap, cand,
+            q_res=np.full(B, res_node, np.int32),
+            q_perm=np.full(B, perm_slot, np.int32),
+            q_subj=cand.astype(np.int32),
+            q_srel=np.full(B, srel_slot, np.int32),
+            q_wc=q_wc,
+            now_us=now_us,
+            oracle_check=oracle_check,
+        )
+
+    return filt, (lambda n: interner.key_of(n)[1])
+
+
+def _one_block(cand: np.ndarray):
+    if cand.size:
+        yield cand
+
+
+def _frontier_stream_bytes(meta, snap) -> int:
+    """Estimated host bytes a live frontier stream holds (the seen-set
+    bitmaps dominate) — the paginate cache's eviction weight."""
+    ns = max(snap.num_slots, 1) + 1
+    return (meta.N * meta.S1 + 2 * meta.N + meta.N * ns) >> 3
+
+
+def lookup_resources_page(
+    engine,
+    dsnap,
+    resource_type: str,
+    permission: str,
+    subject_type: str,
+    subject_id: str,
+    subject_relation: str = "",
+    *,
+    page_size: int = 1_000,
+    cursor=None,
+    now_us: Optional[int] = None,
+    oracle_factory: Optional[Callable[[], object]] = None,
+):
+    """One cursor-paginated page of LookupResources: (ids, next_cursor).
+
+    Results stream in deterministic discovery order — the first page of
+    a 10M-resource answer returns after the first few frontier hops,
+    before the fixpoint completes.  ``cursor`` (engine/spmv.py
+    LookupCursor) is revision-pinned: resuming continues the cached
+    live stream, or deterministically recomputes and skips.  The device
+    frontier path (engine/spmv.py) serves snapshots carrying the
+    reverse-CSR index; legacy layouts and LSM delta chains keep the
+    host walker (delta-exact through advance_lookup_index)."""
+    from . import spmv
+
+    names = (resource_type, permission, subject_type, subject_id,
+             subject_relation)
+    # evaluation time resolves ONCE and rides the cursor: a recompute-
+    # resume must re-gate expirations at the same instant (spmv.py)
+    now_us = spmv.resolve_now_us(cursor, now_us)
+    token = spmv.query_token("res", dsnap.revision, now_us, *names)
+    resolved = _resolve_resources(dsnap, *names)
+    if resolved is None:
+        return [], None
+    rtid, perm_slot, srel_slot, subj_node, wc_node = resolved
+    filt, id_of = _res_filter(
+        engine, dsnap, resolved, names, now_us, oracle_factory
     )
-    return sorted(interner.key_of(int(n))[1] for n in granted)
+    snap = dsnap.snapshot
+
+    def make_stream():
+        if spmv.frontier_ok(engine, dsnap):
+            from ..utils import metrics as _m
+
+            _m.default.inc("lookups.frontier")
+            st = spmv.state_for(engine, dsnap)
+            cands = st.resource_candidates(
+                rtid, subj_node, srel_slot, wc_node, now_us
+            )
+            cost = _frontier_stream_bytes(dsnap.flat_meta, snap)
+        else:
+            from ..utils import metrics as _m
+
+            _m.default.inc("lookups.walker")
+            seen = _walk_resource_candidates(
+                snap, subj_node, srel_slot, wc_node
+            )
+            cands = _one_block(seen[snap.node_type[seen] == rtid])
+            cost = 1 << 20
+        return spmv._ResultStream(cands, filt, id_of, cost_bytes=cost)
+
+    return spmv.paginate(
+        dsnap, token, make_stream, page_size, cursor, now_us
+    )
+
+
+def lookup_subjects_page(
+    engine,
+    dsnap,
+    resource_type: str,
+    resource_id: str,
+    permission: str,
+    subject_type: str,
+    subject_relation: str = "",
+    *,
+    page_size: int = 1_000,
+    cursor=None,
+    now_us: Optional[int] = None,
+    oracle_factory: Optional[Callable[[], object]] = None,
+):
+    """One cursor-paginated page of LookupSubjects: (ids, next_cursor) —
+    the forward-frontier mirror of ``lookup_resources_page``."""
+    from . import spmv
+
+    names = (resource_type, resource_id, permission, subject_type,
+             subject_relation)
+    now_us = spmv.resolve_now_us(cursor, now_us)
+    token = spmv.query_token("subj", dsnap.revision, now_us, *names)
+    resolved = _resolve_subjects(dsnap, *names)
+    if resolved is None:
+        return [], None
+    res_node, perm_slot, srel_slot, stid, wc_node = resolved
+    filt, id_of = _subj_filter(
+        engine, dsnap, resolved, names, now_us, oracle_factory
+    )
+    snap = dsnap.snapshot
+
+    def make_stream():
+        if spmv.frontier_ok(engine, dsnap) and dsnap.flat_meta.has_fw:
+            from ..utils import metrics as _m
+
+            _m.default.inc("lookups.frontier")
+            st = spmv.state_for(engine, dsnap)
+            cands = st.subject_candidates(
+                res_node, stid, srel_slot, wc_node, now_us
+            )
+            cost = _frontier_stream_bytes(dsnap.flat_meta, snap)
+        else:
+            from ..utils import metrics as _m
+
+            _m.default.inc("lookups.walker")
+            cands = _one_block(_walk_subject_candidates(
+                snap, res_node, stid, srel_slot, wc_node
+            ))
+            cost = 1 << 20
+        return spmv._ResultStream(cands, filt, id_of, cost_bytes=cost)
+
+    return spmv.paginate(
+        dsnap, token, make_stream, page_size, cursor, now_us
+    )
+
+
+def lookup_resources_device(
+    engine,
+    dsnap,
+    resource_type: str,
+    permission: str,
+    subject_type: str,
+    subject_id: str,
+    subject_relation: str = "",
+    *,
+    now_us: Optional[int] = None,
+    oracle_factory: Optional[Callable[[], object]] = None,
+) -> List[str]:
+    """Resource ids of ``resource_type`` the subject definitively holds
+    ``permission`` on, sorted — the full-answer surface (drains the
+    paginated stream).  Matches oracle.lookup_resources exactly on both
+    serving paths (tests/test_lookup.py, tests/test_lookup_stream.py)."""
+    out: List[str] = []
+    cursor = None
+    while True:
+        ids, cursor = lookup_resources_page(
+            engine, dsnap, resource_type, permission, subject_type,
+            subject_id, subject_relation,
+            page_size=65_536, cursor=cursor, now_us=now_us,
+            oracle_factory=oracle_factory,
+        )
+        out.extend(ids)
+        if cursor is None:
+            return sorted(out)
+
+
+def lookup_subjects_device(
+    engine,
+    dsnap,
+    resource_type: str,
+    resource_id: str,
+    permission: str,
+    subject_type: str,
+    subject_relation: str = "",
+    *,
+    now_us: Optional[int] = None,
+    oracle_factory: Optional[Callable[[], object]] = None,
+) -> List[str]:
+    """Subject ids of ``subject_type`` definitively holding ``permission``
+    on the resource, sorted — the full-answer surface of the paginated
+    stream.  Matches oracle.lookup_subjects exactly on both paths."""
+    out: List[str] = []
+    cursor = None
+    while True:
+        ids, cursor = lookup_subjects_page(
+            engine, dsnap, resource_type, resource_id, permission,
+            subject_type, subject_relation,
+            page_size=65_536, cursor=cursor, now_us=now_us,
+            oracle_factory=oracle_factory,
+        )
+        out.extend(ids)
+        if cursor is None:
+            return sorted(out)
 
 
 # ---------------------------------------------------------------------------

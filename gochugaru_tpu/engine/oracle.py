@@ -146,8 +146,11 @@ class Oracle:
         subject_id: str,
         subject_relation: str = "",
         context: Optional[Mapping[str, Any]] = None,
+        now_us: Optional[int] = None,
     ) -> int:
-        """Tri-state check of one (resource, permission, subject)."""
+        """Tri-state check of one (resource, permission, subject).
+        ``now_us`` pins the evaluation time for this call (cursor-pinned
+        lookup re-checks); None keeps the oracle's own clock."""
         memo: Dict[Tuple[str, str, str], int] = {}
         in_progress: Set[Tuple[str, str, str]] = set()
         # Keys that were returned as F because they were in progress (cycle
@@ -157,7 +160,8 @@ class Oracle:
         # final answer for siblings outside the cycle.
         cut_hits: Set[Tuple[str, str, str]] = set()
         ctx = context or {}
-        now_us = self._now_us()
+        if now_us is None:
+            now_us = self._now_us()
         subject = (subject_type, subject_id, subject_relation)
 
         def eval_item(rtype: str, rid: str, item: str) -> int:

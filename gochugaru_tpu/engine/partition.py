@@ -591,6 +591,13 @@ def partition_feed(
     ``plan=None`` fold/rc are declined as before (the parity oracle for
     the walked layout).
 
+    The reverse-CSR lookup index (engine/rev.py) is DECLINED on this
+    path: its shard ownership is keyed by the SUBJECT hash, not the
+    primary (k1, k2) bucket the owned feed rows arrive keyed by — a
+    process would need other owners' rows to build its rv slices (an
+    owner exchange at feed time; ROADMAP follow-on).  Lookups on a
+    feed-partitioned snapshot serve through the host walker.
+
     ``serve`` picks the placement the tables are built for:
 
     - ``"partitioned"`` (default): every O(E)-scale table materializes

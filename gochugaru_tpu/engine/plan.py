@@ -78,8 +78,24 @@ class EngineConfig:
     #: prewarm the transposed lookup index in a background thread at full
     #: prepare time (worlds ≥ LOOKUP_PREWARM_MIN_EDGES edges): cold
     #: lookup_resources joins a mostly-finished build instead of paying
-    #: the O(E log E) sort inside the first user-facing query
+    #: the O(E log E) sort inside the first user-facing query.  Only
+    #: engaged when the HOST walker would serve lookups — snapshots
+    #: carrying the reverse-CSR index (flat_rev_index) answer on the
+    #: device frontier path and never need the transposed host index
     lookup_prewarm: bool = True
+    #: build the reverse-CSR lookup index alongside the forward tables
+    #: (engine/rev.py: rvx/rax/fwx + offsets): LookupResources/
+    #: LookupSubjects then run as device-resident masked frontier SpMV
+    #: (engine/spmv.py) instead of the host walker.  Costs ~16-24 packed
+    #: bytes/edge of extra residency; False falls back to the walker
+    flat_rev_index: bool = True
+    #: per-dispatch row budget of the frontier expansion kernel: each
+    #: hop emits matches in chunks of this many rows (fixed shape — one
+    #: compiled program regardless of fan-out)
+    lookup_chunk: int = 65_536
+    #: frontier-key padding floor (pow2 tiers above it): bounds expansion
+    #: kernel retraces the way batch_bucket_min bounds check dispatches
+    lookup_frontier_min: int = 1_024
     #: dl_* table shape floor: delta tables pre-size to this many rows so
     #: consecutive revisions keep ONE compiled kernel instead of
     #: retracing at every pow2 row-count boundary (a retrace costs ~1s —

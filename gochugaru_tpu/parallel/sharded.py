@@ -724,6 +724,17 @@ class ShardedEngine(DeviceEngine):
         queries, _, qctx = self._lower_queries(dsnap.snapshot, rels, dsnap.strings)
         return self._dispatch_columns(dsnap, queries, qctx, now_us, span=span)
 
+    # -- owner-routed lookup hops (engine/spmv.py frontier SpMV) ----------
+    def lookup_hops_for(self, dsnap: DeviceSnapshot, kern):
+        """The sharded hop backend of the lookup frontier engine: each
+        hop's frontier keys are grouped to their OWNER shard host-side
+        (high bits of the reverse-index bucket — only owner-crossing
+        IDs move), and the single-shard probe/emit bodies run
+        shard_mapped over the model axis with no collective (inside a
+        shard the stacked off/table blocks have exactly the
+        single-chip shapes, so the bodies are shared verbatim)."""
+        return _ShardedLookupHops(self, dsnap, kern)
+
     def check_columns(
         self,
         dsnap: DeviceSnapshot,
@@ -749,3 +760,124 @@ class ShardedEngine(DeviceEngine):
         return self._dispatch_columns(
             dsnap, queries, qctx, now_us, fetch=fetch, bucket_min=bucket_min
         )
+
+
+# ---------------------------------------------------------------------------
+# owner-routed lookup hops (engine/spmv.py frontier SpMV over the
+# bucket-sharded reverse-CSR tables)
+# ---------------------------------------------------------------------------
+
+
+class _ShardedLookupHops:
+    """One DeviceSnapshot's routed hop executor.  A hop:
+
+    1. HOST: owner of each frontier key = high bits of its reverse-index
+       bucket (the partition discipline of engine/partition.py) — keys
+       group into per-owner blocks, so the only bytes that cross shards
+       are the owner-crossing frontier IDs themselves;
+    2. DEVICE: the shard_mapped probe body finds each key's contiguous
+       run in ITS shard's block (local bucket = low bits — the stacked
+       layout guarantees a key's rows live wholly on its owner), then
+       budgeted emission kernels stream the matches per shard, each
+       shard walking its own chunk cursor;
+    3. HOST: merged live rows feed the frontier engine exactly like the
+       single-chip path (engine/spmv.py FrontierState).
+
+    The compiled programs contain NO collective — routing made every
+    probe local by construction, mirroring _dispatch_flat_routed."""
+
+    #: probe-argument table per hop kind: (off key, rows-table key)
+    _TABS = {
+        "rv": ("rv_off", "rvx"),
+        "ra": ("ra_off", "rax"),
+        "fw": ("fw_off", "fwx"),
+        "arg": ("arr_off", "argx"),
+    }
+
+    def __init__(self, engine: ShardedEngine, dsnap: DeviceSnapshot, kern):
+        self.engine = engine
+        self.dsnap = dsnap
+        self.kern = kern
+        self.M = engine.model_size
+        self.mesh = engine.mesh
+        self._fns: Dict = engine.__dict__.setdefault("_lookup_hop_fns", {})
+        self._dummy = jnp.zeros(1, jnp.int32)
+
+    def _fn_pair(self, kind: str):
+        """(runs_fn, emit_fn) shard_mapped over the model axis, cached
+        per (meta, kind) on the engine."""
+        key = (self.dsnap.flat_meta, kind)
+        got = self._fns.get(key)
+        if got is not None:
+            return got
+        MP = P(MODEL_AXIS)
+        runs = jax.jit(shard_map(
+            self.kern.raw_runs[kind], mesh=self.mesh,
+            in_specs=(MP, P(), MP, MP), out_specs=(MP, MP),
+            **_SHARD_MAP_NO_CHECK,
+        ))
+        body = self.kern.raw_emits[kind]
+        CH = self.kern.CH  # fixed chunk per shard (static under jit)
+        emit = jax.jit(shard_map(
+            lambda t, l, n, c0, nw: body(t, l, n, c0, nw, CH),
+            mesh=self.mesh,
+            in_specs=(MP, MP, MP, MP, P()), out_specs=(MP, MP),
+            **_SHARD_MAP_NO_CHECK,
+        ))
+        got = (runs, emit)
+        while len(self._fns) >= 16:
+            self._fns.pop(next(iter(self._fns)))
+        self._fns[key] = got
+        return got
+
+    def expand(self, kind: str, keys: np.ndarray, now):
+        """Generator of live row blocks for ``keys`` over one view —
+        the sharded mirror of FrontierKernels.expand."""
+        from ..engine.hash import mix32 as _mix
+        from ..engine.spmv import _mt
+        from ..utils import faults as _faults
+
+        if keys.shape[0] == 0:
+            return
+        _faults.fire("lookup.dispatch")
+        arrs = self.dsnap.arrays
+        off_key, tbl_key = self._TABS[kind]
+        off, tbl = arrs[off_key], arrs[tbl_key]
+        # emission gathers rows from the arx view for arrow hops (the
+        # group table only resolves ranges)
+        emit_tbl = arrs["arx"] if kind == "arg" else tbl
+        M = self.M
+        bpd = off.shape[0] // M - 1
+        size = bpd * M
+        kk = np.ascontiguousarray(keys, np.int32)
+        h = _mix([kk], np)
+        owner = ((h & np.uint32(size - 1)) >> np.uint32(
+            bpd.bit_length() - 1
+        )).astype(np.int64)
+        counts = np.bincount(owner, minlength=M)
+        per = 1 << max(int(counts.max()) - 1, 0).bit_length()
+        per = max(per, self.kern.F_min)
+        routed = np.full(M * per, -1, np.int32)
+        order = np.argsort(owner, kind="stable")
+        starts = np.cumsum(counts) - counts
+        # rank within the owner group, aligned with the sorted order
+        rank = np.arange(kk.shape[0], dtype=np.int64) - np.repeat(
+            starts, counts
+        )
+        routed[owner[order] * per + rank] = kk[order]
+        runs_fn, emit_fn = self._fn_pair(kind)
+        lo, ln = runs_fn(off, self._dummy, tbl, jnp.asarray(routed))
+        _mt.inc("lookup.hops")
+        totals = np.asarray(ln).reshape(M, per).sum(axis=1)
+        CH = self.kern.CH
+        at = np.zeros(M, np.int64)
+        nowj = jnp.asarray(now)
+        while bool((at < totals).any()):
+            rows, live = emit_fn(
+                emit_tbl, lo, ln, jnp.asarray(at.astype(np.int32)), nowj
+            )
+            rows, live = jax.device_get((rows, live))
+            got = rows[live]
+            if got.shape[0]:
+                yield got
+            at = np.minimum(at + CH, totals)

@@ -43,6 +43,9 @@ Injection sites threaded through the tree (grep ``faults.fire``):
                              engine/partition.py partition_feed)
     closure.delta            incremental closure advance (store/closure.py)
     device.dispatch          batched check dispatch (engine/device.py)
+    lookup.dispatch          frontier-SpMV lookup hop dispatch
+                             (engine/spmv.py; the client's lookup
+                             surface retries these under the envelope)
     latency.dispatch         pinned small-batch dispatch (engine/latency.py)
     sharded.dispatch         sharded query partition (parallel/sharded.py)
     sharded.collective       shard_map kernel launch (parallel/sharded.py)

@@ -1,0 +1,169 @@
+#!/usr/bin/env bash
+# Lookup smoke: the frontier-SpMV lookup surface end-to-end on a small
+# world, CI-runnable.  Asserts (1) host-walker parity of the device
+# frontier path for LookupResources AND LookupSubjects, (2) a cursor-
+# paginated multi-thousand-resource answer reassembles exactly (no
+# dup/lost IDs across pages, resume mid-stream), and (3) the bucket-
+# sharded owner-routed hop path matches the single-chip answer.  Prints
+# LOOKUP-SMOKE-OK on success, mirroring the chaos/telemetry/partition/
+# hbm smokes.  Emits one JSON metric line for benchmarks/run_all.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python - <<'EOF'
+import json
+import sys
+import time
+
+import numpy as np
+
+from gochugaru_tpu.utils.platform import force_cpu_platform
+
+force_cpu_platform(8)
+
+sys.path.insert(0, ".")
+from benchmarks.bench3_docs import EPOCH
+from gochugaru_tpu.engine import lookup as lm
+from gochugaru_tpu.engine import spmv
+from gochugaru_tpu.engine.device import DeviceEngine
+from gochugaru_tpu.engine.oracle import SnapshotOracle
+from gochugaru_tpu.engine.plan import EngineConfig
+from gochugaru_tpu.parallel import ShardedEngine, make_mesh
+from gochugaru_tpu.schema import compile_schema, parse_schema
+from gochugaru_tpu.store.interner import Interner
+from gochugaru_tpu.store.snapshot import build_snapshot_from_columns
+
+t0 = time.time()
+# a doc-style world big enough for a >10k-resource answer: one team
+# userset viewing many docs through a folder tree
+SCHEMA = """
+definition user {}
+definition group { relation member: user | group#member }
+definition folder {
+    relation parent: folder
+    relation viewer: user | group#member
+    permission view = viewer + parent->view
+}
+definition document {
+    relation folder: folder
+    relation viewer: user | group#member
+    permission view = viewer + folder->view
+}
+"""
+cs = compile_schema(parse_schema(SCHEMA))
+interner = Interner()
+rng = np.random.default_rng(5)
+N_DOCS, N_FOLDERS = 30_000, 600
+users = np.array([interner.node("user", f"u{i}") for i in range(300)])
+groups = np.array([interner.node("group", f"g{i}") for i in range(8)])
+folders = np.array(
+    [interner.node("folder", f"f{i}") for i in range(N_FOLDERS)]
+)
+docs = np.array([interner.node("document", f"d{i}") for i in range(N_DOCS)])
+slot = cs.slot_of_name
+res, rl, sub, sr = [], [], [], []
+
+
+def bulk(r, l, s, srl):
+    res.append(np.asarray(r, np.int64))
+    rl.append(np.full(len(r), l, np.int64))
+    sub.append(np.asarray(s, np.int64))
+    sr.append(np.full(len(r), srl, np.int64))
+
+
+# g0 contains g1's members plus direct users; root folder viewed by g0
+bulk(groups[:4], slot["member"], groups[1:5], slot["member"])
+gm = np.repeat(groups, 6)
+bulk(gm, slot["member"], rng.choice(users, gm.shape[0]), -1)
+f_idx = np.arange(1, N_FOLDERS)
+bulk(folders[f_idx], slot["parent"], folders[(f_idx - 1) // 8], -1)
+bulk(folders[:1], slot["viewer"], groups[:1], slot["member"])
+bulk(docs, slot["folder"], rng.choice(folders, N_DOCS), -1)
+bulk(docs[: N_DOCS // 10], slot["viewer"],
+     rng.choice(users, N_DOCS // 10), -1)
+snap = build_snapshot_from_columns(
+    1, cs, interner,
+    res=np.concatenate(res), rel=np.concatenate(rl),
+    subj=np.concatenate(sub), srel=np.concatenate(sr), epoch_us=EPOCH,
+)
+oracle = SnapshotOracle(snap, {})
+engine = DeviceEngine(cs)
+dsnap = engine.prepare(snap)
+assert spmv.frontier_ok(engine, dsnap), "frontier path must serve"
+
+# (1) host-walker parity, both directions
+walker = DeviceEngine(cs, EngineConfig.for_schema(cs, flat_rev_index=False))
+wds = walker.prepare(snap)
+checked = 0
+for u in [interner.key_of(int(x))[1] for x in users[:6]]:
+    got = lm.lookup_resources_device(
+        engine, dsnap, "document", "view", "user", u,
+        now_us=EPOCH, oracle_factory=lambda: oracle,
+    )
+    ref = lm.lookup_resources_device(
+        walker, wds, "document", "view", "user", u,
+        now_us=EPOCH, oracle_factory=lambda: oracle,
+    )
+    assert got == ref, f"walker mismatch for user {u}"
+    checked += len(got)
+for d in [interner.key_of(int(x))[1] for x in docs[:4]]:
+    got = lm.lookup_subjects_device(
+        engine, dsnap, "document", d, "view", "user",
+        now_us=EPOCH, oracle_factory=lambda: oracle,
+    )
+    ref = lm.lookup_subjects_device(
+        walker, wds, "document", d, "view", "user",
+        now_us=EPOCH, oracle_factory=lambda: oracle,
+    )
+    assert got == ref
+print(f"walker parity: ok ({checked} results compared)", file=sys.stderr)
+
+# (2) a member of g1 reaches the whole root-folder subtree through the
+# nested-group + arrow chain: paginated reassembly must be exact
+member = None
+for x in users:
+    uid = interner.key_of(int(x))[1]
+    full = lm.lookup_resources_device(
+        engine, dsnap, "document", "view", "user", uid,
+        now_us=EPOCH, oracle_factory=lambda: oracle,
+    )
+    if len(full) > 10_000:
+        member = (uid, full)
+        break
+assert member is not None, "no subject with a >10k-resource answer"
+uid, full = member
+out, pages, cursor = [], 0, None
+while True:
+    ids, cursor = lm.lookup_resources_page(
+        engine, dsnap, "document", "view", "user", uid,
+        page_size=1_024, cursor=cursor, now_us=EPOCH,
+        oracle_factory=lambda: oracle,
+    )
+    out.extend(ids)
+    pages += 1
+    if cursor is None:
+        break
+assert len(out) == len(set(out)), "duplicate ids across pages"
+assert sorted(out) == full, "paginated reassembly diverged"
+print(f"paginated {len(out)} resources over {pages} pages: exact",
+      file=sys.stderr)
+
+# (3) owner-routed sharded hops match single-chip
+sh = ShardedEngine(cs, make_mesh(1, 2))
+sds = sh.prepare(snap)
+assert sds.flat_meta.has_rev and spmv.frontier_ok(sh, sds)
+got = lm.lookup_resources_device(
+    sh, sds, "document", "view", "user", uid,
+    now_us=EPOCH, oracle_factory=lambda: oracle,
+)
+assert got == full, "routed-shard lookup diverged from single-chip"
+print("routed-shard parity: ok", file=sys.stderr)
+
+print(json.dumps({
+    "metric": "lookup_smoke", "value": len(out), "unit": "paged resources",
+    "vs_baseline": 1.0, "edges": int(snap.num_edges), "batch": pages,
+    "wall_s": round(time.time() - t0, 1),
+}))
+EOF
+
+echo "LOOKUP-SMOKE-OK"

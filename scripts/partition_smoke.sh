@@ -43,7 +43,12 @@ def raw_cols():
 
 
 # 1. bitwise parity of the partitioned fold/rc build vs the reference
-legacy = EngineConfig.for_schema(cs, flat_partition_build=False)
+# (flat_rev_index=False: the feed declines the reverse lookup index —
+# rv ownership is keyed by the subject hash, not the primary bucket —
+# so the reference builds without it too)
+legacy = EngineConfig.for_schema(
+    cs, flat_partition_build=False, flat_rev_index=False
+)
 ref_arrays, ref_meta, _f, _c = build_flat_arrays_sharded(
     snap, legacy, M, plan=eng.plan
 )

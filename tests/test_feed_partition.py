@@ -46,8 +46,14 @@ def _reference(cs, itn, raw, contexts, M):
     cfg = EngineConfig.for_schema(cs)
     # the reference is the PRE-PR build-full-then-stack path: with the
     # partition-first default both sides would share engine/partition.py
-    # and a shared bug would cancel out of the parity comparison
-    legacy = EngineConfig.for_schema(cs, flat_partition_build=False)
+    # and a shared bug would cancel out of the parity comparison.
+    # flat_rev_index=False: the feed declines the reverse lookup index
+    # (rv ownership is keyed by the subject hash, not the primary
+    # bucket the owned feed rows are keyed by), so the reference
+    # builds without it too
+    legacy = EngineConfig.for_schema(
+        cs, flat_partition_build=False, flat_rev_index=False
+    )
     built = build_flat_arrays_sharded(snap, legacy, M, plan=None)
     assert built is not None
     arrays, meta, _f, _c = built

@@ -60,8 +60,12 @@ def _build_both(rels, cs, M, **cfg_kw):
         **{k: v.copy() for k, v in raw.items()},
     )
     eng = DeviceEngine(cs, EngineConfig.for_schema(cs, **cfg_kw))
+    # flat_rev_index=False: the FEED cannot build the reverse lookup
+    # index (rv ownership is keyed by the subject hash, not the primary
+    # bucket a process's owned feed rows are keyed by — engine/rev.py),
+    # so the apples-to-apples reference builds without it too
     legacy = EngineConfig.for_schema(
-        cs, flat_partition_build=False, **cfg_kw
+        cs, flat_partition_build=False, flat_rev_index=False, **cfg_kw
     )
     built = build_flat_arrays_sharded(snap, legacy, M, plan=eng.plan)
     assert built is not None

@@ -394,11 +394,18 @@ def test_lookup_index_advances_through_lsm_chain(monkeypatch):
     accumulated overlay/tombstones — never by a full rebuild
     (engine/lookup.py lookup_index chain-advance; VERDICT r04 item 4)."""
     from gochugaru_tpu.engine import lookup as lookup_mod
+    from gochugaru_tpu.engine.plan import EngineConfig
     from gochugaru_tpu.store.delta import apply_delta
 
     rels, users, teams, orgs, repos = rbac_world()
     cs, engine, dsnap, oracle = world(RBAC, rels)
     snap = dsnap.snapshot
+    # this test pins the HOST walker's index-advance machinery — the
+    # serving path for layouts without the reverse-CSR index — so the
+    # device frontier path (which never touches the transposed index)
+    # is disabled for it
+    engine = DeviceEngine(cs, EngineConfig.for_schema(cs, flat_rev_index=False))
+    dsnap = engine.prepare(snap)
     # plant the base index the way the prepare-time prewarm does
     lookup_mod.lookup_index(snap, mark_used=False)
     assert getattr(snap, "_lookup_index", None) is not None
@@ -451,11 +458,16 @@ def test_stash_redeems_across_chain_hops(monkeypatch):
     (base stash first, then the new chain's carry) — never a full
     rebuild (store/delta.py _materialize_locked carry block)."""
     from gochugaru_tpu.engine import lookup as lookup_mod
+    from gochugaru_tpu.engine.plan import EngineConfig
     from gochugaru_tpu.store.delta import apply_delta
 
     rels, users, teams, orgs, repos = rbac_world()
     cs, engine, dsnap, oracle = world(RBAC, rels)
     snap = dsnap.snapshot
+    # walker-forced engine: this test pins the stash-redeem machinery of
+    # the transposed host index (see the chain-advance test above)
+    engine = DeviceEngine(cs, EngineConfig.for_schema(cs, flat_rev_index=False))
+    dsnap = engine.prepare(snap)
     lookup_mod.lookup_index(snap, mark_used=False)  # prewarm-style
     cur_rels = list(rels)
 

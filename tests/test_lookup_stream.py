@@ -1,0 +1,433 @@
+"""Cursor-paginated frontier lookups (engine/spmv.py + engine/lookup.py
+page APIs): exact resume semantics, revision pinning, fault-injection
+retry through the client envelope, and frontier-vs-walker parity —
+including the sharded owner-routed hop path.
+
+The cursor contract under test: a lookup's result stream is
+DETERMINISTIC per (snapshot revision, query), pages resume exactly (no
+duplicate and no lost IDs) whether the live stream is still cached or
+the resume deterministically recomputes, and a cursor never silently
+serves a different revision or query."""
+
+import numpy as np
+import pytest
+
+import test_lookup as tl
+from gochugaru_tpu import rel
+from gochugaru_tpu.engine import lookup as lm
+from gochugaru_tpu.engine import spmv
+from gochugaru_tpu.engine.device import DeviceEngine
+from gochugaru_tpu.engine.oracle import Oracle
+from gochugaru_tpu.engine.plan import EngineConfig
+from gochugaru_tpu.utils import faults
+from gochugaru_tpu.utils.errors import PreconditionFailedError
+
+NOW = tl.NOW
+
+
+def _paged_ids(engine, dsnap, oracle, uid, page_size, *, churn=False,
+               through_strings=False):
+    """Drain lookup_resources for ``uid`` via cursored pages; optionally
+    drop the continuation cache between pages (forcing the
+    recompute-and-skip path) or round-trip cursors through their string
+    encoding."""
+    out, pages, cursor = [], 0, None
+    while True:
+        if churn:
+            dsnap.__dict__.pop("_lookup_streams", None)
+        ids, cursor = lm.lookup_resources_page(
+            engine, dsnap, "repo", "read", "user", uid,
+            page_size=page_size, cursor=cursor, now_us=NOW,
+            oracle_factory=lambda: oracle,
+        )
+        out.extend(ids)
+        pages += 1
+        if through_strings and cursor is not None:
+            cursor = spmv.LookupCursor.decode(cursor.encode())
+        if cursor is None:
+            return out, pages
+
+
+@pytest.fixture(scope="module")
+def rbac():
+    rels, users, teams, orgs, repos = tl.rbac_world(
+        seed=7, n_users=24, n_repos=16
+    )
+    cs, engine, dsnap, oracle = tl.world(tl.RBAC, rels)
+    return cs, engine, dsnap, oracle, rels, users, repos
+
+
+def test_pagination_resumes_exactly_across_boundaries(rbac):
+    cs, engine, dsnap, oracle, rels, users, repos = rbac
+    assert spmv.frontier_ok(engine, dsnap)
+    for uid in [u.split(":")[1] for u in users[:6]]:
+        full = lm.lookup_resources_device(
+            engine, dsnap, "repo", "read", "user", uid,
+            now_us=NOW, oracle_factory=lambda: oracle,
+        )
+        for page_size in (1, 3):
+            got, pages = _paged_ids(engine, dsnap, oracle, uid, page_size,
+                                    through_strings=True)
+            assert len(got) == len(set(got)), "duplicate id across pages"
+            assert sorted(got) == full
+            if full:
+                assert pages >= len(full) // max(page_size, 1)
+
+
+def test_pagination_recompute_resume_is_exact(rbac):
+    """An evicted continuation (process restart, cache churn) resumes by
+    deterministic recompute-and-skip — same exact page stream."""
+    cs, engine, dsnap, oracle, rels, users, repos = rbac
+    # a subject with a multi-page answer, so a resume really happens
+    answers = {}
+    for u in users:
+        uid = u.split(":")[1]
+        answers[uid] = lm.lookup_resources_device(
+            engine, dsnap, "repo", "read", "user", uid,
+            now_us=NOW, oracle_factory=lambda: oracle,
+        )
+    uid = max(answers, key=lambda k: len(answers[k]))
+    full = answers[uid]
+    assert len(full) >= 3, "world must give someone a multi-page answer"
+    got, _ = _paged_ids(engine, dsnap, oracle, uid, 2, churn=True)
+    assert len(got) == len(set(got)) and sorted(got) == full
+    from gochugaru_tpu.utils.metrics import default as m
+
+    assert m.counter("lookup.stream_recomputes") > 0
+
+
+def _heavy_uid(engine, dsnap, oracle, users):
+    """A subject whose answer spans multiple 1-result pages."""
+    best, n = None, -1
+    for u in users:
+        uid = u.split(":")[1]
+        got = lm.lookup_resources_device(
+            engine, dsnap, "repo", "read", "user", uid,
+            now_us=NOW, oracle_factory=lambda: oracle,
+        )
+        if len(got) > n:
+            best, n = uid, len(got)
+    assert n >= 2, "world must give someone a multi-page answer"
+    return best
+
+
+def test_cursor_rejects_wrong_query_and_revision(rbac):
+    cs, engine, dsnap, oracle, rels, users, repos = rbac
+    uid = _heavy_uid(engine, dsnap, oracle, users)
+    ids, cursor = lm.lookup_resources_page(
+        engine, dsnap, "repo", "read", "user", uid,
+        page_size=1, now_us=NOW, oracle_factory=lambda: oracle,
+    )
+    assert cursor is not None
+    # different query, same cursor
+    with pytest.raises(PreconditionFailedError):
+        lm.lookup_resources_page(
+            engine, dsnap, "repo", "admin", "user", uid,
+            page_size=1, cursor=cursor, now_us=NOW,
+            oracle_factory=lambda: oracle,
+        )
+    # stale revision
+    bad = spmv.LookupCursor(cursor.revision + 1, cursor.token, cursor.pos)
+    with pytest.raises(PreconditionFailedError):
+        lm.lookup_resources_page(
+            engine, dsnap, "repo", "read", "user", uid,
+            page_size=1, cursor=bad, now_us=NOW,
+            oracle_factory=lambda: oracle,
+        )
+    # malformed encoding
+    with pytest.raises(PreconditionFailedError):
+        spmv.LookupCursor.decode("not-a-cursor")
+
+
+def test_cursor_revision_pinned_across_delta(rbac):
+    """A cursor taken at revision R keeps serving R's answer after the
+    store advances — the walker-backed page path covers the advanced
+    revision (delta chains decline the frontier), and the R-pinned
+    pagination completes with no dup/lost IDs."""
+    from gochugaru_tpu.store.delta import apply_delta
+
+    cs, engine, dsnap, oracle, rels, users, repos = rbac
+    uid = users[2].split(":")[1]
+    full_r1 = lm.lookup_resources_device(
+        engine, dsnap, "repo", "read", "user", uid,
+        now_us=NOW, oracle_factory=lambda: oracle,
+    )
+    got, cursor = lm.lookup_resources_page(
+        engine, dsnap, "repo", "read", "user", uid,
+        page_size=2, now_us=NOW, oracle_factory=lambda: oracle,
+    )
+    # the world advances: this user gains a direct reader edge
+    snap = dsnap.snapshot
+    adds = [rel.must_from_tuple(f"{repos[-1]}#reader", f"user:{uid}")]
+    snap2 = apply_delta(snap, 2, adds, [], interner=snap.interner)
+    ds2 = engine.prepare(snap2, prev=dsnap)
+    oracle2 = Oracle(cs, rels + adds, {}, now_us=NOW)
+    want2 = sorted(oracle2.lookup_resources("repo", "read", "user", uid, ""))
+    got2 = lm.lookup_resources_device(
+        engine, ds2, "repo", "read", "user", uid,
+        now_us=NOW, oracle_factory=lambda: oracle2,
+    )
+    assert got2 == want2 and got2 != full_r1
+    # ... while the pinned cursor still completes revision 1's answer
+    while cursor is not None:
+        ids, cursor = lm.lookup_resources_page(
+            engine, dsnap, "repo", "read", "user", uid,
+            page_size=2, cursor=cursor, now_us=NOW,
+            oracle_factory=lambda: oracle,
+        )
+        got.extend(ids)
+    assert len(got) == len(set(got)) and sorted(got) == full_r1
+
+
+def test_walker_backed_pages_on_delta_snapshots(rbac):
+    """Delta-prepared snapshots decline the frontier (their reverse
+    tables are at the base revision); the SAME page API serves them
+    through the walker with identical cursor semantics."""
+    from gochugaru_tpu.store.delta import apply_delta
+
+    cs, engine, dsnap, oracle, rels, users, repos = rbac
+    snap = dsnap.snapshot
+    uid = users[3].split(":")[1]
+    adds = [rel.must_from_tuple(f"{repos[0]}#reader", f"user:{uid}")]
+    snap2 = apply_delta(snap, 2, adds, [], interner=snap.interner)
+    ds2 = engine.prepare(snap2, prev=dsnap)
+    oracle2 = Oracle(cs, rels + adds, {}, now_us=NOW)
+    if ds2.flat_meta is not None and ds2.flat_meta.delta is not None:
+        assert not spmv.frontier_ok(engine, ds2)
+    out, cursor = [], None
+    while True:
+        ids, cursor = lm.lookup_resources_page(
+            engine, ds2, "repo", "read", "user", uid,
+            page_size=3, cursor=cursor, now_us=NOW,
+            oracle_factory=lambda: oracle2,
+        )
+        out.extend(ids)
+        if cursor is None:
+            break
+    want = sorted(oracle2.lookup_resources("repo", "read", "user", uid, ""))
+    assert sorted(out) == want and len(out) == len(set(out))
+
+
+def test_lookup_subjects_pages(rbac):
+    cs, engine, dsnap, oracle, rels, users, repos = rbac
+    rid = repos[0].split(":")[1]
+    full = lm.lookup_subjects_device(
+        engine, dsnap, "repo", rid, "read", "user",
+        now_us=NOW, oracle_factory=lambda: oracle,
+    )
+    out, cursor = [], None
+    while True:
+        ids, cursor = lm.lookup_subjects_page(
+            engine, dsnap, "repo", rid, "read", "user",
+            page_size=2, cursor=cursor, now_us=NOW,
+            oracle_factory=lambda: oracle,
+        )
+        out.extend(ids)
+        if cursor is None:
+            break
+    assert sorted(out) == full and len(out) == len(set(out))
+
+
+def test_frontier_equals_walker_paths(rbac):
+    """The device frontier engine and the host walker are two
+    implementations of one contract: identical answers on the same
+    snapshot (the walker is the parity oracle the bench enforces too)."""
+    cs, engine, dsnap, oracle, rels, users, repos = rbac
+    snap = dsnap.snapshot
+    walker_engine = DeviceEngine(
+        cs, EngineConfig.for_schema(cs, flat_rev_index=False)
+    )
+    wds = walker_engine.prepare(snap)
+    assert not spmv.frontier_ok(walker_engine, wds)
+    for u in users[:8]:
+        uid = u.split(":")[1]
+        got = lm.lookup_resources_device(
+            engine, dsnap, "repo", "read", "user", uid,
+            now_us=NOW, oracle_factory=lambda: oracle,
+        )
+        ref = lm.lookup_resources_device(
+            walker_engine, wds, "repo", "read", "user", uid,
+            now_us=NOW, oracle_factory=lambda: oracle,
+        )
+        assert got == ref
+
+
+def test_client_envelope_retries_lookup_dispatch_fault():
+    """An injected transient fault at the ``lookup.dispatch`` site
+    surfaces as UnavailableError and the client's lookup surface retries
+    it under the reference's backoff envelope — same contract as check
+    dispatch (utils/faults.py round-7 discipline)."""
+    from gochugaru_tpu import consistency, new_tpu_evaluator
+    from gochugaru_tpu.rel.txn import Txn
+    from gochugaru_tpu.utils import background
+    from gochugaru_tpu.utils.metrics import default as m
+
+    c = new_tpu_evaluator()
+    ctx = background()
+    c.write_schema(ctx, tl.RBAC)
+    rels, users, teams, orgs, repos = tl.rbac_world(
+        seed=3, n_users=10, n_repos=6
+    )
+    txn = Txn()
+    for r in rels:
+        txn.create(r)
+    rev = c.write(ctx, txn)
+    cs = consistency.at_least(rev)
+    base_retries = m.counter("retry.retries")
+    with faults.default.armed("lookup.dispatch", times=1) as spec:
+        got = sorted(c.lookup_resources(ctx, cs, "repo#read", users[0]))
+    assert spec.fired == 1
+    assert m.counter("retry.retries") >= base_retries + 1
+    snap = c.store.snapshot_for(cs)
+    oracle = c._oracle_for(snap)
+    stype, sid = users[0].split(":")
+    assert got == sorted(oracle.lookup_resources("repo", "read", stype, sid, ""))
+    # paged surface retries too
+    with faults.default.armed("lookup.dispatch", times=1) as spec:
+        page = c.lookup_resources_page(
+            ctx, cs, "repo#read", users[1], page_size=3
+        )
+    assert spec.fired == 1
+    out = list(page.ids)
+    while page.cursor is not None:
+        page = c.lookup_resources_page(
+            ctx, cs, "repo#read", users[1], page_size=3, cursor=page.cursor
+        )
+        out.extend(page.ids)
+    stype, sid = users[1].split(":")
+    assert sorted(out) == sorted(
+        oracle.lookup_resources("repo", "read", stype, sid, "")
+    )
+
+
+def test_sharded_routed_lookup_parity():
+    """The bucket-sharded stacked layout serves lookups through the
+    owner-routed hop path (parallel/sharded.py _ShardedLookupHops):
+    answers bitwise-match the single-chip frontier and the oracle, and
+    the hops actually run (no silent walker fallback)."""
+    from gochugaru_tpu.parallel import ShardedEngine, make_mesh
+    from gochugaru_tpu.utils.metrics import default as m
+
+    rels, users, teams, orgs, repos = tl.rbac_world(
+        seed=11, n_users=20, n_repos=12
+    )
+    cs, engine, dsnap, oracle = tl.world(tl.RBAC, rels)
+    snap = dsnap.snapshot
+    sh = ShardedEngine(cs, make_mesh(1, 4))
+    ds = sh.prepare(snap)
+    assert ds.flat_meta.sharded and ds.flat_meta.has_rev
+    assert spmv.frontier_ok(sh, ds)
+    base_hops = m.counter("lookup.hops")
+    base_walk = m.counter("lookups.walker")
+    for u in users[:5]:
+        uid = u.split(":")[1]
+        got = lm.lookup_resources_device(
+            sh, ds, "repo", "read", "user", uid,
+            now_us=NOW, oracle_factory=lambda: oracle,
+        )
+        ref = lm.lookup_resources_device(
+            engine, dsnap, "repo", "read", "user", uid,
+            now_us=NOW, oracle_factory=lambda: oracle,
+        )
+        assert got == ref
+    for r in repos[:3]:
+        rid = r.split(":")[1]
+        got = lm.lookup_subjects_device(
+            sh, ds, "repo", rid, "read", "user",
+            now_us=NOW, oracle_factory=lambda: oracle,
+        )
+        want = sorted(oracle.lookup_subjects("repo", rid, "read", "user", ""))
+        assert got == want
+    assert m.counter("lookup.hops") > base_hops
+    assert m.counter("lookups.walker") == base_walk
+
+
+def test_fuzz_pagination_matches_full_answer():
+    """Randomized caveat/wildcard/overflow worlds: pages concatenate to
+    the full sorted answer with no dup/lost IDs (frontier path)."""
+    import random
+
+    rng = random.Random(4)
+    users = [f"user:u{i}" for i in range(10)]
+    groups = [f"group:g{i}" for i in range(4)]
+    projs = [f"proj:p{i}" for i in range(6)]
+    rels = []
+    for g in groups:
+        for u in rng.sample(users, 3):
+            rels.append(rel.must_from_tuple(f"{g}#member", u))
+        if rng.random() < 0.5:
+            rels.append(
+                rel.must_from_tuple(f"{g}#member", f"{rng.choice(groups)}#member")
+            )
+    for p in projs:
+        rels.append(rel.must_from_tuple(f"{p}#owner", rng.choice(users)))
+        rels.append(
+            rel.must_from_tuple(f"{p}#owner", f"{rng.choice(groups)}#member")
+        )
+        for u in rng.sample(users, 2):
+            r = rel.must_from_tuple(f"{p}#writer", u)
+            if rng.random() < 0.4:
+                r = r.with_caveat("lim", {"v": rng.randint(0, 9), "cap": 5})
+            rels.append(r)
+    cs, engine, dsnap, oracle = tl.world(tl.FUZZ_SCHEMA, rels)
+    for u in users[:5]:
+        uid = u.split(":")[1]
+        full = lm.lookup_resources_device(
+            engine, dsnap, "proj", "write", "user", uid,
+            now_us=NOW, oracle_factory=lambda: oracle,
+        )
+        out, cursor = [], None
+        while True:
+            ids, cursor = lm.lookup_resources_page(
+                engine, dsnap, "proj", "write", "user", uid,
+                page_size=2, cursor=cursor, now_us=NOW,
+                oracle_factory=lambda: oracle,
+            )
+            out.extend(ids)
+            if cursor is None:
+                break
+        assert sorted(out) == full and len(out) == len(set(out))
+
+
+def test_cursor_pins_implicit_evaluation_time(rbac):
+    """A lookup with no explicit now_us resolves wall clock ONCE and
+    pins it in the cursor: a recompute-resume at a later wall clock
+    re-evaluates expiry gates at the SAME instant (the no-dup/no-loss
+    contract would otherwise break for expiring worlds), and an
+    explicit different now_us is a different query (token mismatch)."""
+    cs, engine, dsnap, oracle, rels, users, repos = rbac
+    uid = _heavy_uid(engine, dsnap, oracle, users)
+    ids, cursor = lm.lookup_resources_page(
+        engine, dsnap, "repo", "read", "user", uid,
+        page_size=1, oracle_factory=lambda: oracle,
+    )
+    assert cursor is not None and cursor.now_us is not None
+    pinned = cursor.now_us
+    # churn the continuation cache: the resume must recompute at the
+    # PINNED time, produce the same stream, and keep carrying it
+    out = list(ids)
+    while cursor is not None:
+        dsnap.__dict__.pop("_lookup_streams", None)
+        ids, cursor = lm.lookup_resources_page(
+            engine, dsnap, "repo", "read", "user", uid,
+            page_size=1, cursor=cursor, oracle_factory=lambda: oracle,
+        )
+        out.extend(ids)
+        if cursor is not None:
+            assert cursor.now_us == pinned
+    full = lm.lookup_resources_device(
+        engine, dsnap, "repo", "read", "user", uid,
+        now_us=pinned, oracle_factory=lambda: oracle,
+    )
+    assert sorted(out) == full and len(out) == len(set(out))
+    # an explicit, different evaluation time is a different query
+    _ids, c2 = lm.lookup_resources_page(
+        engine, dsnap, "repo", "read", "user", uid,
+        page_size=1, now_us=pinned, oracle_factory=lambda: oracle,
+    )
+    with pytest.raises(PreconditionFailedError):
+        lm.lookup_resources_page(
+            engine, dsnap, "repo", "read", "user", uid,
+            page_size=1, cursor=c2, now_us=pinned + 1_000_000,
+            oracle_factory=lambda: oracle,
+        )

@@ -411,3 +411,76 @@ def test_packed_sharded_and_routed_parity():
     assert np.array_equal(np.asarray(d1), np.asarray(d3))
     assert np.array_equal(np.asarray(p1), np.asarray(p3))
     assert np.array_equal(np.asarray(o1), np.asarray(o3))
+
+
+def test_fold_direct_offsets_pack_anchor_residual():
+    """The fold's DIRECT offset arrays (pfu_start/csr_start) pack under
+    the anchor+residual scheme like every bucket-offset array (the named
+    ROADMAP follow-on), with bitwise dispatch parity to the unpacked
+    oracle on a folded world.  The bench.py RBAC world folds its
+    permissions, so the direct views exist."""
+    import sys
+
+    sys.path.insert(0, ".")
+    from bench import build_world as bw
+
+    from gochugaru_tpu.engine.device import DeviceEngine
+    from gochugaru_tpu.engine.plan import EngineConfig
+
+    cs, snap, users, repos, slot = bw(n_repos=400, n_users=150)
+    eng_p = DeviceEngine(cs, EngineConfig.for_schema(cs, flat_packed=True))
+    eng_u = DeviceEngine(cs, EngineConfig.for_schema(cs, flat_packed=False))
+    ds_p, ds_u = eng_p.prepare(snap), eng_u.prepare(snap)
+    assert ds_p.flat_meta.fold_pairs and ds_p.flat_meta.pf_direct
+    assert ds_p.flat_meta.pf_s_direct
+    pko = dict(ds_p.flat_meta.packed_off)
+    assert "pfu_start" in pko and "csr_start" in pko
+    assert ds_p.arrays["pfu_start"].dtype == np.uint16
+    assert "pfu_start_a" in ds_p.arrays and "csr_start_a" in ds_p.arrays
+    assert ds_u.arrays["pfu_start"].dtype == np.int32
+    rng = np.random.default_rng(5)
+    B = 4096
+    q_res = rng.choice(repos, B).astype(np.int32)
+    q_perm = rng.choice(
+        np.asarray([slot["read"], slot["admin"]], np.int32), B
+    )
+    q_subj = rng.choice(users, B).astype(np.int32)
+    NOWUS = 1_700_000_000_000_000
+    dp, pp_, op = eng_p.check_columns(ds_p, q_res, q_perm, q_subj, now_us=NOWUS)
+    du, pu, ou = eng_u.check_columns(ds_u, q_res, q_perm, q_subj, now_us=NOWUS)
+    assert np.array_equal(dp, du) and np.array_equal(pp_, pu)
+    assert np.array_equal(op, ou)
+    assert 0 < int(dp.sum()) < B
+
+
+def test_tx_row_padding_trimmed():
+    """The T-join rows table rounds to a 4096-row quantum instead of
+    pow2 (up to 2x waste per ROADMAP) — and the slice-safety pad is
+    kept, so block probes stay in bounds."""
+    from gochugaru_tpu.engine.hash import build_hash, interleave_buckets
+
+    rng = np.random.default_rng(3)
+    cols = [rng.integers(0, 1 << 20, 9_000).astype(np.int32)] * 2
+    h = build_hash(cols)
+    pow2_tbl = interleave_buckets(h, cols)
+    trim_tbl = interleave_buckets(h, cols, quantum=4096)
+    assert pow2_tbl.shape[0] == 16_384
+    assert trim_tbl.shape[0] == 12_288  # ceil((9000+64)/4096)*4096
+    assert trim_tbl.shape[0] % 4096 == 0
+    assert np.array_equal(trim_tbl, pow2_tbl[: trim_tbl.shape[0]])
+    # the padded tail keeps the -1 fill blocks rely on
+    assert (trim_tbl[9_000:] == -1).all()
+
+    # integration: a T-bearing world's resident tx lands on the quantum
+    import sys
+
+    sys.path.insert(0, ".")
+    from bench import build_world as bw
+
+    from gochugaru_tpu.engine.device import DeviceEngine
+
+    cs, snap, users, repos, slot = bw(n_repos=400, n_users=150)
+    eng = DeviceEngine(cs)
+    ds = eng.prepare(snap)
+    if ds.flat_meta.has_tindex and "tx" in ds.arrays:
+        assert ds.arrays["tx"].shape[0] % 4096 == 0

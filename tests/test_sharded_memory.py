@@ -157,6 +157,10 @@ def test_partitioned_build_scratch_is_shard_local():
             eng = ShardedEngine(cs, make_mesh(2, M), cfg)
             dsnap = eng.prepare(snap)
         assert dsnap.flat_meta is not None and dsnap.flat_meta.sharded
+        # the reverse-CSR lookup index builds inside this prepare too —
+        # its partition-first sorts/gathers (engine/rev.py) are under
+        # the same tracker and the same E/M bound
+        assert dsnap.flat_meta.has_rev
         return calls
 
     calls = prepare_with(partition=True)
