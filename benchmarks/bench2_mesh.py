@@ -221,6 +221,20 @@ def main() -> int:
 
     c.check(ctx, consistency.full(),
             rel.must_from_triple("repo:r0", "read", "user:u0"))  # warm
+    # queue-depth sampling during the degraded phase: the gate's
+    # in-flight gauge is this path's queue, reported with the SAME
+    # column names the serving bench uses (bench9_serve.py), so the
+    # sharded and serving stories share a schema
+    depth_samples = []
+    stop_sampler = threading.Event()
+
+    def depth_sampler():
+        while not stop_sampler.is_set():
+            depth_samples.append(m.gauge("admission.inflight"))
+            time.sleep(0.002)
+
+    sampler_t = threading.Thread(target=depth_sampler, daemon=True)
+    sampler_t.start()
     t0 = time.perf_counter()
     ts = [threading.Thread(target=worker, args=(w,)) for w in range(WORKERS)]
     for t in ts:
@@ -228,8 +242,11 @@ def main() -> int:
     for t in ts:
         t.join()
     degraded_dt = time.perf_counter() - t0
+    stop_sampler.set()
+    sampler_t.join(timeout=1.0)
     faults.reset()
     snap_m = m.snapshot()
+    qd = np.asarray(depth_samples) if depth_samples else np.zeros(1)
 
     def delta(key):
         return snap_m.get(key, 0) - base.get(key, 0)
@@ -248,6 +265,8 @@ def main() -> int:
         **mesh_rates,
         degraded_rate=round(degraded_rate, 1),
         shed_rate=round(sheds / max(total_checks / DB, 1), 4),
+        queue_depth_p50=round(float(np.percentile(qd, 50)), 1),
+        queue_depth_max=int(qd.max()),
         retry_count=int(retries),
         faults_injected=int(injected),
         breaker_trips=int(delta("breaker.trips")),

@@ -1,0 +1,390 @@
+"""Open-loop serving bench: Poisson traffic through the continuous
+batcher vs the closed-loop pinned-tier rate.
+
+Every other bench hands the engine pre-formed batches (closed loop: the
+next batch waits for the last — the load adapts to the server, hiding
+queueing).  This one is OPEN loop, the honest serving methodology:
+submissions arrive on a Poisson process at a FIXED offered load whether
+or not the server keeps up, subjects are zipf-skewed, and each
+submission is a small CheckMany (the reference's request shape).  The
+micro-batch former (gochugaru_tpu/serve/) coalesces them onto the
+pinned tier ladder; we report goodput, shed rate, the batch-occupancy
+histogram, and queue+service p50/p99 per offered-load step — so the
+headline reads "N concurrent clients at p99 ≤ Y ms", not batch
+throughput.
+
+Honesty rules: the closed-loop denominator is measured in THIS process
+at the serving tier; latencies are per-submission submit→resolve times
+from the futures themselves (no waiting threads in the hot path);
+oracle parity is sampled on real coalesced answers; zero retraces is
+asserted from the latency.compiles counter across the whole sweep.
+
+One JSON line per load step ("serve_openloop_sweep") plus the headline
+("serve_openloop_goodput") at the highest load whose queue+service p99
+stays within 3x the quiet-window small-batch p99.
+"""
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EPOCH_US = 1_700_000_000_000_000
+
+
+def build_store_world(client, n_repos, n_users, n_orgs, edges, rng):
+    """GitHub-RBAC-shaped world imported columnarly through the client
+    (the serving handle needs a store-backed snapshot chain)."""
+    import numpy as np
+
+    from gochugaru_tpu.utils.context import background
+
+    ctx = background()
+    client.write_schema(ctx, """
+    definition user {}
+    definition org { relation admin: user  relation member: user }
+    definition repo {
+        relation org: org
+        relation reader: user
+        permission admin = org->admin
+        permission read = reader + admin + org->member
+    }
+    """)
+    ru = rng.integers(0, n_users, edges)
+    rr = rng.integers(0, n_repos, edges)
+    client.import_relationship_columns(
+        ctx, resource_type="repo",
+        resource_ids=[f"r{i}" for i in rr], resource_relation="reader",
+        subject_type="user", subject_ids=[f"u{i}" for i in ru],
+    )
+    client.import_relationship_columns(
+        ctx, resource_type="repo",
+        resource_ids=[f"r{i}" for i in range(n_repos)],
+        resource_relation="org", subject_type="org",
+        subject_ids=[f"o{i % n_orgs}" for i in range(n_repos)],
+    )
+    client.import_relationship_columns(
+        ctx, resource_type="org",
+        resource_ids=[f"o{i}" for i in range(n_orgs)],
+        resource_relation="admin", subject_type="user",
+        subject_ids=[f"u{i % n_users}" for i in range(n_orgs)],
+    )
+    mu = rng.integers(0, n_users, n_orgs * 4)
+    client.import_relationship_columns(
+        ctx, resource_type="org",
+        resource_ids=[f"o{i % n_orgs}" for i in range(n_orgs * 4)],
+        resource_relation="member", subject_type="user",
+        subject_ids=[f"u{i}" for i in mu],
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--edges", type=int, default=200_000)
+    ap.add_argument("--repos", type=int, default=20_000)
+    ap.add_argument("--users", type=int, default=5_000)
+    ap.add_argument("--seconds", type=float, default=4.0,
+                    help="measurement window per offered-load step")
+    ap.add_argument("--loads", default="0.5,0.8,0.9",
+                    help="offered load as fractions of the closed-loop rate")
+    ap.add_argument("--submit", type=int, default=64,
+                    help="checks per submission (CheckMany size)")
+    ap.add_argument("--clients", type=int, default=32,
+                    help="distinct fairness client ids in the arrival stream")
+    ap.add_argument("--zipf", type=float, default=1.2,
+                    help="zipf exponent for subject skew")
+    ap.add_argument("--oracle-samples", type=int, default=50,
+                    help="coalesced submissions re-checked on the host oracle")
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    if args.quick:
+        args.edges = min(args.edges, 50_000)
+        args.repos = min(args.repos, 5_000)
+        args.seconds = min(args.seconds, 2.0)
+
+    from benchmarks.common import (
+        NORTH_STAR_RATE,
+        emit,
+        maybe_force_cpu,
+        note,
+        small_batch_latency,
+    )
+
+    platform = maybe_force_cpu()
+    import numpy as np
+
+    from gochugaru_tpu import consistency
+    from gochugaru_tpu.client import new_tpu_evaluator, with_latency_mode
+    from gochugaru_tpu.serve import ServeConfig
+    from gochugaru_tpu.utils import metrics as _metrics
+    from gochugaru_tpu.utils.context import background
+    from gochugaru_tpu.utils.errors import ShedError
+
+    rng = np.random.default_rng(5)
+    c = new_tpu_evaluator(with_latency_mode())
+    t0 = time.perf_counter()
+    build_store_world(c, args.repos, args.users, 16, args.edges, rng)
+    cs = consistency.full()
+    ctx = background()
+    snap = c.store.snapshot_for(cs)
+    engine = c._engine_for(snap)
+    dsnap = c._dsnap_for(engine, snap)
+    note(f"world: edges={snap.num_edges} built in"
+         f" {time.perf_counter() - t0:.1f}s platform={platform}")
+
+    # -- interned query pools (zipf-skewed subjects) ---------------------
+    inter = snap.interner
+    slot = snap.compiled.slot_of_name
+    repo_ids = np.array(
+        [inter.node("repo", f"r{i}") for i in range(args.repos)], np.int32
+    )
+    user_ids = np.array(
+        [inter.node("user", f"u{i}") for i in range(args.users)], np.int32
+    )
+    POOL = 1 << 18
+    zipf_users = (rng.zipf(args.zipf, POOL) - 1) % args.users
+    pool_res = repo_ids[rng.integers(0, args.repos, POOL)]
+    pool_subj = user_ids[zipf_users]
+    pool_perm = np.where(
+        rng.random(POOL) < 0.9, slot["read"], slot["admin"]
+    ).astype(np.int32)
+
+    # -- closed-loop pinned-tier denominator + quiet-window p99 ----------
+    TIER = 1024
+    lp = engine.latency_path(dsnap)
+    q = (pool_res[:TIER], pool_perm[:TIER].copy(), pool_subj[:TIER])
+    q[1][:] = slot["read"]  # one slot set → one pinned kernel, like serving
+    for _ in range(5):
+        lp.dispatch_columns(*q, now_us=EPOCH_US)
+    reps = 60 if args.quick else 150
+    t0 = time.perf_counter()
+    for i in range(reps):
+        lp.dispatch_columns(
+            np.roll(q[0], i), q[1], np.roll(q[2], 2 * i), now_us=EPOCH_US
+        )
+    closed_rate = reps * TIER / (time.perf_counter() - t0)
+    quiet = small_batch_latency(
+        engine, dsnap, q[0], q[1], q[2], now_us=EPOCH_US,
+        warmup=10, reps=120 if args.quick else 300,
+    )
+    quiet_p99_ms = quiet["p99_ms"]
+    note(f"closed-loop tier-{TIER} rate {closed_rate:,.0f} checks/s;"
+         f" quiet-window p99 {quiet_p99_ms} ms")
+
+    # -- open-loop sweep -------------------------------------------------
+    m = _metrics.default
+    rows = []
+    handle = c.with_serving(cs=cs, config=ServeConfig(hold_max_s=0.001))
+    # warm the serving pool: pin every (slot-subset, tier) executable
+    # the sweep will form — a rapid-fire burst fills the TOP tiers, a
+    # paced trickle forms the small ones.  The zero-retrace assertion
+    # then covers the MEASURED window, the standard warm-serving
+    # discipline (same as every latency row's warmup)
+    def warm_burst(n, pace_s):
+        futs = []
+        for k in range(n):
+            s = int(rng.integers(0, POOL - args.submit))
+            while True:
+                try:
+                    futs.append(handle.submit_columns(
+                        ctx, pool_res[s:s + args.submit],
+                        pool_perm[s:s + args.submit],
+                        pool_subj[s:s + args.submit],
+                        client_id=k % args.clients,
+                    ))
+                    break
+                except ShedError:  # warm as fast as admission allows
+                    time.sleep(0.005)
+            if pace_s:
+                time.sleep(pace_s)
+        for f in futs:
+            f.result(timeout=60.0)
+
+    warm_burst(400, 0.0)   # saturates → full 4096-tier batches
+    warm_burst(48, 0.003)  # trickle → 256/1024-tier batches
+    compiles_sweep0 = m.counter("latency.compiles")
+    # serving GC discipline: collections pause every thread and land
+    # straight in the tail; collect between steps instead (the futures
+    # are acyclic — nothing leaks while disabled)
+    import gc
+
+    try:
+        for frac in [float(x) for x in args.loads.split(",")]:
+            offered = frac * closed_rate
+            sub_rate = offered / args.submit
+            n_subs = max(int(sub_rate * args.seconds), 16)
+            gaps = rng.exponential(1.0 / sub_rate, n_subs)
+            arrivals = np.cumsum(gaps)
+            starts = rng.integers(0, POOL - args.submit, n_subs)
+            client_ids = rng.integers(0, args.clients, n_subs)
+
+            base0 = m.snapshot()
+            futures = []
+            sheds = 0
+            depth_samples = []
+            stop_sampler = threading.Event()
+
+            def sampler():
+                while not stop_sampler.is_set():
+                    depth_samples.append(m.gauge("serve.queue_depth"))
+                    time.sleep(0.005)
+
+            st = threading.Thread(target=sampler, daemon=True)
+            st.start()
+            gc.collect()
+            gc.disable()
+            t_start = time.perf_counter()
+            for k in range(n_subs):
+                target = t_start + arrivals[k]
+                slack = target - time.perf_counter()
+                if slack > 0.0015:
+                    # coarse pacing: sleep off the bulk, let sub-ms
+                    # arrivals micro-burst (Poisson in aggregate) —
+                    # spinning per arrival would burn the core the
+                    # dispatcher needs
+                    time.sleep(slack - 0.001)
+                s = starts[k]
+                try:
+                    futures.append(handle.submit_columns(
+                        ctx,
+                        pool_res[s:s + args.submit],
+                        pool_perm[s:s + args.submit],
+                        pool_subj[s:s + args.submit],
+                        client_id=int(client_ids[k]),
+                    ))
+                except ShedError:  # open-loop counts sheds, not retries;
+                    sheds += 1     # any other failure must FAIL the row
+                    futures.append(None)
+            # drain
+            deadline = time.perf_counter() + 30.0
+            for f in futures:
+                if f is not None:
+                    f.result(timeout=max(deadline - time.perf_counter(), 0.1))
+            t_end = time.perf_counter()
+            gc.enable()
+            stop_sampler.set()
+            st.join(timeout=1.0)
+
+            lat_ms = np.array([
+                (f.t_done - f.t_submit) * 1000.0
+                for f in futures if f is not None
+            ])
+            snap_m = m.snapshot()
+
+            def delta(key):
+                return snap_m.get(key, 0) - base0.get(key, 0)
+
+            done_checks = delta("serve.checks")
+            elapsed = t_end - t_start
+            goodput = done_checks / elapsed
+            batches = max(delta("serve.batches"), 1)
+            occ_n = delta("serve.occupancy.count")
+            occ_mean = (
+                delta("serve.occupancy.sum") / occ_n if occ_n else 0.0
+            )
+            ds = np.asarray(depth_samples) if depth_samples else np.zeros(1)
+            row = dict(
+                load_frac=frac,
+                offered=round(offered, 1),
+                goodput=round(goodput, 1),
+                goodput_vs_closed=round(goodput / closed_rate, 4),
+                submissions=n_subs,
+                shed_rate=round(sheds / n_subs, 4),
+                p50_ms=round(float(np.percentile(lat_ms, 50)), 3),
+                p99_ms=round(float(np.percentile(lat_ms, 99)), 3),
+                batches=int(batches),
+                mean_batch=round(done_checks / batches, 1),
+                occupancy_mean=round(occ_mean, 4),
+                flush_full=int(delta("serve.flush_full")),
+                flush_deadline=int(delta("serve.flush_deadline")),
+                flush_maxhold=int(delta("serve.flush_maxhold")),
+                queue_depth_p50=round(float(np.percentile(ds, 50)), 1),
+                queue_depth_max=int(ds.max()),
+            )
+            rows.append(row)
+            note(
+                f"load {frac:.2f}: offered {offered:,.0f} → goodput"
+                f" {goodput:,.0f} checks/s ({goodput / closed_rate:.0%} of"
+                f" closed) p50 {row['p50_ms']}ms p99 {row['p99_ms']}ms"
+                f" shed {row['shed_rate']:.1%} mean batch"
+                f" {row['mean_batch']:.0f} depth_max {row['queue_depth_max']}"
+            )
+            emit(
+                "serve_openloop_sweep", row["goodput"], "checks/sec",
+                row["goodput"] / NORTH_STAR_RATE,
+                edges=int(snap.num_edges), batch=args.submit, **row,
+            )
+
+        retraces = int(m.counter("latency.compiles") - compiles_sweep0)
+
+        # -- oracle parity on sampled coalesced answers -------------------
+        oracle = c._oracle_for(snap)
+        ns = args.oracle_samples
+        oracle_match = True
+        si = rng.integers(0, POOL - 4, ns)
+        for s in si:
+            got = np.asarray(handle.check_columns(
+                ctx, pool_res[s:s + 4], pool_perm[s:s + 4],
+                pool_subj[s:s + 4],
+            ))
+            want = np.fromiter(
+                (c._check_interned(oracle, snap, pool_res[s + j],
+                                   pool_perm[s + j], pool_subj[s + j])
+                 for j in range(4)),
+                bool, count=4,
+            )
+            if not (got == want).all():
+                oracle_match = False
+                note(f"ORACLE MISMATCH at pool offset {s}")
+    finally:
+        handle.close()
+
+    # -- headline: the highest load whose p99 holds the 3x bar; when no
+    # row holds it (the 1-core CPU proxy shares the dispatch core with
+    # the submission front-end, so queueing starts well below the
+    # device's own capacity), the best sustained-goodput row with a
+    # sub-2% shed rate carries the headline and p99_bar_met says so
+    bar_ms = 3.0 * quiet_p99_ms
+    ok_rows = [r for r in rows if r["p99_ms"] <= bar_ms and
+               r["shed_rate"] < 0.01]
+    if ok_rows:
+        head = max(ok_rows, key=lambda r: r["goodput"])
+    else:
+        sustained = [r for r in rows if r["shed_rate"] < 0.02] or rows
+        head = max(sustained, key=lambda r: r["goodput"])
+    emit(
+        "serve_openloop_goodput", head["goodput"], "checks/sec",
+        head["goodput"] / NORTH_STAR_RATE,
+        edges=int(snap.num_edges), batch=args.submit,
+        closed_rate=round(closed_rate, 1),
+        goodput_vs_closed=head["goodput_vs_closed"],
+        load_frac=head["load_frac"],
+        p50_ms=head["p50_ms"], p99_ms=head["p99_ms"],
+        quiet_p99_ms=quiet_p99_ms,
+        p99_vs_quiet=round(head["p99_ms"] / max(quiet_p99_ms, 1e-9), 3),
+        p99_bar_met=bool(ok_rows),
+        shed_rate=head["shed_rate"],
+        clients=args.clients, zipf=args.zipf,
+        oracle_match=bool(oracle_match),
+        retraces=retraces,
+        queue_depth_p50=head["queue_depth_p50"],
+        queue_depth_max=head["queue_depth_max"],
+        platform=platform,
+        note=(
+            f"{args.clients} concurrent clients at p99 <="
+            f" {head['p99_ms']} ms: open-loop Poisson arrivals,"
+            f" zipf({args.zipf}) subjects, {args.submit}-check"
+            " submissions coalesced onto the pinned tier ladder"
+        ),
+    )
+    assert retraces == 0, f"{retraces} retraces across the sweep"
+    return 0
+
+
+if __name__ == "__main__":
+    from benchmarks.common import bench_main
+
+    bench_main(main)

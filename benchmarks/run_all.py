@@ -176,6 +176,17 @@ def main() -> int:
         ["bash", "scripts/lookup_smoke.sh"],
         600,
     ))
+    configs.append((
+        "13 — continuous batching: open-loop goodput/p99 @ offered load"
+        + (" (quick)" if q else ""),
+        [py, "benchmarks/bench9_serve.py"] + (["--quick"] if q else []),
+        900,
+    ))
+    configs.append((
+        "14 — serve smoke (concurrent submitters, oracle parity, shed path)",
+        ["bash", "scripts/serve_smoke.sh"],
+        600,
+    ))
     if not q:
         # Leopard-scale CPU proxy (VERDICT r04 item 3): the same Watch
         # re-index loop at a 100M-edge base — BASELINE config 5's

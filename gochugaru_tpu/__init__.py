@@ -21,6 +21,8 @@ Package layout (mirrors the reference's layer map, SURVEY.md §1):
 - ``store``        — interners, MVCC tuple log, columnar snapshots
 - ``engine``       — the evaluators: host oracle + JAX device engine
 - ``parallel``     — mesh/sharding helpers, multi-chip bulk check
+- ``serve``        — continuous-batching front-end (micro-batch former
+  over the pinned tier ladder; ``Client.with_serving``)
 - ``client``       — the ergonomic Client facade (reference ``client/``)
 - ``utils``        — context, retry/backoff, errors, metrics
 """

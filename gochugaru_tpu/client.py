@@ -46,7 +46,12 @@ from .engine.device import DeviceEngine, DeviceSnapshot
 from .engine.oracle import Oracle, SnapshotOracle, T, U
 from .engine.plan import EngineConfig
 from .rel.filter import Filter, PreconditionedFilter
-from .rel.relationship import Relationship, RelationshipLike, as_relationship
+from .rel.relationship import (
+    Relationship,
+    RelationshipLike,
+    as_relationship,
+    must_from_triple as rel_must_from_triple,
+)
 from .rel.strings import parse_object_set, parse_typed_relation
 from .rel.txn import Txn
 from .rel.update import Update, UpdateFilter
@@ -491,102 +496,255 @@ class Client:
         the thread-local current span so deep write-path work reached
         from here (incremental closure advance during a delta prepare)
         attaches its events to this request."""
-        adm = self._admission
         dsp = span.child("dispatch")
         with dsp:
             snap = self._store.snapshot_for(cs)
             dsp.set_attr("revision", int(snap.revision))
-            engine = self._engine_for(snap)
-            with self._metrics.timer("checks.dispatch"):
-                if engine is None:
-                    self._metrics.inc("checks.oracle", len(rels))
-                    with dsp.child("oracle.check", items=len(rels)):
-                        oracle = self._oracle_for(snap)
-                        return [
-                            oracle.check_relationship(r) == T for r in rels
-                        ]
-                dsnap = self._dsnap_for(engine, snap)
-                dsp.event("snapshot.prepared")
-                if self._profile_dir is not None:
-                    import jax
+            return self._evaluate_rels(
+                snap, rels, latency=self._latency_mode, span=dsp
+            )
 
-                    self._profile_lock.acquire()
-                    prof = jax.profiler.trace(self._profile_dir)
-                    unlock = self._profile_lock.release
-                else:
-                    prof = contextlib.nullcontext()
-                    unlock = lambda: None
-                # circuit breaker: after consecutive transient dispatch
-                # failures, latency-mode traffic reroutes onto the batch
-                # path until the breaker half-opens a probe
-                use_latency = self._latency_mode and adm.breaker.allow_latency()
-                if self._latency_mode and not use_latency:
-                    self._metrics.inc("breaker.latency_rerouted")
-                    dsp.event("breaker.latency_rerouted")
-                # a latency-mode call may silently fall back to the batch path
-                # (batch beyond the top tier, no flat tables, ...): the probe
-                # flag fed to the breaker must reflect whether the latency
-                # path actually SERVED, so read its dispatch counter around
-                # the call (per-snapshot counter; a concurrent same-snapshot
-                # dispatch can inflate it, which at worst closes the breaker
-                # on that other dispatch's success — still a latency success)
-                lp = dsnap.latency_path if use_latency else None
-                lp_n = lp.dispatch_count if lp is not None else 0
-                try:
-                    with prof, self._metrics.timer("checks.device_time_s"):
-                        d, p, ovf = engine.check_batch(
-                            dsnap, rels, latency=use_latency, span=dsp
-                        )
-                except Exception as e:  # classify device dispatch failures
-                    classified = classify_dispatch_exception(e)
-                    if isinstance(classified, UnavailableError):
-                        adm.breaker.record_failure()
-                        if classified is e:
-                            raise
-                        raise classified
-                    raise
-                else:
-                    lp2 = dsnap.latency_path
-                    served_latency = (
-                        use_latency
-                        and lp2 is not None
-                        and lp2.dispatch_count > lp_n
+    def _evaluate_rels(
+        self,
+        snap: Snapshot,
+        rels: List[Relationship],
+        *,
+        latency: bool,
+        span=_trace.NOOP,
+    ) -> List[bool]:
+        """Evaluate a formed batch at one snapshot: device dispatch with
+        classified failures feeding the circuit breaker, host-oracle
+        resolution of conditional/overflow items.  ``latency`` asks for
+        the pinned-tier path (the breaker may still reroute).  Shared by
+        the per-request path above and the serving batcher
+        (serve/batcher.py), so breaker semantics cannot drift between
+        caller-formed and coalesced batches."""
+        adm = self._admission
+        dsp = span
+        engine = self._engine_for(snap)
+        with self._metrics.timer("checks.dispatch"):
+            if engine is None:
+                self._metrics.inc("checks.oracle", len(rels))
+                with dsp.child("oracle.check", items=len(rels)):
+                    oracle = self._oracle_for(snap)
+                    return [
+                        oracle.check_relationship(r) == T for r in rels
+                    ]
+            dsnap = self._dsnap_for(engine, snap)
+            dsp.event("snapshot.prepared")
+            if self._profile_dir is not None:
+                import jax
+
+                self._profile_lock.acquire()
+                prof = jax.profiler.trace(self._profile_dir)
+                unlock = self._profile_lock.release
+            else:
+                prof = contextlib.nullcontext()
+                unlock = lambda: None
+            # circuit breaker: after consecutive transient dispatch
+            # failures, latency-mode traffic reroutes onto the batch
+            # path until the breaker half-opens a probe
+            use_latency = latency and adm.breaker.allow_latency()
+            if latency and not use_latency:
+                self._metrics.inc("breaker.latency_rerouted")
+                dsp.event("breaker.latency_rerouted")
+            # a latency-mode call may silently fall back to the batch path
+            # (batch beyond the top tier, no flat tables, ...): the probe
+            # flag fed to the breaker must reflect whether the latency
+            # path actually SERVED, so read its dispatch counter around
+            # the call (per-snapshot counter; a concurrent same-snapshot
+            # dispatch can inflate it, which at worst closes the breaker
+            # on that other dispatch's success — still a latency success)
+            lp = dsnap.latency_path if use_latency else None
+            lp_n = lp.dispatch_count if lp is not None else 0
+            try:
+                with prof, self._metrics.timer("checks.device_time_s"):
+                    d, p, ovf = engine.check_batch(
+                        dsnap, rels, latency=use_latency, span=dsp
                     )
-                    adm.breaker.record_success(probe=served_latency)
-                finally:
-                    unlock()
-                needs_host = (p & ~d) | ovf
-                if not needs_host.any():
-                    self._metrics.inc("checks.device_definite", len(rels))
-                    return [bool(x) for x in d]
-                osp = dsp.child(
-                    "oracle.fallback", items=int(needs_host.sum()),
-                    overflow=int(ovf.sum()),
+            except Exception as e:  # classify device dispatch failures
+                classified = classify_dispatch_exception(e)
+                if isinstance(classified, UnavailableError):
+                    adm.breaker.record_failure()
+                    if classified is e:
+                        raise
+                    raise classified
+                raise
+            else:
+                lp2 = dsnap.latency_path
+                served_latency = (
+                    use_latency
+                    and lp2 is not None
+                    and lp2.dispatch_count > lp_n
+                )
+                adm.breaker.record_success(probe=served_latency)
+            finally:
+                unlock()
+            needs_host = (p & ~d) | ovf
+            if not needs_host.any():
+                self._metrics.inc("checks.device_definite", len(rels))
+                return [bool(x) for x in d]
+            osp = dsp.child(
+                "oracle.fallback", items=int(needs_host.sum()),
+                overflow=int(ovf.sum()),
+            )
+            try:
+                oracle = self._oracle_for(snap)
+                out = []
+                for i, r in enumerate(rels):
+                    if needs_host[i]:
+                        self._metrics.inc(
+                            "checks.fallback_overflow"
+                            if ovf[i]
+                            else "checks.fallback_conditional"
+                        )
+                        try:
+                            out.append(oracle.check_relationship(r) == T)
+                        except Exception as e:
+                            # per-item error: abort with partial results,
+                            # mirroring the reference's bulk mapping loop
+                            # (client/client.go:279-283).  Not retriable —
+                            # the reference retries the RPC, not the
+                            # per-item mapping
+                            raise BulkCheckItemError(i, out, e) from e
+                    else:
+                        out.append(bool(d[i]))
+                return out
+            finally:
+                osp.end()
+
+    def _evaluate_columns(
+        self,
+        snap: Snapshot,
+        q_res: np.ndarray,
+        q_perm: np.ndarray,
+        q_subj: np.ndarray,
+        *,
+        latency: bool,
+        span=_trace.NOOP,
+    ) -> np.ndarray:
+        """The columnar mirror of ``_evaluate_rels`` for the serving
+        batcher: pre-interned int32 columns straight onto the pinned
+        tier ladder (breaker-gated, classified failures feed it), with
+        conditional/overflow items resolved on the host oracle by id
+        reconstruction.  Returns a bool verdict array of len(q_res)."""
+        adm = self._admission
+        B = int(q_res.shape[0])
+        engine = self._engine_for(snap)
+        if engine is None:
+            self._metrics.inc("checks.oracle", B)
+            oracle = self._oracle_for(snap)
+            return np.fromiter(
+                (
+                    self._check_interned(
+                        oracle, snap, q_res[i], q_perm[i], q_subj[i]
+                    )
+                    for i in range(B)
+                ),
+                bool, count=B,
+            )
+        dsnap = self._dsnap_for(engine, snap)
+        use_latency = latency and adm.breaker.allow_latency()
+        if latency and not use_latency:
+            self._metrics.inc("breaker.latency_rerouted")
+            span.event("breaker.latency_rerouted")
+        # deliberately NO with_profiling (jax.profiler.trace) wrapper
+        # here, unlike _evaluate_rels: the process allows one active
+        # profiler trace, so per-batch traces would serialize the
+        # serving dispatcher behind _profile_lock — profiler
+        # correlation for serving dispatches goes through the
+        # GOCHUGARU_TRACE_DIR annotation path (trace.annotate_dispatch)
+        lp = engine.latency_path(dsnap) if use_latency else None
+        lp_n = lp.dispatch_count if lp is not None else 0
+        try:
+            with self._metrics.timer("checks.device_time_s"):
+                out = None
+                if lp is not None:
+                    out = lp.dispatch_columns(q_res, q_perm, q_subj, span=span)
+                if out is None:
+                    out = engine.check_columns(dsnap, q_res, q_perm, q_subj)
+        except Exception as e:
+            classified = classify_dispatch_exception(e)
+            if isinstance(classified, UnavailableError):
+                adm.breaker.record_failure()
+                if classified is e:
+                    raise
+                raise classified
+            raise
+        else:
+            adm.breaker.record_success(
+                probe=lp is not None and lp.dispatch_count > lp_n
+            )
+        d, p, ovf = out
+        res = np.asarray(d, bool).copy()
+        needs_host = (p & ~d) | ovf
+        if needs_host.any():
+            oracle = self._oracle_for(snap)
+            idx = np.nonzero(needs_host)[0]
+            span.event("oracle.fallback", items=int(idx.shape[0]))
+            for i in idx:
+                self._metrics.inc(
+                    "checks.fallback_overflow" if ovf[i]
+                    else "checks.fallback_conditional"
                 )
                 try:
-                    oracle = self._oracle_for(snap)
-                    out = []
-                    for i, r in enumerate(rels):
-                        if needs_host[i]:
-                            self._metrics.inc(
-                                "checks.fallback_overflow"
-                                if ovf[i]
-                                else "checks.fallback_conditional"
-                            )
-                            try:
-                                out.append(oracle.check_relationship(r) == T)
-                            except Exception as e:
-                                # per-item error: abort with partial results,
-                                # mirroring the reference's bulk mapping loop
-                                # (client/client.go:279-283).  Not retriable —
-                                # the reference retries the RPC, not the
-                                # per-item mapping
-                                raise BulkCheckItemError(i, out, e) from e
-                        else:
-                            out.append(bool(d[i]))
-                    return out
-                finally:
-                    osp.end()
+                    res[i] = self._check_interned(
+                        oracle, snap, q_res[i], q_perm[i], q_subj[i]
+                    )
+                except Exception as e:
+                    # same per-item isolation as _evaluate_rels: idx is
+                    # ascending, so every item before i is fully
+                    # resolved (device-definite or already host-checked)
+                    # — the serving batcher slices this back onto the
+                    # co-batched submissions instead of failing them all
+                    raise BulkCheckItemError(int(i), res[:int(i)], e) from e
+        else:
+            self._metrics.inc("checks.device_definite", B)
+        return res
+
+    def _check_interned(
+        self, oracle: Oracle, snap: Snapshot, res_id, perm_slot, subj_id
+    ) -> bool:
+        """One host-oracle check from interned ids (the columnar path's
+        fallback): reconstruct the (resource, permission, subject)
+        triple through the snapshot's interner and slot names."""
+        rtype, rid = snap.interner.key_of(int(res_id))
+        stype, sid = snap.interner.key_of(int(subj_id))
+        perm = snap.compiled.name_of_slot[int(perm_slot)]
+        r = rel_must_from_triple(f"{rtype}:{rid}", perm, f"{stype}:{sid}")
+        return oracle.check_relationship(r) == T
+
+    # ------------------------------------------------------------------
+    # Continuous-batching serving front-end (serve/batcher.py)
+    # ------------------------------------------------------------------
+    def with_serving(
+        self, cs: Optional[Strategy] = None, config=None
+    ) -> "Any":
+        """Open a continuous-batching serving handle over this client:
+        an async micro-batch former that coalesces concurrent Check /
+        CheckMany submissions into the next pinned pow2 tier slot
+        (engine/latency.py ladder) under a deadline-aware hold-back,
+        with per-client fair admission and queue-depth shedding through
+        the admission controller's ``ShedError`` path.  The handle's
+        ``check(ctx, *rels)`` blocks on its coalesced result (the
+        retry envelope re-submits on transient faults); ``submit`` /
+        ``submit_columns`` return futures for open-loop callers
+        (benchmarks/bench9_serve.py).  Works over single-chip,
+        latency-mode, and ``with_mesh(partitioned=True)`` engines —
+        engines whose latency path declines a batch serve it on the
+        throughput path, same answers.
+
+        ``cs`` pins the handle's consistency strategy (default
+        ``min_latency()``): coalesced requests in one formed batch
+        evaluate at one snapshot, the same revision discipline the
+        reference's bulk RPCs have.  Close the handle (or use it as a
+        context manager) to drain and stop its threads."""
+        from .serve import ServingHandle
+
+        return ServingHandle(
+            self, cs if cs is not None else _consistency.min_latency(),
+            config,
+        )
 
     # ------------------------------------------------------------------
     # Reads (client/client.go:286-315)

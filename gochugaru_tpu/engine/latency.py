@@ -60,6 +60,17 @@ from ..utils import trace as _trace
 from .flat import QM_ROWS, fill_qm
 
 
+def tier_for(tiers, B: int) -> Optional[int]:
+    """Smallest tier in the ladder holding ``B``, or None (→ the
+    throughput path).  Shared by LatencyPath routing and the serving
+    micro-batch former (serve/batcher.py), so "which pinned shape would
+    this batch land on" has exactly one definition."""
+    for t in sorted(tiers):
+        if B <= t:
+            return int(t)
+    return None
+
+
 @dataclass
 class DispatchBudget:
     """Per-dispatch stage timings (seconds) of one latency-mode call."""
@@ -129,10 +140,7 @@ class LatencyPath:
     def tier_for(self, B: int) -> Optional[int]:
         """Smallest configured tier holding ``B``, or None (→ fall back
         to the throughput path)."""
-        for t in sorted(self.engine.config.latency_tiers):
-            if B <= t:
-                return int(t)
-        return None
+        return tier_for(self.engine.config.latency_tiers, B)
 
     # -- pinning ---------------------------------------------------------
     def _fingerprint(self) -> Tuple:

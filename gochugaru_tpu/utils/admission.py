@@ -52,6 +52,86 @@ CLOSED, HALF_OPEN, OPEN = 0, 1, 2
 _EWMA_ALPHA = 0.2
 
 
+class CostModel:
+    """The ONE expected-dispatch-cost estimate the deadline shed and the
+    serving batcher's hold-back share (serve/batcher.py).
+
+    The original scalar EWMA was tuned for caller-formed batches: one
+    number regardless of batch size.  A micro-batch former needs "what
+    will a tier-1024 dispatch cost" to decide whether holding a request
+    another 500 µs blows its deadline — so the model keeps one EWMA per
+    pow2 tier (seeded from the scalar estimate until the tier has its
+    own samples) on top of the overall scalar, and both consumers read
+    the SAME object: there is no second EWMA to drift.
+
+    ``decay()`` halves every estimate — the deadline shed's cold-start
+    escape hatch (see ``AdmissionController.check_deadline``)."""
+
+    def __init__(self, floor_s: float = 0.0) -> None:
+        self.floor_s = floor_s
+        self._lock = threading.Lock()
+        self._overall: Optional[float] = None
+        self._by_tier: dict = {}
+
+    def observe(self, seconds: float, tier: Optional[int] = None) -> None:
+        """Tier-less samples (caller-formed dispatches) feed the overall
+        scalar; tier-tagged samples (the batcher's coalesced dispatches)
+        feed ONLY their tier — a 4096-tier batch costing 10x a small
+        dispatch must not inflate the estimate the tier-less deadline
+        shed reads, or small deadline-bearing requests shed spuriously
+        whenever serving traffic runs hot."""
+        with self._lock:
+            if tier is None:
+                if self._overall is None:
+                    self._overall = seconds
+                else:
+                    self._overall += _EWMA_ALPHA * (seconds - self._overall)
+            else:
+                cur = self._by_tier.get(tier)
+                if cur is None:
+                    self._by_tier[tier] = seconds
+                else:
+                    self._by_tier[tier] = cur + _EWMA_ALPHA * (seconds - cur)
+
+    def expected_s(self, tier: Optional[int] = None) -> float:
+        """Expected dispatch seconds — the tier's own EWMA when it has
+        samples, else the overall estimate, else (tier-less with only
+        tiered samples) the CHEAPEST tier's estimate: a request not yet
+        assigned a tier could land on the cheapest one, so shedding
+        against anything costlier would over-shed.  Floored by
+        ``floor_s``."""
+        with self._lock:
+            est = None
+            if tier is not None:
+                est = self._by_tier.get(tier)
+            if est is None:
+                est = self._overall
+            if est is None and self._by_tier:
+                est = min(self._by_tier.values())
+        return max(self.floor_s, est or 0.0)
+
+    def has_samples(self) -> bool:
+        with self._lock:
+            return self._overall is not None or bool(self._by_tier)
+
+    def decay(self) -> None:
+        """Halve the estimate the TIER-LESS readout is built from —
+        learning happens on admitted dispatches only, so a one-off
+        cold-start outlier must not lock deadline-bearing traffic out
+        forever.  Only the channel the shed actually read decays: the
+        overall scalar when it has samples, else the cheapest tier (the
+        min-fallback ``expected_s(None)`` returns).  Accurate per-tier
+        estimates the serving hold-back relies on are NOT collateral —
+        repeated caller-formed sheds must not teach the batcher that a
+        4096-tier dispatch is free."""
+        with self._lock:
+            if self._overall is not None:
+                self._overall /= 2.0
+            elif self._by_tier:
+                k = min(self._by_tier, key=self._by_tier.get)
+                self._by_tier[k] /= 2.0
+
+
 @dataclass(frozen=True)
 class AdmissionConfig:
     """Tuning for the client's admission controller."""
@@ -221,24 +301,20 @@ class AdmissionController:
             registry=self._m,
             clock=clock,
         )
-        self._lock = threading.Lock()
-        #: client-local EWMA of dispatch cost (seconds); None until the
-        #: first sample so a fresh client never sheds on other clients'
-        #: history
-        self._cost_ewma: Optional[float] = None
+        #: the shared dispatch-cost model (per-tier EWMA + overall);
+        #: client-local — None samples until the first dispatch so a
+        #: fresh client never sheds on other clients' history.  The
+        #: serving batcher (serve/batcher.py) reads and feeds the SAME
+        #: object for its hold-back decisions — one cost model, two
+        #: consumers, no duplicated EWMA
+        self.cost = CostModel(self.config.deadline_floor_s)
 
     # -- deadline budget -------------------------------------------------
-    def expected_cost_s(self) -> float:
-        with self._lock:
-            ewma = self._cost_ewma
-        return max(self.config.deadline_floor_s, ewma or 0.0)
+    def expected_cost_s(self, tier: Optional[int] = None) -> float:
+        return self.cost.expected_s(tier)
 
-    def observe_cost(self, seconds: float) -> None:
-        with self._lock:
-            if self._cost_ewma is None:
-                self._cost_ewma = seconds
-            else:
-                self._cost_ewma += _EWMA_ALPHA * (seconds - self._cost_ewma)
+    def observe_cost(self, seconds: float, tier: Optional[int] = None) -> None:
+        self.cost.observe(seconds, tier)
 
     def check_deadline(self, ctx: Context, span=_trace.NOOP) -> None:
         """Shed a dispatch whose deadline cannot cover the expected cost
@@ -263,9 +339,7 @@ class AdmissionController:
         if remaining <= 0 or (est > 0.0 and remaining < est):
             if remaining > 0:
                 # the ESTIMATE caused this shed: decay it
-                with self._lock:
-                    if self._cost_ewma is not None:
-                        self._cost_ewma /= 2.0
+                self.cost.decay()
             self._m.inc("admission.deadline_sheds")
             span.event(
                 "admission.deadline_shed",

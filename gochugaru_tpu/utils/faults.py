@@ -50,6 +50,12 @@ Injection sites threaded through the tree (grep ``faults.fire``):
     sharded.dispatch         sharded query partition (parallel/sharded.py)
     sharded.collective       shard_map kernel launch (parallel/sharded.py)
     watch.stream             per-update watch delivery (client.py)
+    batcher.form             micro-batch formation (serve/batcher.py; a
+                             form fault leaves the queue INTACT — the
+                             former retries, zero requests lost)
+    batcher.dispatch         formed-batch dispatch (serve/batcher.py;
+                             classified onto the futures, so the
+                             submitters' retry envelopes re-submit)
 """
 
 from __future__ import annotations

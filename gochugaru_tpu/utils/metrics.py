@@ -65,6 +65,11 @@ class Metrics:
         #: cursor lives and dies with its ring, so the two cannot skew
         self._scursor: Dict[str, int] = defaultdict(int)
         self._gauges: Dict[str, float] = {}  # last-set values (breaker state)
+        #: fixed-bucket histograms: name → [ascending bucket uppers,
+        #: per-bucket counts (len+1, last = overflow), count, sum].
+        #: Buckets freeze at first observe — a histogram whose buckets
+        #: drift mid-run cannot be merged or compared
+        self._hists: Dict[str, list] = {}
 
     def inc(self, name: str, delta: float = 1.0) -> None:
         with self._lock:
@@ -101,6 +106,40 @@ class Metrics:
                 s[cur] = seconds
                 self._scursor[name] = (cur + 1) % self.SAMPLE_CAP
 
+    def observe_hist(
+        self, name: str, value: float, buckets: Tuple[float, ...]
+    ) -> None:
+        """Count ``value`` into a fixed-bucket histogram (bucket uppers
+        are inclusive, Prometheus ``le`` semantics; values past the last
+        bucket land in the +Inf overflow slot).  The serving batcher's
+        batch-occupancy distribution is the motivating consumer — a
+        p99 summary can't show bimodality (half the batches full, half
+        nearly empty averages to a lie), a histogram can."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                bs = tuple(sorted(float(b) for b in buckets))
+                h = self._hists[name] = [bs, [0] * (len(bs) + 1), 0, 0.0]
+            bs, counts = h[0], h[1]
+            i = len(bs)
+            for j, b in enumerate(bs):
+                if value <= b:
+                    i = j
+                    break
+            counts[i] += 1
+            h[2] += 1
+            h[3] += value
+
+    def hist_snapshot(self) -> Dict[str, Tuple[Tuple[float, ...], List[int], int, float]]:
+        """name → (bucket uppers, per-bucket counts incl. +Inf overflow,
+        total count, sum) — the telemetry exporter renders these as
+        Prometheus ``histogram`` series with cumulative ``le`` labels."""
+        with self._lock:
+            return {
+                k: (h[0], list(h[1]), h[2], h[3])
+                for k, h in self._hists.items()
+            }
+
     @contextmanager
     def timer(self, name: str):
         t0 = time.perf_counter()
@@ -135,6 +174,13 @@ class Metrics:
                 out[f"{k}.total_s"] = total
                 if n:
                     out[f"{k}.mean_s"] = total / n
+            for k, h in self._hists.items():
+                cum = 0
+                for b, c in zip(h[0], h[1]):
+                    cum += c
+                    out[f"{k}.le_{format(b, 'g')}"] = cum
+                out[f"{k}.count"] = h[2]
+                out[f"{k}.sum"] = h[3]
         for k, s in samples.items():
             # one sorted pass per timer, every published quantile off it;
             # sorting happens outside the lock the latency path's
@@ -172,6 +218,7 @@ class Metrics:
             self._samples.clear()
             self._scursor.clear()
             self._gauges.clear()
+            self._hists.clear()
 
 
 #: Process-global default registry.

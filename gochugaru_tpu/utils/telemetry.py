@@ -61,6 +61,7 @@ def render_prometheus(registry: Optional[_metrics.Metrics] = None) -> str:
     scraped p99 and the in-process p99 cannot disagree."""
     m = registry or _metrics.default
     counters, gauges, timers = m.typed_snapshot()
+    hists = m.hist_snapshot()
     lines = []
     for name in sorted(counters):
         pn = prom_name(name, "_total")
@@ -84,6 +85,17 @@ def render_prometheus(registry: Optional[_metrics.Metrics] = None) -> str:
                     f'{pn}{{quantile="{label}"}} '
                     f"{_fmt(_metrics.nearest_rank(samples, q))}"
                 )
+        lines.append(f"{pn}_count {n}")
+        lines.append(f"{pn}_sum {_fmt(total)}")
+    for name in sorted(hists):
+        buckets, counts, n, total = hists[name]
+        pn = prom_name(name)
+        lines.append(f"# TYPE {pn} histogram")
+        cum = 0
+        for b, c in zip(buckets, counts):
+            cum += c
+            lines.append(f'{pn}_bucket{{le="{format(b, "g")}"}} {cum}')
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {n}')
         lines.append(f"{pn}_count {n}")
         lines.append(f"{pn}_sum {_fmt(total)}")
     return "\n".join(lines) + "\n"
