@@ -104,6 +104,11 @@ def do_trace(trace_dir: str) -> None:
     # profiler capture — the TensorBoard timeline and the request view
     # join on `gochugaru:<trace_id>`
     tracer = _trace.configure(sample_rate=1.0, slow_threshold_s=None)
+    # flight recorder rides the harvest window: any anomaly inside it
+    # (breaker trip, pinned-path recompile) dumps an incident bundle
+    # under $GOCHUGARU_INCIDENT_DIR (tpu_watch.sh sets it and copies the
+    # bundles next to this capture)
+    _trace.install_recorder(_trace.FlightRecorder())
     spans = []
     with _trace.profiler_session(trace_dir), jax.profiler.trace(trace_dir):
         for _ in range(10):
@@ -120,6 +125,9 @@ def do_trace(trace_dir: str) -> None:
                 spans.append(sp.trace_id)
     jsonl_path = _os.path.join(trace_dir, "request_traces.jsonl")
     tracer.dump_jsonl(jsonl_path)
+    rec = _trace.recorder()
+    if rec is not None:
+        rec.flush()  # land any in-flight incident bundles before teardown
     _trace.disable()
     print(json.dumps({
         "metric": "tpu_profile_trace", "value": 1.0, "unit": "capture",

@@ -6,14 +6,19 @@ decides the platform once: if the default (TPU) backend is unusable,
 children run with GOCHUGARU_FORCE_CPU=1 and the report says so per row.
 
 Usage:  python benchmarks/run_all.py [--out BENCHMARKS.md] [--quick]
-                                     [--metrics]
+                                     [--metrics] [--compare]
+                                     [--compare-tolerance 0.10]
 
 ``--quick`` shrinks configs 3/4/5 (CI-sized smoke run); the committed
 BENCHMARKS.md should come from a full run.  ``--metrics`` asks every
 bench child to append its final ``metrics.snapshot()`` blob
 (GOCHUGARU_BENCH_METRICS=1 → common.maybe_emit_metrics_snapshot), which
 lands in a "Metrics snapshots" appendix — a regression row then ships
-WITH the counters that explain it.
+WITH the counters that explain it.  ``--compare`` runs
+scripts/bench_compare.py after the suite — newest committed BENCH_r*
+round vs. the previous one, direction-aware, one line per metric — and
+the suite exits nonzero when the trajectory regressed beyond the
+tolerance.
 """
 
 import argparse
@@ -90,6 +95,11 @@ def main() -> int:
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--metrics", action="store_true",
                     help="children append a final metrics.snapshot() blob")
+    ap.add_argument("--compare", action="store_true",
+                    help="run scripts/bench_compare.py after the suite and"
+                         " fail on a BENCH_r* trajectory regression")
+    ap.add_argument("--compare-tolerance", type=float, default=0.10,
+                    help="relative worsening tolerated by --compare")
     args = ap.parse_args()
 
     backend = probe_backend()
@@ -187,6 +197,11 @@ def main() -> int:
         ["bash", "scripts/serve_smoke.sh"],
         600,
     ))
+    configs.append((
+        "15 — SLO/incident smoke (breaker trip -> incident bundle + burn)",
+        ["bash", "scripts/slo_smoke.sh"],
+        600,
+    ))
     if not q:
         # Leopard-scale CPU proxy (VERDICT r04 item 3): the same Watch
         # re-index loop at a 100M-edge base — BASELINE config 5's
@@ -264,6 +279,18 @@ def main() -> int:
                 f.write(json.dumps(snap, indent=1, sort_keys=True))
                 f.write("\n```\n\n")
     print(f"wrote {args.out}", file=sys.stderr)
+    if args.compare:
+        # trajectory gate: the suite's verdict includes "did the
+        # committed round-over-round numbers regress"
+        r = subprocess.run(
+            [py, "scripts/bench_compare.py",
+             "--tolerance", str(args.compare_tolerance)],
+            cwd=ROOT,
+        )
+        if r.returncode != 0:
+            print("bench trajectory REGRESSED (see table above)",
+                  file=sys.stderr)
+            return r.returncode
     return 0
 
 

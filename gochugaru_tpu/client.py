@@ -114,6 +114,8 @@ class _Options:
         self.telemetry_host = "127.0.0.1"
         self.trace_sample_rate: Optional[float] = None
         self.trace_slow_ms: Optional[float] = 100.0
+        self.incident_dir: Optional[str] = None
+        self.slos = None  # None → utils/slo.default_slos(); () disables
 
 
 Option = Callable[[_Options], None]
@@ -220,26 +222,46 @@ def with_telemetry(
     host: str = "127.0.0.1",
     trace_sample_rate: Optional[float] = None,
     trace_slow_ms: Optional[float] = 100.0,
+    incident_dir: Optional[str] = None,
+    slos=None,
 ) -> Option:
     """Serve live telemetry from this client's process: a stdlib HTTP
-    daemon thread (utils/telemetry.py) with ``/metrics`` (Prometheus
-    text — counters, gauges, and every timer ring as p50/p90/p99/p999
-    quantiles), ``/traces`` (JSONL dump of sampled request traces), and
-    ``/healthz``.  ``port=0`` picks an ephemeral port; read it back from
-    ``client.telemetry.port``.
+    daemon thread (utils/telemetry.py) with ``/metrics`` (Prometheus or
+    OpenMetrics text — counters, gauges, every timer ring as p50/p90/
+    p99/p999 quantiles, histograms with trace-id exemplars), ``/traces``
+    (JSONL dump of sampled request traces), ``/slo`` (multi-window
+    burn-rate report, utils/slo.py), ``/debug/incidents`` (flight-
+    recorder bundles), and ``/healthz`` (readiness: breaker state,
+    in-flight admission, serve queue depth, SLO status).  ``port=0``
+    picks an ephemeral port; read it back from ``client.telemetry.port``.
+
+    This option also arms the anomaly-diagnosis loop with zero further
+    configuration: a process-global **flight recorder** (utils/trace.py)
+    retains the last N finished request traces at full fidelity
+    regardless of the sample rate, and an **SLO engine** evaluates burn
+    rates on a background cadence — an SLO burn, a breaker trip, a shed
+    spike, a pinned-path recompile, or a watch resume storm freezes the
+    ring and dumps an incident bundle.  ``incident_dir`` lands the
+    bundles on disk as JSONL (otherwise the last few stay in memory,
+    served at ``/debug/incidents``); ``slos`` overrides the stock
+    objectives (``utils/slo.default_slos``; pass ``()`` to disable the
+    engine).
 
     ``trace_sample_rate`` additionally installs the process-global
     request tracer (utils/trace.py) at that head-sampling rate with a
     ``trace_slow_ms`` keep-slow tail rule (None disables the tail
-    rule).  Left at None, whatever tracer the process already has (or
-    none) stays in force — telemetry export and trace capture compose
-    but don't require each other."""
+    rule).  Left at None, whatever tracer the process already has stays
+    in force — or, when none exists, a 0%-head-sample tracer is
+    installed so the flight recorder has traces to retain (``/traces``
+    then only exports slow-tail trees; raise the rate for full export)."""
 
     def opt(o: _Options) -> None:
         o.telemetry_port = port
         o.telemetry_host = host
         o.trace_sample_rate = trace_sample_rate
         o.trace_slow_ms = trace_slow_ms
+        o.incident_dir = incident_dir
+        o.slos = slos
 
     return opt
 
@@ -285,20 +307,79 @@ class Client:
         self._admission = AdmissionController(o.admission)
         #: telemetry endpoint (utils/telemetry.py), via with_telemetry()
         self.telemetry = None
+        #: flight recorder + SLO engine (armed by with_telemetry)
+        self.recorder = None
+        self.slo = None
         if o.telemetry_port is not None:
+            slow_s = (
+                None if o.trace_slow_ms is None else o.trace_slow_ms / 1000.0
+            )
             if o.trace_sample_rate is not None:
                 _trace.configure(
-                    sample_rate=o.trace_sample_rate,
-                    slow_threshold_s=(
-                        None if o.trace_slow_ms is None
-                        else o.trace_slow_ms / 1000.0
-                    ),
+                    sample_rate=o.trace_sample_rate, slow_threshold_s=slow_s
                 )
+            elif not _trace.enabled():
+                # the flight recorder needs a tracer to build span trees;
+                # a 0% head sample keeps /traces lean (slow-tail trees
+                # only) while the recorder retains everything
+                _trace.configure(sample_rate=0.0, slow_threshold_s=slow_s)
+            rec = _trace.recorder()
+            if rec is None:
+                rec = _trace.install_recorder(
+                    _trace.FlightRecorder(incident_dir=o.incident_dir)
+                )
+            elif o.incident_dir is not None:
+                # an explicit caller dir WINS over whatever the shared
+                # recorder inherited (env default, an earlier client) —
+                # silently keeping the old dir would strand this
+                # caller's own incident-dir polling
+                rec.incident_dir = o.incident_dir
+            self.recorder = rec
+            # incident bundles carry the admission state that explains
+            # shed/breaker behavior at the moment of the anomaly.  The
+            # recorder is process-shared, so each telemetry client
+            # registers its providers as an atomic GROUP on the current
+            # recorder — suffixed keys, so client B never clobbers
+            # client A's state, counted per recorder (a fresh recorder
+            # starts over) and capped so a client-per-job pattern can't
+            # grow the context or pin dead controllers without bound
+            rec.add_context_group(
+                {
+                    "cost_model": self._admission.cost.state,
+                    "admission": lambda adm=self._admission: {
+                        "inflight": adm.gate.inflight,
+                        "max_inflight": adm.gate.max_inflight,
+                        "breaker_state": adm.breaker.state,
+                    },
+                },
+                cap=self.TELEMETRY_CONTEXT_MAX,
+            )
+            from .utils import slo as _slo
+
+            if o.slos is not None and len(o.slos) == 0:
+                # explicit disable: an already-installed engine must
+                # actually STOP (install_engine closes it) — leaving it
+                # ticking behind an "/slo disabled" surface would keep
+                # firing slo.burn incidents nothing reports on
+                _slo.install_engine(None)
+            else:
+                # ONE engine per process (it writes shared slo.* gauges
+                # and arms shared timer thresholds): reuse the installed
+                # one unless this caller declares its own objectives, in
+                # which case the old engine is closed and replaced
+                eng = _slo.get_engine()
+                if eng is None or o.slos is not None:
+                    # install_engine closes any previous engine and
+                    # republishes the replacement's gauges
+                    eng = _slo.install_engine(
+                        _slo.SLOEngine(slos=o.slos, registry=self._metrics)
+                    )
+                self.slo = eng
             from .utils.telemetry import TelemetryServer
 
             self.telemetry = TelemetryServer(
                 port=o.telemetry_port, host=o.telemetry_host,
-                registry=self._metrics,
+                registry=self._metrics, slo=self.slo, recorder=rec,
             )
 
     # -- store access (shared by watch etc.) -----------------------------
@@ -337,6 +418,12 @@ class Client:
 
     #: prepared-snapshot / oracle cache capacity per client
     SNAPSHOT_CACHE_MAX = 4
+
+    #: max with_telemetry clients whose admission/cost-model state rides
+    #: incident bundles on one recorder (providers are never
+    #: unregistered — clients have no close — so registration is capped;
+    #: later clients serve telemetry but skip bundle context)
+    TELEMETRY_CONTEXT_MAX = 8
 
     @staticmethod
     def _lru_get(cache: Dict[int, Any], key: int):
@@ -817,6 +904,11 @@ class Client:
     #: surfaces the UnavailableError to its consumer — bounded so a
     #: permanently-faulted stream classifies instead of spinning forever
     WATCH_MAX_RESUMES = 64
+    #: consecutive no-progress resumes that count as a resume STORM —
+    #: fires a flight-recorder incident (utils/trace.py) well before the
+    #: stream gives up at WATCH_MAX_RESUMES, so the bundle captures the
+    #: storm in progress
+    WATCH_STORM_RESUMES = 8
 
     def updates_since_revision(
         self, ctx: Context, f: UpdateFilter, revision: str
@@ -897,6 +989,17 @@ class Client:
                             cursor_offset=part_n,
                         )
                         no_progress += 1
+                        if no_progress == self.WATCH_STORM_RESUMES:
+                            # a resume is routine; EIGHT consecutive
+                            # no-progress resumes is a storm — freeze the
+                            # flight ring while the faulting stream's
+                            # spans are still in it (fires once per
+                            # storm: the counter resets on progress)
+                            _trace.trigger_incident(
+                                "watch.resume_storm",
+                                no_progress=no_progress,
+                                cursor_rev=int(base),
+                            )
                         if no_progress > self.WATCH_MAX_RESUMES:
                             raise
                         # brief context-aware pause, then re-subscribe

@@ -126,6 +126,13 @@ class LatencyPath:
         self.dispatch_count = 0
         #: number of pinned-executable entries (incl. engine-cache hits)
         self.pin_count = 0
+        #: (slots, tier, qctx_key) combos this path has SERVED warm — a
+        #: fresh compile for a key already here means a pinned executable
+        #: was lost (cache eviction, engine churn) and the "no retrace by
+        #: construction" invariant is being paid for at serving time:
+        #: fire a flight-recorder incident so the recompile is diagnosed
+        #: from the traces around it, not discovered in a p99 regression
+        self._served_keys: set = set()
         self.last_budget: Optional[DispatchBudget] = None
         self._shape_fp: Optional[Tuple] = None
         #: (clock value, device scalar) — the snapshot-relative clock has
@@ -319,6 +326,15 @@ class LatencyPath:
         # ---- stage 3: pinned kernel (blocked) --------------------------
         args = (self.dsnap.arrays, self.dsnap.tid_map, now_dev, qm_dev, qctx_dev)
         fn, fresh = self._pinned_for(slots, tier, qctx_key, args)
+        pin_key = (slots, tier, qctx_key)
+        if fresh and pin_key in self._served_keys:
+            # retrace detection: this exact shape was served warm before,
+            # so the compile we just paid means its pin was evicted —
+            # a silent tail regression in the making.  Counted + incident
+            self._m.inc("latency.retraces")
+            _trace.trigger_incident(
+                "latency.retrace", tier=tier, batch=B, slots=len(slots),
+            )
         # profiler correlation: inside a GOCHUGARU_TRACE_DIR session the
         # kernel window is annotated with the request's trace id, so the
         # harvested device trace attributes back to this dispatch
@@ -339,6 +355,8 @@ class LatencyPath:
         )
         self.last_budget = budget
         self.dispatch_count += 1
+        if len(self._served_keys) < 4096:  # qctx-shape churn backstop
+            self._served_keys.add(pin_key)
         m = self._m
         m.inc("latency.dispatches")
         if not fresh:

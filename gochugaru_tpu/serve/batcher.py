@@ -193,6 +193,15 @@ _FLUSH_DEADLINE = "deadline"
 _FLUSH_MAXHOLD = "maxhold"
 _FLUSH_DRAIN = "drain"
 
+#: ``serve.request_latency`` histogram uppers (seconds, submit→resolve).
+#: The serve.request_s timer ring gives sliding-window quantiles; the
+#: histogram gives the bucket-resolved tail — cumulative, mergeable, and
+#: (through the exporter's OpenMetrics exemplars) each bucket links to
+#: the last dispatch trace that landed in it
+REQUEST_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+)
+
 
 class MicroBatcher:
     """The former/dispatcher pair.  Dispatch is injected so the batcher
@@ -314,38 +323,56 @@ class MicroBatcher:
             # the SAME cost model + counters as the caller-formed path
             if self._adm is not None:
                 self._adm.check_deadline(ctx, span=span)
+        shed_depth = None
         with self._cond:
             if self._closed:
                 raise UnavailableError("serving handle is closed")
             if self._depth + n > self.config.queue_max:
                 self._m.inc("serve.sheds")
-                span.event(
-                    "serve.shed", depth=self._depth, submitting=n,
-                    queue_max=self.config.queue_max,
+                shed_depth = self._depth
+            else:
+                sub = _Submission(
+                    client_id, kind, rels, cols, n, deadline, fut
                 )
-                raise ShedError(
-                    f"serve queue depth {self._depth} + {n} >"
-                    f" queue_max {self.config.queue_max}"
-                )
-            sub = _Submission(client_id, kind, rels, cols, n, deadline, fut)
-            was_empty = self._depth == 0
-            q = self._queues.get(client_id)
-            if q is None:
-                q = self._queues[client_id] = deque()
-            q.append(sub)
-            self._depth += n
-            self._m.set_gauge("serve.queue_depth", self._depth)
-            if deadline is not None:
-                self._dl_seq += 1
-                heapq.heappush(self._dl_heap, (deadline, self._dl_seq, sub))
-            # wake the former only when this submission can CHANGE its
-            # decision: first work after idle, a full target tier, or a
-            # new deadline that may tighten the hold-back.  Every other
-            # submission rides the former's own timed wait — at tens of
-            # thousands of submissions/s, notify-per-submit is the
-            # front-end's biggest avoidable cost
-            if was_empty or deadline is not None or self._depth >= self._top:
-                self._cond.notify_all()
+                was_empty = self._depth == 0
+                q = self._queues.get(client_id)
+                if q is None:
+                    q = self._queues[client_id] = deque()
+                q.append(sub)
+                self._depth += n
+                self._m.set_gauge("serve.queue_depth", self._depth)
+                if deadline is not None:
+                    self._dl_seq += 1
+                    heapq.heappush(
+                        self._dl_heap, (deadline, self._dl_seq, sub)
+                    )
+                # wake the former only when this submission can CHANGE
+                # its decision: first work after idle, a full target
+                # tier, or a new deadline that may tighten the
+                # hold-back.  Every other submission rides the former's
+                # own timed wait — at tens of thousands of
+                # submissions/s, notify-per-submit is the front-end's
+                # biggest avoidable cost
+                if (
+                    was_empty or deadline is not None
+                    or self._depth >= self._top
+                ):
+                    self._cond.notify_all()
+        if shed_depth is not None:
+            # shed bookkeeping OUTSIDE the condition lock: the spike-
+            # threshold-crossing note() spawns an incident capture
+            # thread, and that spawn must not serialize submitters and
+            # the former/dispatcher loops on the hottest lock at peak
+            # load (same hoist as the admission gate's shed path)
+            _trace.note_anomaly("shed")
+            span.event(
+                "serve.shed", depth=shed_depth, submitting=n,
+                queue_max=self.config.queue_max,
+            )
+            raise ShedError(
+                f"serve queue depth {shed_depth} + {n} >"
+                f" queue_max {self.config.queue_max}"
+            )
         return fut
 
     # -- formation -------------------------------------------------------
@@ -599,10 +626,20 @@ class MicroBatcher:
             )
             m.observe("serve.dispatch_s", dt)
             t1 = time.perf_counter()
+            # exemplar: the batch's dispatch trace id, so a fat latency
+            # bucket on /metrics links straight to a recorded trace
+            # (flight-only spans carry ids too — the recorder retains
+            # them even when the head sample dropped the trace)
+            tid = sp.trace_id if sp.sampled else None
             off = 0
             for s in batch.subs:
                 s.future._resolve(verdicts[off:off + s.n], t1)
-                m.observe("serve.request_s", t1 - s.future.t_submit)
+                lat = t1 - s.future.t_submit
+                m.observe("serve.request_s", lat)
+                m.observe_hist(
+                    "serve.request_latency", lat,
+                    REQUEST_LATENCY_BUCKETS, trace_id=tid,
+                )
                 off += s.n
             m.inc("serve.batches")
             m.inc("serve.checks", batch.total)

@@ -114,6 +114,17 @@ class CostModel:
         with self._lock:
             return self._overall is not None or bool(self._by_tier)
 
+    def state(self) -> dict:
+        """Introspection snapshot — dumped into flight-recorder incident
+        bundles (utils/trace.py) so "what did the system THINK a dispatch
+        cost when it tripped" is part of the diagnosis record."""
+        with self._lock:
+            return {
+                "floor_s": self.floor_s,
+                "overall_s": self._overall,
+                "by_tier_s": dict(sorted(self._by_tier.items())),
+            }
+
     def decay(self) -> None:
         """Halve the estimate the TIER-LESS readout is built from —
         learning happens on admitted dispatches only, so a one-off
@@ -173,21 +184,34 @@ class DispatchGate:
     @contextmanager
     def admit(self, span=_trace.NOOP):
         if self.max_inflight > 0:
+            shed_at = None
             with self._lock:
                 if self._inflight >= self.max_inflight:
                     self._m.inc("admission.sheds")
-                    span.event(
-                        "admission.shed",
-                        error="ShedError", inflight=self._inflight,
-                    )
-                    span.set_attr("shed_error", "ShedError")
-                    raise ShedError(
-                        f"dispatch admission: {self._inflight} in-flight"
-                        f" >= max_inflight {self.max_inflight}"
-                    )
-                self._inflight += 1
-                self._m.set_gauge("admission.inflight", self._inflight)
-                span.event("admission.admit", inflight=self._inflight)
+                    shed_at = self._inflight
+                else:
+                    self._inflight += 1
+                    inflight = self._inflight
+                    self._m.set_gauge("admission.inflight", inflight)
+            if shed_at is not None:
+                # everything below runs OUTSIDE the gate lock: a shed
+                # burst crossing the spike threshold spawns an incident
+                # capture thread, and that spawn must not serialize the
+                # admits/releases the gate exists to keep moving (the
+                # same hoist the breaker's trip trigger does)
+                span.event(
+                    "admission.shed", error="ShedError", inflight=shed_at
+                )
+                span.set_attr("shed_error", "ShedError")
+                # one shed is overload working as designed; a BURST of
+                # sheds is an incident — the flight recorder's spike
+                # detector decides which this is
+                _trace.note_anomaly("shed")
+                raise ShedError(
+                    f"dispatch admission: {shed_at} in-flight"
+                    f" >= max_inflight {self.max_inflight}"
+                )
+            span.event("admission.admit", inflight=inflight)
         else:
             span.event("admission.admit", inflight=-1)
         try:
@@ -264,14 +288,17 @@ class CircuitBreaker:
         permanent errors say nothing about path health)."""
         if self.threshold <= 0:
             return
+        tripped = False
         with self._lock:
             self._consecutive_failures += 1
+            consecutive = self._consecutive_failures
             if self._state == HALF_OPEN:
                 # failed probe: straight back to OPEN, fresh cooldown
                 self._state = OPEN
                 self._opened_at = self._clock()
                 self._m.inc("breaker.trips")
                 self._m.set_gauge("breaker.state", OPEN)
+                tripped = True
             elif (
                 self._state == CLOSED
                 and self._consecutive_failures >= self.threshold
@@ -280,6 +307,16 @@ class CircuitBreaker:
                 self._opened_at = self._clock()
                 self._m.inc("breaker.trips")
                 self._m.set_gauge("breaker.state", OPEN)
+                tripped = True
+        if tripped:
+            # flight-recorder trigger OUTSIDE the lock (the capture
+            # thread spawn must not serialize other dispatch outcomes):
+            # a breaker trip freezes the last N request traces — the
+            # consecutive failures that tripped it are in the ring
+            _trace.trigger_incident(
+                "breaker.trip", consecutive=consecutive,
+                threshold=self.threshold,
+            )
 
 
 class AdmissionController:
@@ -341,6 +378,7 @@ class AdmissionController:
                 # the ESTIMATE caused this shed: decay it
                 self.cost.decay()
             self._m.inc("admission.deadline_sheds")
+            _trace.note_anomaly("shed")
             span.event(
                 "admission.deadline_shed",
                 remaining_s=round(max(remaining, 0.0), 6),

@@ -66,10 +66,18 @@ class Metrics:
         self._scursor: Dict[str, int] = defaultdict(int)
         self._gauges: Dict[str, float] = {}  # last-set values (breaker state)
         #: fixed-bucket histograms: name → [ascending bucket uppers,
-        #: per-bucket counts (len+1, last = overflow), count, sum].
-        #: Buckets freeze at first observe — a histogram whose buckets
-        #: drift mid-run cannot be merged or compared
+        #: per-bucket counts (len+1, last = overflow), count, sum,
+        #: per-bucket exemplars (len+1, last trace that landed in the
+        #: bucket, or None)].  Buckets freeze at first observe — a
+        #: histogram whose buckets drift mid-run cannot be merged or
+        #: compared
         self._hists: Dict[str, list] = {}
+        #: per-timer over-objective thresholds (utils/slo.py): observe()
+        #: counts samples above the threshold into ``_over`` so an SLO
+        #: burn rate is computed from EXACT per-window counts, not a
+        #: quantile estimate over an unstamped ring
+        self._thr: Dict[str, float] = {}
+        self._over: Dict[str, int] = defaultdict(int)
 
     def inc(self, name: str, delta: float = 1.0) -> None:
         with self._lock:
@@ -105,21 +113,55 @@ class Metrics:
                 cur = self._scursor[name]
                 s[cur] = seconds
                 self._scursor[name] = (cur + 1) % self.SAMPLE_CAP
+            thr = self._thr.get(name)
+            if thr is not None and seconds > thr:
+                self._over[name] += 1
+
+    def set_timer_threshold(self, name: str, seconds: Optional[float]) -> None:
+        """Arm (or with ``None`` disarm) over-objective counting for a
+        timer: every ``observe(name, s)`` with ``s > seconds`` also bumps
+        the timer's over-counter.  The SLO engine (utils/slo.py) reads
+        (count, over) pairs per tick, so a latency burn rate is exact —
+        "of the N requests observed this window, M blew the objective" —
+        instead of estimated from the sample ring."""
+        with self._lock:
+            if seconds is None:
+                self._thr.pop(name, None)
+            else:
+                self._thr[name] = float(seconds)
+
+    def timer_counts(self, name: str) -> Tuple[int, int]:
+        """(total observations, over-threshold observations) for a timer
+        — both cumulative, both monotone, the SLO engine's raw feed."""
+        with self._lock:
+            return self._timings[name][0] if name in self._timings else 0, \
+                self._over.get(name, 0)
 
     def observe_hist(
-        self, name: str, value: float, buckets: Tuple[float, ...]
+        self,
+        name: str,
+        value: float,
+        buckets: Tuple[float, ...],
+        trace_id: Optional[str] = None,
     ) -> None:
         """Count ``value`` into a fixed-bucket histogram (bucket uppers
         are inclusive, Prometheus ``le`` semantics; values past the last
         bucket land in the +Inf overflow slot).  The serving batcher's
         batch-occupancy distribution is the motivating consumer — a
         p99 summary can't show bimodality (half the batches full, half
-        nearly empty averages to a lie), a histogram can."""
+        nearly empty averages to a lie), a histogram can.
+
+        ``trace_id`` records an EXEMPLAR: the last trace that landed in
+        the bucket, rendered by the telemetry exporter as an OpenMetrics
+        exemplar — so a fat tail bucket links directly to a recorded
+        trace instead of to a guess."""
         with self._lock:
             h = self._hists.get(name)
             if h is None:
                 bs = tuple(sorted(float(b) for b in buckets))
-                h = self._hists[name] = [bs, [0] * (len(bs) + 1), 0, 0.0]
+                h = self._hists[name] = [
+                    bs, [0] * (len(bs) + 1), 0, 0.0, [None] * (len(bs) + 1)
+                ]
             bs, counts = h[0], h[1]
             i = len(bs)
             for j, b in enumerate(bs):
@@ -129,14 +171,20 @@ class Metrics:
             counts[i] += 1
             h[2] += 1
             h[3] += value
+            if trace_id is not None:
+                h[4][i] = (trace_id, float(value), time.time())
 
-    def hist_snapshot(self) -> Dict[str, Tuple[Tuple[float, ...], List[int], int, float]]:
+    def hist_snapshot(
+        self,
+    ) -> Dict[str, Tuple[Tuple[float, ...], List[int], int, float, list]]:
         """name → (bucket uppers, per-bucket counts incl. +Inf overflow,
-        total count, sum) — the telemetry exporter renders these as
-        Prometheus ``histogram`` series with cumulative ``le`` labels."""
+        total count, sum, per-bucket exemplars) — the telemetry exporter
+        renders these as Prometheus ``histogram`` series with cumulative
+        ``le`` labels (exemplars attach in OpenMetrics mode).  Each
+        exemplar is (trace_id, observed value, unix seconds) or None."""
         with self._lock:
             return {
-                k: (h[0], list(h[1]), h[2], h[3])
+                k: (h[0], list(h[1]), h[2], h[3], list(h[4]))
                 for k, h in self._hists.items()
             }
 
@@ -219,6 +267,9 @@ class Metrics:
             self._scursor.clear()
             self._gauges.clear()
             self._hists.clear()
+            # thresholds are CONFIG (armed by the SLO engine) and survive
+            # a reset; the over-counters are data and do not
+            self._over.clear()
 
 
 #: Process-global default registry.

@@ -1,0 +1,241 @@
+#!/usr/bin/env python
+"""Bench-trajectory regression guard: compare the newest BENCH_r*.json
+round against the previous one per metric name.
+
+The BENCH_r<NN>.json files are the committed per-round driver captures
+(config-2 bench.py child): ``tail`` holds the child's raw stdout —
+including every ``{"metric": ...}`` JSON line — and ``parsed`` the last
+metric line.  Nothing guarded that trajectory against silent perf
+regressions: a round could land 30% slower and nobody would notice until
+a human re-read the table.  This script makes the comparison mechanical:
+
+- extract every metric line from each round (plus the headline's
+  ``true_rate``/``p99_ms`` companions as ``<metric>.true_rate`` /
+  ``<metric>.p99_ms`` — the honest numbers ride as extra fields);
+- compare the newest round with metrics against the previous such round,
+  direction-aware (units/suffixes decide whether bigger is better);
+- print a one-line-per-metric trajectory table;
+- exit nonzero when any metric regressed beyond ``--tolerance``
+  (default 10%) — ``run_all.py --compare`` wires this as the suite's
+  final gate.
+
+New metrics (no previous value) and retired metrics are reported but
+never fail the run; platform changes between rounds are noted (a cpu
+round vs a tpu round is apples vs oranges — flagged, not failed).
+
+Usage:
+  python scripts/bench_compare.py [--dir /root/repo] [--tolerance 0.10]
+                                  [--old r04] [--new r05]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+#: units where a SMALLER value is the better one
+_LOWER_BETTER_UNITS = {"ms", "s", "seconds", "mb", "mib", "bytes", "gb"}
+#: metric-name suffixes that mark lower-better numbers regardless of unit
+_LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_latency", "_bytes", "_rss_mb")
+#: extra fields of a metric line promoted to their own comparison rows
+_PROMOTED_FIELDS = ("true_rate", "p99_ms")
+#: boolean/one-shot rows that carry no trajectory signal
+_SKIP_UNITS = {"ok", "capture", "keys"}
+
+
+def lower_is_better(name: str, unit: str) -> bool:
+    u = unit.strip().lower()
+    if u in _LOWER_BETTER_UNITS:
+        return True
+    if any(name.endswith(s) for s in _LOWER_BETTER_SUFFIXES):
+        return True
+    return False
+
+
+def metrics_of(path: str) -> dict:
+    """metric name → {value, unit, platform} from one BENCH_r file
+    (every JSON metric line in ``tail``, newest wins, plus ``parsed``)."""
+    with open(path) as f:
+        doc = json.load(f)
+    out: dict = {}
+
+    def take(parsed) -> None:
+        if not isinstance(parsed, dict) or "metric" not in parsed:
+            return
+        name = parsed["metric"]
+        unit = str(parsed.get("unit", ""))
+        if unit in _SKIP_UNITS:
+            return
+        try:
+            value = float(parsed.get("value"))
+        except (TypeError, ValueError):
+            return
+        plat = parsed.get("platform", "")
+        out[name] = {"value": value, "unit": unit, "platform": plat}
+        for fld in _PROMOTED_FIELDS:
+            v = parsed.get(fld)
+            if isinstance(v, (int, float)):
+                out[f"{name}.{fld}"] = {
+                    "value": float(v),
+                    "unit": "ms" if fld.endswith("ms") else unit,
+                    "platform": plat,
+                }
+
+    for line in (doc.get("tail") or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                take(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    take(doc.get("parsed"))
+    return out
+
+
+def round_key(path: str) -> int:
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def compare(
+    old: dict, new: dict, old_name: str, new_name: str, tolerance: float
+):
+    """Returns (table rows, regression count).  A row is one formatted
+    line; regressions are direction-aware changes beyond tolerance."""
+    rows = []
+    regressions = 0
+    width = max([len(n) for n in set(old) | set(new)] + [6])
+    for name in sorted(set(old) | set(new)):
+        o, n = old.get(name), new.get(name)
+        if o is None:
+            rows.append(f"{name:<{width}}  {'—':>12} -> {n['value']:>12,.1f}"
+                        f"  {'new':>8}  {n['unit']}")
+            continue
+        if n is None:
+            rows.append(f"{name:<{width}}  {o['value']:>12,.1f} -> {'—':>12}"
+                        f"  {'gone':>8}")
+            continue
+        ov, nv = o["value"], n["value"]
+        if ov == 0:
+            delta = 0.0 if nv == 0 else float("inf")
+        else:
+            delta = (nv - ov) / abs(ov)
+        lower = lower_is_better(name, n["unit"] or o["unit"])
+        worse = -delta if lower else delta
+        if o.get("platform") and n.get("platform") and (
+            o["platform"] != n["platform"]
+        ):
+            verdict = f"platform {o['platform']}->{n['platform']}"
+        elif worse < -tolerance:
+            verdict = "REGRESSED"
+            regressions += 1
+        elif worse > tolerance:
+            verdict = "improved"
+        else:
+            verdict = "ok"
+        rows.append(
+            f"{name:<{width}}  {ov:>12,.1f} -> {nv:>12,.1f}"
+            f"  {delta:>+7.1%}  {verdict}"
+        )
+    header = (
+        f"{'metric':<{width}}  {old_name:>12} -> {new_name:>12}"
+        f"  {'delta':>8}  verdict"
+    )
+    return [header] + rows, regressions
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--dir",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    ap.add_argument("--glob", default="BENCH_r*.json")
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="relative worsening tolerated before failing")
+    ap.add_argument("--old", default=None,
+                    help="explicit old round (e.g. r04); default: previous"
+                         " round with metrics")
+    ap.add_argument("--new", default=None,
+                    help="explicit new round (e.g. r05); default: newest"
+                         " round with metrics")
+    args = ap.parse_args()
+
+    paths = sorted(glob.glob(os.path.join(args.dir, args.glob)),
+                   key=round_key)
+    if len(paths) < 2:
+        print(f"bench_compare: fewer than two rounds match "
+              f"{args.glob} under {args.dir} — nothing to compare")
+        return 0
+
+    def named(tag):
+        for p in paths:
+            if os.path.basename(p) == f"BENCH_{tag}.json" or (
+                f"_{tag}." in os.path.basename(p)
+            ):
+                return p
+        print(f"bench_compare: no round named {tag}", file=sys.stderr)
+        return None
+
+    if args.new is not None:
+        new_path = named(args.new)
+        if new_path is None:
+            return 2
+    else:
+        new_path = None
+    if args.old is not None:
+        old_path = named(args.old)
+        if old_path is None:
+            return 2
+    else:
+        old_path = None
+
+    # walk newest→oldest picking the two most recent rounds that carry
+    # metrics at all (a probe-failed round records rc/tail but no JSON
+    # metric lines — skipping it keeps the comparison meaningful)
+    usable = [(p, metrics_of(p)) for p in paths]
+    with_metrics = [(p, m) for p, m in usable if m]
+    if new_path is None:
+        if not with_metrics:
+            print("bench_compare: no round carries metrics")
+            return 0
+        new_path, new_metrics = with_metrics[-1]
+    else:
+        new_metrics = metrics_of(new_path)
+    if old_path is None:
+        older = [(p, m) for p, m in with_metrics
+                 if round_key(p) < round_key(new_path)]
+        if not older:
+            print(f"bench_compare: no earlier round with metrics before "
+                  f"{os.path.basename(new_path)}")
+            return 0
+        old_path, old_metrics = older[-1]
+    else:
+        old_metrics = metrics_of(old_path)
+
+    short = lambda p: os.path.basename(p).replace("BENCH_", "").replace(
+        ".json", ""
+    )
+    rows, regressions = compare(
+        old_metrics, new_metrics, short(old_path), short(new_path),
+        args.tolerance,
+    )
+    for r in rows:
+        print(r)
+    if regressions:
+        print(f"\nbench_compare: {regressions} metric(s) regressed beyond "
+              f"{args.tolerance:.0%} ({short(old_path)} -> "
+              f"{short(new_path)})")
+        return 1
+    print(f"\nbench_compare: trajectory ok "
+          f"({short(old_path)} -> {short(new_path)}, "
+          f"tolerance {args.tolerance:.0%})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,166 @@
+#!/usr/bin/env bash
+# SLO / incident smoke: arm a chaos-registry fault site so the latency
+# path fails under serving load, trip the circuit breaker, and assert
+# the anomaly-diagnosis loop closes END TO END with zero configuration
+# beyond with_telemetry(incident_dir=...):
+#   1. the breaker trip fires the flight-recorder trigger bus;
+#   2. an incident bundle lands on disk containing the OFFENDING
+#      dispatch traces (error-attributed spans, trace ids listed in the
+#      bundle head) plus the metrics/cost-model state;
+#   3. the /slo endpoint reports the transient-fault burn;
+#   4. /healthz degrades to "degraded" with machine-readable reasons
+#      while the breaker is open.
+# Prints SLO-SMOKE-OK on success — the CI-runnable proof, mirroring
+# scripts/serve_smoke.sh / telemetry_smoke.sh.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+: "${SLO_SMOKE_TIMEOUT_S:=420}"
+
+timeout -k 10 "${SLO_SMOKE_TIMEOUT_S}" env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import os
+import tempfile
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from gochugaru_tpu import consistency, rel
+from gochugaru_tpu.client import (
+    new_tpu_evaluator, with_admission_control, with_latency_mode,
+    with_telemetry,
+)
+from gochugaru_tpu.utils import faults, metrics, trace
+from gochugaru_tpu.utils.admission import AdmissionConfig
+from gochugaru_tpu.utils.context import background
+
+D = tempfile.mkdtemp(prefix="gochugaru_incidents_")
+m = metrics.default
+
+# zero manual configuration beyond incident_dir: recorder + SLO engine +
+# 0%-head-sample tracer all arm here
+c = new_tpu_evaluator(
+    with_latency_mode(),
+    with_admission_control(AdmissionConfig(
+        breaker_threshold=2, breaker_cooldown_s=60.0,
+    )),
+    with_telemetry(port=0, incident_dir=D),
+)
+url = c.telemetry.url
+ctx = background()
+c.write_schema(ctx, """
+definition user {}
+definition doc { relation reader: user  permission read = reader }
+""")
+txn = rel.Txn()
+for i in range(64):
+    txn.create(rel.must_from_triple(f"doc:d{i}", "reader", f"user:u{i % 16}"))
+c.write(ctx, txn)
+qs = [rel.must_from_triple(f"doc:d{i}", "read", f"user:u{i % 16}")
+      for i in range(8)]
+# warm: pin the latency tier before the storm
+for _ in range(4):
+    c.check(ctx, consistency.full(), *qs)
+assert m.counter("latency.dispatches") > 0, "latency path never engaged"
+
+# -- the fault storm under serving load ---------------------------------
+trips0 = m.counter("breaker.trips")
+with c.with_serving() as h:
+    stop = threading.Event()
+
+    def load(w):
+        lr = np.random.default_rng(w)
+        while not stop.is_set():
+            sub = [rel.must_from_triple(
+                f"doc:d{lr.integers(64)}", "read",
+                f"user:u{lr.integers(16)}") for _ in range(4)]
+            h.check(ctx.with_timeout(30.0), *sub, client_id=w)
+    ts = [threading.Thread(target=load, args=(w,), daemon=True)
+          for w in range(3)]
+    for t in ts:
+        t.start()
+    time.sleep(0.3)  # load flowing
+    faults.arm("latency.dispatch", times=4)
+    t0 = time.time()
+    while m.counter("breaker.trips") <= trips0 and time.time() - t0 < 30:
+        time.sleep(0.05)
+    stop.set()
+    for t in ts:
+        t.join(timeout=30)
+    faults.disarm("latency.dispatch")
+assert m.counter("breaker.trips") > trips0, "breaker never tripped"
+print(f"# breaker tripped under load "
+      f"(trips={int(m.counter('breaker.trips'))}, "
+      f"retries={int(m.counter('retry.retries'))})")
+
+# -- 1+2: the incident bundle, with the offending traces ----------------
+c.recorder.flush()
+bundle_path = None
+t0 = time.time()
+while bundle_path is None and time.time() - t0 < 20:
+    hits = [f for f in os.listdir(D)
+            if f.startswith("incident_") and "breaker.trip" in f]
+    if hits:
+        bundle_path = os.path.join(D, sorted(hits)[0])
+        break
+    time.sleep(0.2)
+assert bundle_path, f"no breaker.trip incident bundle appeared under {D}"
+lines = [json.loads(ln) for ln in open(bundle_path) if ln.strip()]
+head = lines[0]
+assert head["kind"] == "incident" and head["trigger"] == "breaker.trip", head
+traces = [ln for ln in lines if ln["kind"] == "trace"]
+assert traces, "bundle retained no traces"
+offending = [
+    t["trace_id"] for t in traces
+    if any("error" in (sp.get("attrs") or {}) for sp in t["spans"])
+]
+assert offending, "no error-attributed (offending) trace in the bundle"
+assert set(offending) <= set(head["trace_ids"]), "head trace-id index wrong"
+mline = next(ln for ln in lines if ln["kind"] == "metrics")
+assert "breaker.trips" in mline["counters"], "metrics dump missing"
+assert "cost_model" in head["context"], "cost-model state missing"
+print(f"# incident bundle: {os.path.basename(bundle_path)} — "
+      f"{len(traces)} traces, {len(offending)} offending "
+      f"(e.g. {offending[0]})")
+
+# -- 3: /slo reports the burn ------------------------------------------
+def get(path):
+    with urllib.request.urlopen(url + path, timeout=10) as r:
+        return json.loads(r.read().decode())
+
+burn = 0.0
+t0 = time.time()
+while time.time() - t0 < 15:
+    rep = get("/slo")
+    assert rep["enabled"], "/slo engine missing"
+    row = next(s for s in rep["slos"] if s["name"] == "transient_faults")
+    burn = max(w["burn"] for w in row["windows"].values())
+    if burn > 0:
+        break
+    time.sleep(0.5)
+assert burn > 0, "transient-fault burn never showed on /slo"
+print(f"# /slo: transient_faults burn={burn} "
+      f"(budget {row['budget']}, breached={row['breached']})")
+
+# -- 4: /healthz readiness degrades while the breaker is open -----------
+hz = get("/healthz")
+assert hz["status"] == "degraded", hz
+assert "breaker_open" in hz["reasons"], hz["reasons"]
+assert hz["breaker_state"] == 2 and hz["incidents"] >= 1, hz
+print(f"# /healthz: status={hz['status']} reasons={hz['reasons']}")
+
+print(json.dumps({
+    "metric": "slo_smoke", "value": 1, "unit": "ok", "vs_baseline": 1.0,
+    "breaker_trips": int(m.counter("breaker.trips")),
+    "incident_traces": len(traces), "offending_traces": len(offending),
+    "transient_fault_burn": round(burn, 3),
+    "note": "breaker trip -> incident bundle with offending trace ids"
+            " + /slo burn + degraded /healthz",
+}))
+print(f"SLO-SMOKE-OK bundle={os.path.basename(bundle_path)} "
+      f"offending={len(offending)} burn={round(burn, 3)}")
+EOF
+rc=$?
+exit "$rc"

@@ -50,4 +50,22 @@ echo "metrics: dispatch p99 quantile present"
 curl -fsS "$URL/traces" | head -n1 | grep -q '"trace_id"' \
     || { echo "FAIL: /traces has no trace"; exit 1; }
 echo "traces: JSONL present"
+
+# SLO burn-rate engine: enabled, reporting every declared objective
+curl -fsS "$URL/slo" | grep -q '"enabled": true' \
+    || { echo "FAIL: /slo not enabled"; exit 1; }
+curl -fsS "$URL/slo" | grep -q '"burn"' \
+    || { echo "FAIL: /slo reports no burn windows"; exit 1; }
+echo "slo: burn report live"
+
+# flight recorder: incident index serves (empty is fine on a quiet run)
+curl -fsS "$URL/debug/incidents" | grep -q '"incidents"' \
+    || { echo "FAIL: /debug/incidents missing"; exit 1; }
+echo "incidents: index live"
+
+# OpenMetrics negotiation: exemplar-capable dialect ends with # EOF
+curl -fsS -H 'Accept: application/openmetrics-text' "$URL/metrics" \
+    | tail -n1 | grep -q '# EOF' \
+    || { echo "FAIL: OpenMetrics dialect missing # EOF"; exit 1; }
+echo "metrics: OpenMetrics dialect negotiated"
 echo "TELEMETRY-SMOKE-OK"

@@ -1,18 +1,24 @@
 #!/usr/bin/env python
 """Standalone telemetry endpoint: build a small demo world, run traced
-checks, and serve /metrics + /traces + /healthz until killed.
+checks, and serve /metrics + /traces + /slo + /debug/incidents +
+/healthz until killed.
 
 The in-process route is ``client.with_telemetry(port=...)`` (client.py);
-this daemon exists so operators and the smoke script
-(scripts/telemetry_smoke.sh) can curl the endpoints without writing a
-driver, and as living documentation of the wiring.
+this daemon exists so operators and the smoke scripts
+(scripts/telemetry_smoke.sh, scripts/slo_smoke.sh) can curl the
+endpoints without writing a driver, and as living documentation of the
+wiring.
 
 Usage:
   python scripts/telemetryd.py [--port 0] [--sample-rate 1.0]
                                [--checks 64] [--idle]
+                               [--incident-dir DIR] [--no-slo]
 
 Prints ``READY url=http://host:port`` on stdout once serving.  With
 ``--idle`` no demo world is built (bare registry — fastest start).
+``--incident-dir`` (default: $GOCHUGARU_INCIDENT_DIR) lands flight-
+recorder incident bundles there; the recorder itself is always
+installed, so /debug/incidents serves in-memory bundles either way.
 """
 
 import argparse
@@ -32,6 +38,11 @@ def main() -> int:
                     help="demo checks to run before (and while) serving")
     ap.add_argument("--idle", action="store_true",
                     help="serve the bare registry; no demo world, no JAX")
+    ap.add_argument("--incident-dir",
+                    default=os.environ.get("GOCHUGARU_INCIDENT_DIR") or None,
+                    help="dump flight-recorder incident bundles here")
+    ap.add_argument("--no-slo", action="store_true",
+                    help="skip the SLO burn-rate engine")
     args = ap.parse_args()
 
     if not args.idle:
@@ -40,11 +51,21 @@ def main() -> int:
 
         force_cpu_platform()
 
+    from gochugaru_tpu.utils import slo as slo_mod
     from gochugaru_tpu.utils import trace
     from gochugaru_tpu.utils.telemetry import TelemetryServer
 
     trace.configure(sample_rate=args.sample_rate, slow_threshold_s=0.1)
-    srv = TelemetryServer(port=args.port, host=args.host)
+    recorder = trace.install_recorder(
+        trace.FlightRecorder(incident_dir=args.incident_dir)
+    )
+    # install_engine, not a bare constructor: the process-global slot is
+    # what enforces one evaluator per process and what the telemetry
+    # endpoints' closed-engine fallback resolves through
+    slo = None if args.no_slo else slo_mod.install_engine(slo_mod.SLOEngine())
+    srv = TelemetryServer(
+        port=args.port, host=args.host, slo=slo, recorder=recorder
+    )
     print(f"READY url={srv.url}", flush=True)
 
     client = ctx = rs = None
@@ -79,6 +100,8 @@ definition doc { relation reader: user  permission read = reader }
     except KeyboardInterrupt:
         pass
     finally:
+        if slo is not None:
+            slo.close()
         srv.close()
     return 0
 

@@ -60,6 +60,21 @@ def _reset_faults():
     faults.reset()
 
 
+@pytest.fixture(autouse=True)
+def _reset_recorder():
+    """Flight-recorder hygiene (utils/trace.py): the recorder is a
+    process-global by design (the trigger bus must be reachable from
+    anomaly sites without plumbing); no test may leak an installed one
+    into the next — a leaked recorder would make every unsampled request
+    allocate flight-only spans and break the zero-alloc contract
+    tests."""
+    yield
+    from gochugaru_tpu.utils import slo, trace
+
+    trace.install_recorder(None)
+    slo.install_engine(None)  # closes a leaked process-global engine
+
+
 # Multi-host capability probe: some container jaxlib builds cannot run
 # multiprocess collectives on the CPU backend at all ("Multiprocess
 # computations aren't implemented on the CPU backend") — an ENVIRONMENT

@@ -424,10 +424,17 @@ def test_breaker_probe_must_actually_run_latency_path():
 def _collect_watch(c, ctx, n_expected, timeout_s=10.0):
     got = []
     done = threading.Event()
+    # subscribe ON THIS THREAD before any test write: c.updates captures
+    # its head-revision cursor at CALL time, so calling it inside the
+    # consumer thread races the caller's writes — a write landing before
+    # the subscription is (correctly) never delivered and the consumer
+    # waits forever.  The GIL makes the race outcome hinge on scheduling
+    # phase, i.e. on unrelated code elsewhere in the suite.
+    stream = c.updates(ctx, rel.UpdateFilter())
 
     def consume():
         try:
-            for u in c.updates(ctx, rel.UpdateFilter()):
+            for u in stream:
                 got.append(u)
                 if len(got) >= n_expected:
                     break
@@ -477,10 +484,13 @@ def test_watch_persistent_fault_surfaces_bounded():
     faults.arm("watch.stream")  # every delivery faults, forever
     err = {}
     done = threading.Event()
+    # subscribe before the write (same cursor-capture race as
+    # _collect_watch: the head cursor is taken when c.updates is CALLED)
+    stream = c.updates(ctx, rel.UpdateFilter())
 
     def consume():
         try:
-            for _u in c.updates(ctx, rel.UpdateFilter()):
+            for _u in stream:
                 pass
         except UnavailableError as e:
             err["e"] = e

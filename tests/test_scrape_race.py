@@ -1,0 +1,160 @@
+"""Concurrent scrape-under-load (the PR's race satellite): a /metrics +
+/traces + /slo + /healthz scrape loop racing a serving-burst stand-in
+(ThreadingHTTPServer handlers vs. hot ``observe_hist``/``observe``/
+``inc`` writers and root-span churn), asserting
+
+- no exporter exceptions (every response 200 and parseable),
+- no TORN histogram rows: within one scrape the cumulative ``le``
+  series is nondecreasing and the +Inf bucket equals ``_count`` — a
+  render that read counts mid-update would violate one of the two,
+- monotone cumulative buckets ACROSS scrapes (a cumulative series that
+  ever decreases would poison any rate() computed over it).
+"""
+
+import json
+import random
+import re
+import threading
+import urllib.request
+
+import pytest
+
+from gochugaru_tpu.utils import trace
+from gochugaru_tpu.utils.metrics import Metrics
+from gochugaru_tpu.utils.slo import SLOEngine, latency_slo, ratio_slo
+from gochugaru_tpu.utils.telemetry import TelemetryServer
+
+BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1)
+_BUCKET_RE = re.compile(
+    r'^gochugaru_serve_request_latency_bucket\{le="([^"]+)"\} (\d+)'
+)
+_COUNT_RE = re.compile(r"^gochugaru_serve_request_latency_count (\d+)$")
+
+
+@pytest.fixture(autouse=True)
+def _trace_hygiene():
+    trace.disable()
+    yield
+    trace.disable()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def _parse_hist(text):
+    """(ordered [(le, cum)], count) for serve.request_latency."""
+    rows, count = [], None
+    for ln in text.splitlines():
+        mb = _BUCKET_RE.match(ln)
+        if mb:
+            rows.append((mb.group(1), int(mb.group(2))))
+            continue
+        mc = _COUNT_RE.match(ln)
+        if mc:
+            count = int(mc.group(1))
+    return rows, count
+
+
+def test_concurrent_scrape_under_serving_burst():
+    m = Metrics()
+    trace.configure(sample_rate=1.0, slow_threshold_s=None, capacity=64,
+                    registry=m)
+    rec = trace.install_recorder(trace.FlightRecorder(registry=m))
+    slo = SLOEngine(
+        slos=[
+            latency_slo("req", "serve.request_s", objective_ms=20.0),
+            ratio_slo("shed", bad=("serve.sheds",),
+                      total=("serve.submissions",), budget=0.05),
+        ],
+        registry=m, tick_s=0.02, start=True,
+    )
+    srv = TelemetryServer(port=0, registry=m, slo=slo, recorder=rec)
+    stop = threading.Event()
+    writer_errors = []
+
+    def writer(w):
+        rng = random.Random(w)
+        i = 0
+        try:
+            while not stop.is_set():
+                v = rng.random() * 0.2
+                m.observe_hist(
+                    "serve.request_latency", v, BUCKETS,
+                    trace_id=f"w{w}-{i}",
+                )
+                m.observe("serve.request_s", v)
+                m.inc("serve.submissions")
+                if i % 7 == 0:
+                    m.inc("serve.sheds")
+                sp = trace.root_span("serve.check", batch=4)
+                sp.event("formed", i=i)
+                sp.end()
+                i += 1
+        except Exception as e:  # pragma: no cover - the failure signal
+            writer_errors.append(e)
+
+    writers = [threading.Thread(target=writer, args=(w,), daemon=True)
+               for w in range(4)]
+    for t in writers:
+        t.start()
+
+    prev_by_le: dict = {}
+    prev_count = 0
+    scrapes = 0
+    try:
+        # ~60 scrape rounds racing the writers, alternating dialects
+        for round_i in range(60):
+            om = round_i % 2 == 1
+            code, body = _get(
+                srv.url + "/metrics" + ("?openmetrics=1" if om else "")
+            )
+            assert code == 200
+            if om:
+                assert body.rstrip().endswith("# EOF")
+            rows, count = _parse_hist(body)
+            if rows:
+                scrapes += 1
+                assert count is not None, "bucket rows without _count"
+                # within-scrape integrity: cumulative nondecreasing,
+                # +Inf == _count (a torn read breaks one of these)
+                cums = [c for _le, c in rows]
+                assert cums == sorted(cums), f"non-monotone le series: {rows}"
+                assert rows[-1][0] == "+Inf" and rows[-1][1] == count, (
+                    rows[-1], count,
+                )
+                # across-scrape monotonicity per bucket
+                for le, c in rows:
+                    assert c >= prev_by_le.get(le, 0), (
+                        f"bucket le={le} went backwards"
+                    )
+                    prev_by_le[le] = c
+                assert count >= prev_count
+                prev_count = count
+            code, body = _get(srv.url + "/traces")
+            assert code == 200
+            for ln in body.splitlines():
+                json.loads(ln)  # every line parses
+            code, body = _get(srv.url + "/slo")
+            assert code == 200
+            rep = json.loads(body)
+            assert rep["enabled"] and len(rep["slos"]) == 2
+            code, body = _get(srv.url + "/healthz")
+            assert code == 200
+            hz = json.loads(body)
+            assert hz["status"] in ("ok", "degraded")
+    finally:
+        stop.set()
+        for t in writers:
+            t.join(timeout=10)
+        slo.close()
+        srv.close()
+
+    assert not writer_errors, writer_errors
+    assert scrapes >= 50, "the burst never overlapped the scrape loop"
+    assert prev_count > 0
+    # and the OpenMetrics dialect carried exemplars for the hot buckets
+    from gochugaru_tpu.utils.telemetry import render_prometheus
+
+    assert "# {trace_id=" in render_prometheus(m, openmetrics=True)

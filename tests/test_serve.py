@@ -754,10 +754,17 @@ def test_metrics_histogram_and_prometheus_render():
     for v in (1, 64, 64, 200, 256, 5000):
         reg.observe_hist("serve.batch_fill", v, (64, 256, 1024))
     hs = reg.hist_snapshot()
-    buckets, counts, n, total = hs["serve.batch_fill"]
+    buckets, counts, n, total, exemplars = hs["serve.batch_fill"]
     assert buckets == (64.0, 256.0, 1024.0)
     assert counts == [3, 2, 0, 1]  # le64: 1,64,64; le256: 200,256; +Inf: 5000
     assert n == 6 and total == pytest.approx(5585.0)
+    assert exemplars == [None] * 4  # no trace ids recorded yet
+    # exemplar recording: the LAST trace id per bucket, value + stamp
+    reg.observe_hist("serve.request_latency", 40, (64, 256), trace_id="t-a")
+    reg.observe_hist("serve.request_latency", 41, (64, 256), trace_id="t-b")
+    ex = reg.hist_snapshot()["serve.request_latency"][4]
+    assert ex[0][0] == "t-b" and ex[0][1] == 41.0 and ex[0][2] > 0
+    assert ex[1:] == [None] * 2
     snap = reg.snapshot()
     assert snap["serve.batch_fill.le_64"] == 3
     assert snap["serve.batch_fill.le_256"] == 5  # cumulative

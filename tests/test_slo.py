@@ -1,0 +1,222 @@
+"""SLO burn-rate engine (utils/slo.py): exact over-objective counting,
+multi-window burn math, breach edge-triggering into the flight-recorder
+bus, gauge export, and the declarative constructors."""
+
+import time
+
+import pytest
+
+from gochugaru_tpu.utils import trace
+from gochugaru_tpu.utils.metrics import Metrics
+from gochugaru_tpu.utils.slo import (
+    SLOEngine,
+    default_slos,
+    latency_slo,
+    ratio_slo,
+)
+
+
+@pytest.fixture(autouse=True)
+def _trace_hygiene():
+    trace.disable()
+    yield
+    trace.disable()
+
+
+def _engine(m, clock, slos=None, **kw):
+    kw.setdefault("windows", (10.0, 60.0))
+    kw.setdefault("burn_threshold", 2.0)
+    kw.setdefault("tick_s", 1.0)
+    return SLOEngine(
+        slos=slos if slos is not None else [
+            latency_slo("req", "t_s", objective_ms=10.0),
+            ratio_slo("shed", bad=("sheds",), total=("reqs",), budget=0.05),
+        ],
+        registry=m, clock=lambda: clock[0], start=False, **kw,
+    )
+
+
+def test_constructors_validate():
+    s = latency_slo("a", "t_s", objective_ms=20.0, quantile=99.0)
+    assert s.kind == "latency" and s.objective_s == 0.02
+    assert s.budget == pytest.approx(0.01)
+    r = ratio_slo("b", bad=("x",), total=("y",), budget=0.05)
+    assert r.kind == "ratio" and r.budget == 0.05
+    with pytest.raises(ValueError):
+        latency_slo("a", "t_s", objective_ms=1.0, quantile=100.0)
+    with pytest.raises(ValueError):
+        ratio_slo("b", bad=("x",), total=("y",), budget=0.0)
+    with pytest.raises(ValueError):
+        SLOEngine(slos=[s], windows=(), start=False)
+
+
+def test_latency_over_objective_counts_are_exact():
+    m = Metrics()
+    clock = [0.0]
+    eng = _engine(m, clock)
+    # the engine armed the threshold at construction
+    for _ in range(97):
+        m.observe("t_s", 0.001)
+    for _ in range(3):
+        m.observe("t_s", 0.5)
+    n, over = m.timer_counts("t_s")
+    assert (n, over) == (100, 3)
+    clock[0] += 1.0
+    rep = eng.tick()
+    row = next(s for s in rep["slos"] if s["name"] == "req")
+    w = row["windows"]["10s"]
+    # 3 bad of 100 against a 1% budget = burn 3.0 — exact, not estimated
+    assert w["bad"] == 3 and w["total"] == 100
+    assert w["burn"] == pytest.approx(3.0)
+
+
+def test_ratio_burn_and_gauges():
+    m = Metrics()
+    clock = [0.0]
+    eng = _engine(m, clock)
+    # 70 ticks: past the 60s long window's warm-up, so the sustained
+    # burn is confirmable in BOTH windows
+    for _ in range(70):
+        clock[0] += 1.0
+        m.inc("reqs", 100)
+        m.inc("sheds", 20)  # 20% shed vs 5% budget → burn 4
+        eng.tick()
+    assert m.gauge("slo.shed.burn_10s") == pytest.approx(4.0, rel=0.05)
+    assert m.gauge("slo.shed.burn_60s") == pytest.approx(4.0, rel=0.05)
+    assert m.gauge("slo.shed.breached") == 1.0
+    assert m.gauge("slo.breached") >= 1.0
+    rep = eng.report()
+    assert "shed" in rep["breached"] and not rep["healthy"]
+
+
+def test_multi_window_and_rule_denoises_short_spikes():
+    """A burst confined to the short window must NOT breach: the long
+    window has to confirm the burn is sustained (the standard
+    multi-window AND)."""
+    m = Metrics()
+    clock = [0.0]
+    eng = _engine(m, clock)
+    # 55 clean ticks fill the long window with healthy history
+    for _ in range(55):
+        clock[0] += 1.0
+        m.inc("reqs", 100)
+        eng.tick()
+    # 3 bad ticks: short-window burn blows past the threshold...
+    for _ in range(3):
+        clock[0] += 1.0
+        m.inc("reqs", 100)
+        m.inc("sheds", 50)
+        rep = eng.tick()
+    row = next(s for s in rep["slos"] if s["name"] == "shed")
+    assert row["windows"]["10s"]["burn"] > 2.0
+    # ...but the 60s window dilutes it below, so no breach
+    assert row["windows"]["60s"]["burn"] < 2.0
+    assert not row["breached"] and rep["healthy"]
+
+
+def test_cold_start_blip_cannot_breach_while_warming():
+    """Until history covers a window, that window is WARMING and cannot
+    confirm a breach: with a short history every window computes the
+    same delta off the oldest sample, so without the gate a cold-start
+    compile blip (first dispatches way over objective) would page
+    instantly — the exact thing the multi-window AND exists to stop."""
+    m = Metrics()
+    clock = [0.0]
+    eng = _engine(m, clock)
+    # an immediate 100%-bad storm, but only 5 ticks of history
+    for _ in range(5):
+        clock[0] += 1.0
+        m.inc("reqs", 10)
+        m.inc("sheds", 10)
+        rep = eng.tick()
+    row = next(s for s in rep["slos"] if s["name"] == "shed")
+    assert row["windows"]["10s"]["burn"] > 2.0  # burn reported...
+    assert row["windows"]["10s"]["warming"] is True  # ...but warming
+    assert not row["breached"] and rep["healthy"]
+    # once the windows warm, the (still sustained) burn breaches
+    for _ in range(65):
+        clock[0] += 1.0
+        m.inc("reqs", 10)
+        m.inc("sheds", 10)
+        rep = eng.tick()
+    row = next(s for s in rep["slos"] if s["name"] == "shed")
+    assert "warming" not in row["windows"]["60s"]
+    assert row["breached"]
+
+
+def test_idle_process_is_healthy_not_breached():
+    m = Metrics()
+    clock = [0.0]
+    eng = _engine(m, clock)
+    for _ in range(30):
+        clock[0] += 1.0
+        rep = eng.tick()
+    assert rep["healthy"] and not rep["breached"]
+    # zero-traffic windows report burn 0, not NaN/inf
+    row = rep["slos"][0]
+    assert row["windows"]["10s"]["burn"] == 0.0
+
+
+def test_breach_edge_fires_one_incident():
+    m = Metrics()
+    clock = [0.0]
+    trace.configure(sample_rate=1.0, slow_threshold_s=None, registry=m)
+    rec = trace.install_recorder(
+        trace.FlightRecorder(cooldown_s=0.0, grace_s=0.0, registry=m)
+    )
+    eng = _engine(m, clock)
+    # sustained burn across BOTH windows
+    for _ in range(70):
+        clock[0] += 1.0
+        m.inc("reqs", 100)
+        m.inc("sheds", 30)
+        eng.tick()
+    rec.flush()
+    slo_burns = [i for i in rec.incident_index()
+                 if i["trigger"] == "slo.burn"]
+    # edge-triggered: ONE incident for the whole excursion, not per tick
+    assert len(slo_burns) == 1
+    assert slo_burns[0]["info"]["slo"] == "shed"
+    assert m.counter("slo.breaches") == 1.0
+    # recovery then re-breach fires a second edge
+    for _ in range(80):
+        clock[0] += 1.0
+        m.inc("reqs", 100)
+        eng.tick()
+    assert eng.report()["healthy"]
+    for _ in range(70):
+        clock[0] += 1.0
+        m.inc("reqs", 100)
+        m.inc("sheds", 30)
+        eng.tick()
+    rec.flush()
+    assert m.counter("slo.breaches") == 2.0
+
+
+def test_default_slos_cover_the_serving_surfaces():
+    names = {s.name for s in default_slos()}
+    assert {"check.dispatch", "serve.request", "latency.dispatch",
+            "shed", "transient_faults"} <= names
+    # latency objectives arm timer thresholds on construction
+    m = Metrics()
+    eng = SLOEngine(registry=m, start=False)
+    m.observe("serve.request_s", 10.0)  # way over any objective
+    assert m.timer_counts("serve.request_s") == (1, 1)
+    assert eng.report()["ticks"] >= 1  # constructor tick
+
+
+def test_background_thread_ticks_and_closes():
+    m = Metrics()
+    eng = SLOEngine(
+        slos=[ratio_slo("shed", bad=("sheds",), total=("reqs",),
+                        budget=0.05)],
+        registry=m, tick_s=0.02, start=True,
+    )
+    t0 = time.time()
+    while eng.report()["ticks"] < 5 and time.time() - t0 < 5.0:
+        time.sleep(0.02)
+    assert eng.report()["ticks"] >= 5
+    eng.close()
+    ticks = eng.report()["ticks"]
+    time.sleep(0.1)
+    assert eng.report()["ticks"] == ticks  # really stopped

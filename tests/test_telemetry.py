@@ -183,5 +183,191 @@ def test_with_telemetry_client_option():
         assert trace.enabled(), "with_telemetry(trace_sample_rate=) installs tracer"
         code, body = _get(c.telemetry.url + "/metrics")
         assert code == 200 and "gochugaru_" in body
+        # this round: the anomaly loop arms with the endpoint — flight
+        # recorder installed, SLO engine ticking, /slo live
+        assert c.recorder is trace.recorder() and c.recorder is not None
+        assert c.slo is not None
+        code, body = _get(c.telemetry.url + "/slo")
+        assert code == 200 and json.loads(body)["enabled"] is True
+        code, body = _get(c.telemetry.url + "/debug/incidents")
+        assert code == 200 and json.loads(body)["incidents"] == []
     finally:
+        if c.slo is not None:
+            c.slo.close()
         c.telemetry.close()
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics dialect + exemplars
+# ---------------------------------------------------------------------------
+
+#: minimal OpenMetrics line grammar: TYPE/EOF comments, or a sample with
+#: optional labels, a value, and an optional exemplar (histogram buckets)
+_OM_LINE = __import__("re").compile(
+    r"^(?:"
+    r"# (?:TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+    r"(?:counter|gauge|summary|histogram)|EOF)"
+    r"|"
+    r"[a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^{}]*\})? -?[0-9.e+-]+(?:[0-9]+)?"
+    r"(?: # \{[^{}]*\} -?[0-9.e+-]+(?: [0-9.]+)?)?"
+    r")$"
+)
+
+
+def test_openmetrics_render_parses_and_carries_exemplars():
+    from gochugaru_tpu.utils.telemetry import render_prometheus
+
+    m = Metrics()
+    m.inc("checks.requested", 3)
+    m.set_gauge("breaker.state", 0)
+    m.observe("checks.dispatch", 0.004)
+    m.observe_hist("serve.request_latency", 0.004, (0.001, 0.01, 0.1),
+                   trace_id="abc-1")
+    m.observe_hist("serve.request_latency", 0.9, (0.001, 0.01, 0.1),
+                   trace_id="def-2")
+    text = render_prometheus(m, openmetrics=True)
+    lines = text.splitlines()
+    # every line matches the OpenMetrics grammar; the doc ends with # EOF
+    for ln in lines:
+        assert _OM_LINE.match(ln), f"invalid OpenMetrics line: {ln!r}"
+    assert lines[-1] == "# EOF"
+    # counter family: TYPE names the family, the sample adds _total
+    assert "# TYPE gochugaru_checks_requested counter" in lines
+    assert "gochugaru_checks_requested_total 3.0" in lines
+    # exemplars attach to the bucket the trace landed in, with value+ts
+    ex = [ln for ln in lines if "# {" in ln]
+    assert len(ex) == 2
+    assert any('le="0.01"' in ln and 'trace_id="abc-1"' in ln for ln in ex)
+    assert any('le="+Inf"' in ln and 'trace_id="def-2"' in ln for ln in ex)
+    # canonical-float le labels in OM mode
+    assert any('le="0.001"' in ln for ln in lines)
+    # the 0.0.4 dialect never emits exemplars (invalid there) and keeps
+    # its historical TYPE naming
+    classic = render_prometheus(m)
+    assert "# {" not in classic
+    assert "# TYPE gochugaru_checks_requested_total counter" in classic
+    assert not classic.rstrip().endswith("# EOF")
+
+
+def test_metrics_endpoint_negotiates_openmetrics():
+    import urllib.request
+
+    m = Metrics()
+    m.observe_hist("serve.batch_fill", 3, (4, 16), trace_id="t-1")
+    srv = TelemetryServer(port=0, registry=m)
+    try:
+        req = urllib.request.Request(
+            srv.url + "/metrics",
+            headers={"Accept": "application/openmetrics-text"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert "openmetrics-text" in r.headers["Content-Type"]
+            body = r.read().decode()
+        assert body.rstrip().endswith("# EOF") and 'trace_id="t-1"' in body
+        # and the query-param route for curl
+        code, body = _get(srv.url + "/metrics?openmetrics=1")
+        assert code == 200 and body.rstrip().endswith("# EOF")
+        # default stays 0.0.4
+        code, body = _get(srv.url + "/metrics")
+        assert code == 200 and "# EOF" not in body
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# readiness /healthz + /slo + /debug/incidents
+# ---------------------------------------------------------------------------
+
+
+def test_readiness_report_degrades_with_reasons():
+    from gochugaru_tpu.utils.slo import SLOEngine, ratio_slo
+    from gochugaru_tpu.utils.telemetry import readiness_report
+
+    m = Metrics()
+    r = readiness_report(m)
+    assert r["status"] == "ok" and r["reasons"] == []
+    assert r["breaker_state"] == 0 and r["slo"] is None
+    # breaker open → degraded with the reason named
+    m.set_gauge("breaker.state", 2)
+    m.set_gauge("admission.inflight", 7)
+    m.set_gauge("serve.queue_depth", 123)
+    r = readiness_report(m)
+    assert r["status"] == "degraded" and "breaker_open" in r["reasons"]
+    assert r["admission_inflight"] == 7 and r["serve_queue_depth"] == 123
+    m.set_gauge("breaker.state", 1)
+    assert "breaker_half_open" in readiness_report(m)["reasons"]
+    # SLO breach → degraded naming the burning SLO
+    m.set_gauge("breaker.state", 0)
+    clock = [0.0]
+    eng = SLOEngine(
+        slos=[ratio_slo("shed", bad=("sheds",), total=("reqs",),
+                        budget=0.05)],
+        registry=m, windows=(10.0, 60.0), tick_s=1.0,
+        clock=lambda: clock[0], start=False,
+    )
+    for _ in range(70):
+        clock[0] += 1.0
+        m.inc("reqs", 10)
+        m.inc("sheds", 5)
+        eng.tick()
+    r = readiness_report(m, slo=eng)
+    assert r["status"] == "degraded"
+    assert "slo_burn:shed" in r["reasons"]
+    assert r["slo"] == {"healthy": False, "breached": ["shed"]}
+
+
+def test_healthz_and_incident_endpoints_end_to_end(tmp_path):
+    m = Metrics()
+    trace.configure(sample_rate=1.0, slow_threshold_s=None, registry=m)
+    rec = trace.install_recorder(trace.FlightRecorder(
+        incident_dir=str(tmp_path), grace_s=0.0, cooldown_s=0.0,
+        registry=m,
+    ))
+    srv = TelemetryServer(port=0, registry=m, recorder=rec)
+    try:
+        code, body = _get(srv.url + "/healthz")
+        hz = json.loads(body)
+        assert code == 200 and hz["status"] == "ok"
+        assert hz["incidents"] == 0
+        trace.root_span("check", batch=1).end()
+        iid = rec.trigger("breaker.trip", consecutive=2)
+        rec.flush()
+        code, body = _get(srv.url + "/debug/incidents")
+        idx = json.loads(body)
+        assert code == 200 and idx["incident_dir"] == str(tmp_path)
+        assert len(idx["incidents"]) == 1
+        assert idx["incidents"][0]["id"] == iid
+        code, body = _get(srv.url + f"/debug/incidents/{iid}")
+        assert code == 200
+        head = json.loads(body.splitlines()[0])
+        assert head["kind"] == "incident" and head["trigger"] == "breaker.trip"
+        # a fresh trip makes /healthz degraded via recent_incidents
+        code, body = _get(srv.url + "/healthz")
+        hz = json.loads(body)
+        assert hz["status"] == "degraded"
+        assert any(r.startswith("recent_incidents:") for r in hz["reasons"])
+        with pytest.raises(urllib.error.HTTPError):
+            _get(srv.url + "/debug/incidents/nope")
+    finally:
+        srv.close()
+
+
+def test_slo_endpoint_disabled_and_enabled():
+    from gochugaru_tpu.utils.slo import SLOEngine
+
+    m = Metrics()
+    srv = TelemetryServer(port=0, registry=m)
+    try:
+        code, body = _get(srv.url + "/slo")
+        assert code == 200 and json.loads(body) == {"enabled": False}
+    finally:
+        srv.close()
+    eng = SLOEngine(registry=m, start=False)
+    srv = TelemetryServer(port=0, registry=m, slo=eng)
+    try:
+        code, body = _get(srv.url + "/slo")
+        rep = json.loads(body)
+        assert rep["enabled"] and rep["healthy"] is True
+        assert {s["name"] for s in rep["slos"]} >= {"shed", "serve.request"}
+    finally:
+        srv.close()

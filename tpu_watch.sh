@@ -32,6 +32,10 @@ while true; do
   if probe; then
     log "probe OK — running TPU harvest ladder"
     TS=$(date +%H%M%S)
+    # flight-recorder bundles: every child in this window dumps
+    # anomaly-triggered incident bundles here (breaker trips, SLO
+    # burns, pinned-path recompiles) — harvested next to the capture
+    export GOCHUGARU_INCIDENT_DIR="tpu_attempts/incidents_${TS}"
     # priority 1: config-2 aligned kernel, all tiers + small-batch p99
     timeout 560 python bench.py --child tpu \
       > "tpu_attempts/bench_${TS}.out" 2> "tpu_attempts/bench_${TS}.err"
@@ -44,6 +48,14 @@ while true; do
         --trace "tpu_attempts/trace_${TS}" \
         > "tpu_attempts/trace_${TS}.out" 2> "tpu_attempts/trace_${TS}.err"
       log "trace rc=$? → tpu_attempts/trace_${TS}"
+      # harvest any incident bundles the window produced NEXT TO the XLA
+      # capture (the request-annotated traces already land there), so a
+      # mid-window anomaly ships with the profile that explains it
+      if compgen -G "${GOCHUGARU_INCIDENT_DIR}/incident_*.jsonl" > /dev/null; then
+        mkdir -p "tpu_attempts/trace_${TS}"
+        cp "${GOCHUGARU_INCIDENT_DIR}"/incident_*.jsonl "tpu_attempts/trace_${TS}/"
+        log "incident bundles copied → tpu_attempts/trace_${TS}/"
+      fi
       # priority 3: aligned-vs-legacy A/B on silicon
       timeout 560 python benchmarks/bench_tpu_harvest.py --ab \
         > "tpu_attempts/ab_${TS}.out" 2> "tpu_attempts/ab_${TS}.err"
@@ -62,6 +74,13 @@ while true; do
       timeout 900 python benchmarks/bench3_docs.py \
         > "tpu_attempts/b3_${TS}.out" 2> "tpu_attempts/b3_${TS}.err"
       log "config3 rc=$?"
+      # late-window incidents (bench7/b1/b3 anomalies) ride along too
+      if compgen -G "${GOCHUGARU_INCIDENT_DIR}/incident_*.jsonl" > /dev/null; then
+        mkdir -p "tpu_attempts/trace_${TS}"
+        cp -u "${GOCHUGARU_INCIDENT_DIR}"/incident_*.jsonl "tpu_attempts/trace_${TS}/" 2>/dev/null \
+          || cp "${GOCHUGARU_INCIDENT_DIR}"/incident_*.jsonl "tpu_attempts/trace_${TS}/"
+        log "incident bundles (late window) copied → tpu_attempts/trace_${TS}/"
+      fi
     fi
   else
     log "probe FAIL (attempt ${attempt})"
