@@ -183,7 +183,7 @@ def main() -> None:
         f"batch={B} step={dt*1000:.1f}ms granted={int(d.sum())}"
         f" overflow={int(ovf.sum())}"
     )
-    from benchmarks.common import est_bytes_per_check, table_bytes
+    from benchmarks.common import roofline_columns, table_bytes
 
     emit(
         "docs_5hop_bulk_check_throughput", rate, "checks/sec/chip",
@@ -191,7 +191,7 @@ def main() -> None:
         table_bytes_per_edge=round(
             table_bytes(dsnap) / max(int(snap.num_edges), 1), 2
         ),
-        bytes_per_check=round(est_bytes_per_check(dsnap), 1),
+        **roofline_columns(rate, dsnap=dsnap),
     )
     p50, p99, mean = latency_percentiles(roundtrip, reps=20)
     emit("docs_5hop_batch_p99_latency", p99, "ms",

@@ -389,74 +389,37 @@ def latency_percentiles(
 
 
 def table_bytes(dsnap) -> int:
-    """Resident device-table bytes of a DeviceSnapshot (the arrays
-    actually shipped; HBM-lean snapshots keep raw columns host-side and
-    those are correctly NOT counted — they never reach the device)."""
-    return sum(int(getattr(v, "nbytes", 0)) for v in dsnap.arrays.values())
+    """Resident device-table bytes of a DeviceSnapshot — delegates to
+    the perf ledger (gochugaru_tpu/utils/perf.py), the ONE
+    implementation bench columns and /perf share."""
+    from gochugaru_tpu.utils.perf import table_bytes as _impl
+
+    return _impl(dsnap)
 
 
 def est_bytes_per_check(dsnap) -> float:
-    """Static estimate of HBM bytes GATHERED per check at the root
-    dispatch (bucket-offset reads + candidate blocks at the e/T/KU/fold
-    sites, wildcard doubling included; deeper recursion levels excluded).
-    Row widths and lane dtypes come from the ACTUAL device arrays, so
-    packed and unpacked layouts are compared by what truly crosses HBM —
-    the roofline numerator next to checks/s."""
-    meta = dsnap.flat_meta
-    if meta is None:
-        return 0.0
-    arrs = dsnap.arrays
+    """HBM bytes GATHERED per check: the perf ledger's meta-driven
+    model (gochugaru_tpu/utils/perf.py gathered_bytes_model) — per
+    table AND per recursion level (the old copy here admitted deeper
+    recursion levels were excluded; the ledger computes them from the
+    snapshot's measured arrow depth and rc geometry).  Row widths and
+    lane dtypes come from the ACTUAL device arrays, so packed and
+    unpacked layouts are compared by what truly crosses HBM — the
+    roofline numerator next to checks/s."""
+    from gochugaru_tpu.utils.perf import est_bytes_per_check as _impl
 
-    def row(k):  # bytes of one table row (packed lanes or int32 cols)
-        a = arrs.get(k)
-        if a is None:
-            return 0
-        return int(a.shape[-1]) * int(np.dtype(a.dtype).itemsize)
+    return _impl(dsnap)
 
-    def off(k):  # one bucket-offset read (+ anchor when packed)
-        a = arrs.get(k)
-        if a is None:
-            return 0
-        return int(np.dtype(a.dtype).itemsize) + (
-            4 if (k + "_a") in arrs else 0
-        )
 
-    wc = 2 if meta.has_wc_edges else 1
-    total = 0.0
-    if meta.e_slots:
-        al = arrs.get("ehx_al")
-        e_blk = (
-            int(al.shape[1]) * int(np.dtype(al.dtype).itemsize)
-            if al is not None else off("eh_off") + meta.e_cap * row("ehx")
-        )
-        total += wc * e_blk
-    if meta.has_tindex:
-        total += wc * (off("th_off") + meta.t_cap * row("tx"))
-    if meta.us_fanout_by_slot:
-        fan = max((f for _s, f in meta.us_fanout_by_slot), default=0)
-        cl_blk = off("clh_off") + meta.cl_cap * row("clx")
-        total += (
-            off("usr_off") + meta.usr_cap * row("usgx")
-            + fan * (row("usx") + cl_blk)
-        )
-    if meta.fold_pairs:
-        total += wc * (off("pfh_off") + meta.pf_e_cap * row("pfx"))
-        if meta.pf_has_u:
-            if meta.pf_direct:
-                total += 8 + meta.pf_u_fan * row("pfu_gk")
-            else:
-                total += (
-                    off("pfu_off") + meta.pf_u_cap * row("pfugx")
-                    + meta.pf_u_fan * row("pfux")
-                )
-            if meta.pf_s_direct:
-                total += 8 + meta.pf_s_fan * row("csr_gk")
-            else:
-                total += (
-                    off("csr_off") + meta.pf_s_cap * row("csrgx")
-                    + meta.pf_s_fan * row("csrx")
-                )
-    return total
+def roofline_columns(rate: float, dsnap=None, bytes_per_check=None) -> dict:
+    """``achieved_gbps``/``roofline_frac`` bench columns: gathered
+    bytes/check × measured true checks/s against the MEASURED bandwidth
+    ceiling (perf.measure_bandwidth — triad microbench, cached per
+    backend fingerprint).  Splat into ``emit`` extra fields next to any
+    rate column."""
+    from gochugaru_tpu.utils.perf import roofline_columns as _impl
+
+    return _impl(rate, dsnap=dsnap, bytes_per_check=bytes_per_check)
 
 
 def peak_rss_mb() -> float:

@@ -202,6 +202,12 @@ def main() -> int:
         ["bash", "scripts/slo_smoke.sh"],
         600,
     ))
+    configs.append((
+        "16 — perf-attribution smoke (roofline microbench + /perf ledger"
+        " + wall-time closure)",
+        ["bash", "scripts/perf_smoke.sh"],
+        600,
+    ))
     if not q:
         # Leopard-scale CPU proxy (VERDICT r04 item 3): the same Watch
         # re-index loop at a 100M-edge base — BASELINE config 5's

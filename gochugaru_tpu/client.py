@@ -230,10 +230,13 @@ def with_telemetry(
     OpenMetrics text — counters, gauges, every timer ring as p50/p90/
     p99/p999 quantiles, histograms with trace-id exemplars), ``/traces``
     (JSONL dump of sampled request traces), ``/slo`` (multi-window
-    burn-rate report, utils/slo.py), ``/debug/incidents`` (flight-
-    recorder bundles), and ``/healthz`` (readiness: breaker state,
-    in-flight admission, serve queue depth, SLO status).  ``port=0``
-    picks an ephemeral port; read it back from ``client.telemetry.port``.
+    burn-rate report, utils/slo.py), ``/perf`` (the performance-
+    attribution ledger, utils/perf.py: cost_analysis entries, the
+    gathered-bytes model, pad waste, measured roofline, wall-time
+    ledger), ``/debug/incidents`` (flight-recorder bundles), and
+    ``/healthz`` (readiness: breaker state, in-flight admission, serve
+    queue depth, SLO status).  ``port=0`` picks an ephemeral port; read
+    it back from ``client.telemetry.port``.
 
     This option also arms the anomaly-diagnosis loop with zero further
     configuration: a process-global **flight recorder** (utils/trace.py)
@@ -343,6 +346,8 @@ class Client:
             # client A's state, counted per recorder (a fresh recorder
             # starts over) and capped so a client-per-job pattern can't
             # grow the context or pin dead controllers without bound
+            from .utils import perf as _perf
+
             rec.add_context_group(
                 {
                     "cost_model": self._admission.cost.state,
@@ -351,6 +356,11 @@ class Client:
                         "max_inflight": adm.gate.max_inflight,
                         "breaker_state": adm.breaker.state,
                     },
+                    # the perf ledger's cost state (gathered-bytes
+                    # model, pad waste, realized cost entries, cached
+                    # roofline, last wall-time window) — cheap by
+                    # contract: no compiles, no microbench
+                    "perf": _perf.context_state,
                 },
                 cap=self.TELEMETRY_CONTEXT_MAX,
             )

@@ -52,6 +52,7 @@ from ..store.snapshot import Snapshot
 import time as _time
 
 from ..utils import faults, metrics
+from ..utils import perf as _perf
 from ..utils import trace as _trace
 from ..utils.context import background as _background
 from ..utils.errors import classify_dispatch_exception
@@ -682,6 +683,10 @@ class DeviceEngine:
 
         self._latency_pins: Dict[Any, Any] = {}
         self._latency_pins_lock = threading.Lock()
+        #: (slots, BP, meta) batch programs already registered with the
+        #: perf cost ledger — the per-dispatch path checks this local
+        #: set only (no global ledger lock per call)
+        self._perf_cost_reg: set = set()
         #: context-free qctx singletons (host + device forms)
         self._empty_qctx_np: Optional[Dict[str, np.ndarray]] = None
         self._empty_qctx_jnp = None
@@ -856,7 +861,7 @@ class DeviceEngine:
         metrics.default.observe(
             "prepare.total_s", _time.perf_counter() - _t0
         )
-        return DeviceSnapshot(
+        dsnap = DeviceSnapshot(
             revision=snap.revision,
             arrays=arrays,
             tid_map=jnp.asarray(tid_map),
@@ -867,6 +872,11 @@ class DeviceEngine:
             closure_state=closure_state,
             host_arrays=host_arrays,
         )
+        # perf ledger: publish the gathered-bytes model (per-level,
+        # per-table) for this snapshot — the roofline numerator rides
+        # /metrics and incident bundles from the moment of prepare
+        _perf.publish_model(dsnap)
+        return dsnap
 
     @staticmethod
     def _frontier_will_serve(flat_meta, snap) -> bool:
@@ -1283,6 +1293,28 @@ class DeviceEngine:
             jnp.asarray(build_qm(queries, BP, dsnap.flat_meta)),
             self._qctx_device(qctx),
         )
+        if jit:
+            # device cost ledger: the batch-path program registers a
+            # LAZY capture over ShapeDtypeStruct avals (no device
+            # buffers pinned, no compile here) — realized only when a
+            # consumer explicitly asks (/perf?compile=1, perf smoke).
+            # The engine-local registered-set keeps the steady-state
+            # dispatch path to one set lookup (no global ledger lock,
+            # no key formatting per call — same discipline as
+            # spmv.FrontierKernels._register_cost)
+            rk = (slots, BP, dsnap.flat_meta)
+            if rk not in self._perf_cost_reg:
+                self._perf_cost_reg.add(rk)
+                ck = (
+                    f"slots={slots};B={BP};"
+                    f"meta={hash(dsnap.flat_meta) & 0xFFFFFFFF:08x}"
+                )
+                _perf.register_cost_thunk(
+                    "batch", ck,
+                    lambda fn=fn, avals=_perf.avals_of(args): fn.lower(
+                        *avals
+                    ).compile(),
+                )
         return fn, args
 
     def _flat_call(

@@ -56,6 +56,7 @@ import numpy as np
 
 from ..utils import faults
 from ..utils import metrics as _metrics
+from ..utils import perf as _perf
 from ..utils import trace as _trace
 from .flat import QM_ROWS, fill_qm
 
@@ -142,6 +143,20 @@ class LatencyPath:
         #: (qctx device dict identity, shape key) — the context-free
         #: singleton is one stable dict, so its key derivation is free
         self._qctx_key_cache: Optional[Tuple[Any, Tuple]] = None
+        #: lazily-computed gathered-bytes/check of this snapshot (the
+        #: perf ledger's meta model) — sampled dispatch spans carry
+        #: ``bytes_gathered_est`` without recomputing the model per call
+        self._bpc_cache: Optional[float] = None
+
+    def _bytes_per_check(self) -> float:
+        v = self._bpc_cache
+        if v is None:
+            try:
+                v = _perf.est_bytes_per_check(self.dsnap)
+            except Exception:
+                v = 0.0
+            self._bpc_cache = v
+        return v
 
     # -- availability ----------------------------------------------------
     def tier_for(self, B: int) -> Optional[int]:
@@ -224,6 +239,13 @@ class LatencyPath:
                 fn = jfn.lower(*args).compile()
                 self.compile_count += 1
                 self._m.inc("latency.compiles")
+                # device cost ledger: the Compiled is in hand, so the
+                # XLA cost_analysis capture is free at pin time
+                _perf.record_cost(
+                    "latency_pin",
+                    f"tier={tier};slots={slots}",
+                    fn, self._m, tier=int(tier), slots=len(slots),
+                )
                 with self.engine._latency_pins_lock:
                     pins = self.engine._latency_pins
                     while len(pins) >= self.engine.LATENCY_PIN_CACHE_MAX:
@@ -355,6 +377,14 @@ class LatencyPath:
         )
         self.last_budget = budget
         self.dispatch_count += 1
+        # pad-waste ledger: B live lanes padded to the tier — direct
+        # calls and batcher-formed batches both flow through here, so
+        # the serving occupancy is accounted per dispatch
+        _perf.record_pad(tier, B, self._m)
+        # wall-time ledger stages from the SAME t0..t4 stamps the budget
+        # (and the stage spans below) subtract — one branch when no
+        # measurement window is armed
+        _perf.report_wall_stages(t0, t1, t2, t3, t4)
         if len(self._served_keys) < 4096:  # qctx-shape churn backstop
             self._served_keys.add(pin_key)
         m = self._m
@@ -375,6 +405,8 @@ class LatencyPath:
             lsp = span.child(
                 "latency.dispatch", t=t0,
                 batch=B, tier=tier, compiled=fresh,
+                pad_fraction=round(1.0 - B / tier, 4),
+                bytes_gathered_est=round(self._bytes_per_check() * B, 1),
             )
             lsp.child_at("stage.host_lower", t0).end(t=t1)
             lsp.child_at("stage.h2d", t1).end(t=t2)

@@ -277,6 +277,9 @@ class FrontierKernels:
         }
         if not self._arg_aligned:
             self._hops_fused["arg"] = self._make_hop("arg")
+        #: (kind, frontier-pad) shapes already registered with the perf
+        #: cost ledger — the hot hop path checks this local set only
+        self._cost_reg: set = set()
 
     def _make_hop(self, kind: str):
         import jax
@@ -454,11 +457,36 @@ class FrontierKernels:
         import jax.numpy as jnp
 
         if kind == "arg" and self._arg_aligned:
+            self._register_cost(kind, self._runs[kind], (tuple(args), kp))
             lo, ln = self._runs[kind](tuple(args), jnp.asarray(kp))
         else:
+            self._register_cost(kind, self._runs[kind], (*args, kp))
             lo, ln = self._runs[kind](*args, jnp.asarray(kp))
         total = int(np.asarray(ln).sum())
         return lo, ln, total
+
+    def _register_cost(
+        self, kind: str, fn, call_args: Tuple, F: Optional[int] = None
+    ) -> None:
+        """Lazy cost-ledger registration for one frontier kernel shape
+        (kernel-cache time, realized only on explicit demand).  The
+        per-kernels ``_cost_reg`` set makes the steady-state hop path
+        one local set-lookup — no global ledger lock, no meta hash, no
+        key formatting per hop."""
+        if F is None:
+            F = int(call_args[-1].shape[0])
+        if (kind, F) in self._cost_reg:
+            return
+        self._cost_reg.add((kind, F))
+        from ..utils import perf as _perf
+
+        key = f"{kind};F={F};meta={hash(self.meta) & 0xFFFFFFFF:08x}"
+        _perf.register_cost_thunk(
+            "spmv", key,
+            lambda fn=fn, avals=_perf.avals_of(call_args): fn.lower(
+                *avals
+            ).compile(),
+        )
 
     def emit(self, kind: str, tbl, lo, ln, chunk0: int, now,
              ch: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
@@ -489,6 +517,12 @@ class FrontierKernels:
         if fused is not None:
             faults.fire("lookup.dispatch")
             kp = self.pad_keys(keys)
+            self._register_cost(
+                f"hop:{kind}", fused,
+                (args[0], args[1], args[2], tbl, kp,
+                 now if hasattr(now, "dtype") else jnp.int32(now)),
+                F=int(kp.shape[0]),
+            )
             lo, ln, rows, live = fused(
                 args[0], args[1], args[2], tbl, jnp.asarray(kp), now
             )

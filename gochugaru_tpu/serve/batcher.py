@@ -62,6 +62,7 @@ import numpy as np
 from ..engine.latency import tier_for
 from ..utils import faults
 from ..utils import metrics as _metrics
+from ..utils import perf as _perf
 from ..utils import trace as _trace
 from ..utils.admission import OPEN, CostModel
 from ..utils.errors import (
@@ -441,7 +442,12 @@ class MicroBatcher:
                 now = time.perf_counter()
                 flush, reason, wait_s = self._flush_decision_locked(now)
                 if not flush:
+                    # hold-back with work queued: the wall ledger calls
+                    # this queue-wait (submissions sit while the former
+                    # deliberately holds) — reported around the wait so
+                    # the 21× question shows up as a bucket, not idle
                     self._cond.wait(wait_s)
+                    _perf.report_wall("queue_wait", now, time.perf_counter())
                     continue
                 # the injection point sits BEFORE any dequeue: a form
                 # fault leaves every submission queued — the former
@@ -451,8 +457,15 @@ class MicroBatcher:
                 except Exception:
                     self._m.inc("serve.form_faults")
                     self._cond.wait(0.002)
+                    # form-fault retry pause: attributed to formation,
+                    # not lost to idle (the chaos closure test's subject)
+                    _perf.report_wall("form", now, time.perf_counter())
                     continue
-                return self._form_locked(reason, now)
+                batch = self._form_locked(reason, now)
+                t_f1 = time.perf_counter()
+                _perf.report_wall("form", now, t_f1)
+                self._m.observe("serve.form_s", t_f1 - now)
+                return batch
 
     def _form_locked(self, reason: str, now: float) -> _FormedBatch:
         cfg = self.config
@@ -551,6 +564,12 @@ class MicroBatcher:
         if not batch.subs:
             return
         t0 = time.perf_counter()
+        # wall ledger: formed→dispatch-start is the formed batch's queue
+        # wait; the dispatch window itself reports as ``filter`` (host
+        # concat/slice/settle) with the device stages — reported by the
+        # latency path from the same stamps its budget uses — overlaying
+        # it at higher priority, so filter ends up the host-side residue
+        _perf.report_wall("queue_wait", batch.t_formed, t0)
         sp = _trace.root_span(
             "serve.dispatch",
             batch=batch.total, target=batch.target, reason=batch.reason,
@@ -654,6 +673,7 @@ class MicroBatcher:
                         UnavailableError("serve dispatch aborted"),
                         time.perf_counter(),
                     )
+            _perf.report_wall("filter", t0, time.perf_counter())
             sp.end()
 
     # -- threads ---------------------------------------------------------

@@ -22,6 +22,7 @@ import time
 from typing import Callable, Optional, TypeVar
 
 from . import metrics as _metrics
+from . import perf as _perf
 from . import trace as _trace
 from .context import Context
 from .errors import DeadlineExceededError, PermanentError, is_retriable
@@ -81,11 +82,16 @@ def retry_retriable_errors(
                 pause_s=round(pause, 6),
             )
             if pause > 0.0:
+                _tp0 = time.perf_counter()
                 if sleep is not None:
                     sleep(pause)
                 else:
                     # context-aware pause: returns early on cancellation
                     ctx.wait(pause)
+                # wall-time ledger: backoff pauses are attributed (a
+                # chaos window's retry time must not read as idle); one
+                # branch when no measurement window is armed
+                _perf.report_wall("backoff", _tp0, time.perf_counter())
             # re-check immediately after the pause: a cancellation or
             # deadline that landed during the backoff must surface before
             # the next fn() attempt

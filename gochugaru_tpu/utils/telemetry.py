@@ -22,12 +22,16 @@ Surface:
   ``application/openmetrics-text``) or a ``?openmetrics=1`` query.
 - ``render_traces(tracer)`` — the tracer ring as JSONL.
 - ``TelemetryServer`` — ``/metrics`` (exposition text), ``/traces``
-  (JSONL), ``/slo`` (burn-rate report, utils/slo.py), ``/debug/
-  incidents`` (flight-recorder bundle index; ``/debug/incidents/<id>``
-  serves one bundle as JSONL), ``/healthz`` (readiness report: breaker
-  state, admission in-flight, serve queue depth, SLO status — degraded
-  states say why instead of a flat ok).  Bound to localhost by default;
-  ``port=0`` picks an ephemeral port (read ``.port`` back).
+  (JSONL), ``/slo`` (burn-rate report, utils/slo.py), ``/perf`` (the
+  performance-attribution ledger, utils/perf.py: cost_analysis
+  entries, gathered-bytes model, pad waste, measured roofline,
+  wall-time ledger — ``?compile=1``/``?bench=1`` opt into the
+  expensive captures), ``/debug/incidents`` (flight-recorder bundle
+  index; ``/debug/incidents/<id>`` serves one bundle as JSONL),
+  ``/healthz`` (readiness report: breaker state, admission in-flight,
+  serve queue depth, SLO status — degraded states say why instead of a
+  flat ok).  Bound to localhost by default; ``port=0`` picks an
+  ephemeral port (read ``.port`` back).
 - ``client.with_telemetry(port=..., incident_dir=...)`` (client.py)
   starts one per client; ``scripts/telemetryd.py`` runs one standalone.
 """
@@ -230,8 +234,8 @@ def readiness_report(
 
 
 class TelemetryServer:
-    """``/metrics`` + ``/traces`` + ``/slo`` + ``/debug/incidents`` +
-    ``/healthz`` on a daemon thread.
+    """``/metrics`` + ``/traces`` + ``/slo`` + ``/perf`` +
+    ``/debug/incidents`` + ``/healthz`` on a daemon thread.
 
     Read-only by construction: the handlers render from the registry,
     the tracer ring, the SLO engine's cached report, and the recorder's
@@ -291,6 +295,30 @@ class TelemetryServer:
                         self._reply(
                             200, render_traces(outer._tracer),
                             "application/x-ndjson; charset=utf-8",
+                        )
+                    elif path == "/perf":
+                        from urllib.parse import parse_qs
+
+                        from . import perf as _perf
+
+                        q = parse_qs(query)
+                        # ?compile=1 realizes pending cost thunks (one
+                        # AOT compile each); ?bench=1 runs the bandwidth
+                        # microbench when no cached verdict exists —
+                        # both explicit: a scrape must never surprise a
+                        # serving process with compiles or a 100-ms
+                        # full-bandwidth burn
+                        self._reply(
+                            200,
+                            json.dumps(
+                                _perf.render_report(
+                                    outer._registry,
+                                    realize=q.get("compile") == ["1"],
+                                    bench=q.get("bench") == ["1"],
+                                ),
+                                default=repr,
+                            ),
+                            "application/json",
                         )
                     elif path == "/slo":
                         slo = _live_slo(outer._slo)

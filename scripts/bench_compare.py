@@ -40,14 +40,28 @@ import sys
 #: units where a SMALLER value is the better one
 _LOWER_BETTER_UNITS = {"ms", "s", "seconds", "mb", "mib", "bytes", "gb"}
 #: metric-name suffixes that mark lower-better numbers regardless of unit
-_LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_latency", "_bytes", "_rss_mb")
+#: (``pad_fraction``: the perf ledger's wasted-lanes share)
+_LOWER_BETTER_SUFFIXES = (
+    "_ms", "_s", "_latency", "_bytes", "_rss_mb", "pad_fraction",
+)
+#: suffixes that are HIGHER-better regardless of unit — checked FIRST,
+#: so the perf columns can't be misread by a unit heuristic
+#: (``achieved_gbps`` must not fall into the "gb" lower-better unit
+#: bucket; ``roofline_frac`` closer to the ceiling is the win)
+_HIGHER_BETTER_SUFFIXES = ("achieved_gbps", "roofline_frac")
 #: extra fields of a metric line promoted to their own comparison rows
-_PROMOTED_FIELDS = ("true_rate", "p99_ms")
+#: (the perf-attribution columns ride headline rows as extra fields —
+#: promoting them guards the roofline trajectory from round one)
+_PROMOTED_FIELDS = (
+    "true_rate", "p99_ms", "achieved_gbps", "roofline_frac", "pad_fraction",
+)
 #: boolean/one-shot rows that carry no trajectory signal
 _SKIP_UNITS = {"ok", "capture", "keys"}
 
 
 def lower_is_better(name: str, unit: str) -> bool:
+    if any(name.endswith(s) for s in _HIGHER_BETTER_SUFFIXES):
+        return False
     u = unit.strip().lower()
     if u in _LOWER_BETTER_UNITS:
         return True

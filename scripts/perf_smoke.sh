@@ -1,0 +1,165 @@
+#!/usr/bin/env bash
+# Performance-attribution smoke: the perf ledger (utils/perf.py) closes
+# end to end on the CPU proxy —
+#   1. the roofline microbench measures a bandwidth ceiling and caches
+#      it per backend fingerprint;
+#   2. /perf serves the ledger: gathered-bytes model (per level / per
+#      table), captured cost_analysis entries (latency pin at pin time,
+#      batch-path program realized via ?compile=1), pad-waste stats,
+#      the cached roofline, and the last wall-time window;
+#   3. the bench columns (achieved_gbps / roofline_frac / pad_fraction)
+#      derive from the measured ceiling;
+#   4. the wall-time ledger closes (buckets sum to the window) under
+#      real serving traffic;
+#   5. an incident bundle carries the perf context state.
+# Prints PERF-SMOKE-OK on success — the CI-runnable proof, mirroring
+# scripts/slo_smoke.sh.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+: "${PERF_SMOKE_TIMEOUT_S:=420}"
+
+ROOFLINE_TMP="$(mktemp -u /tmp/gochugaru_roofline_smoke_XXXX.json)"
+trap 'rm -f "$ROOFLINE_TMP"' EXIT
+
+timeout -k 10 "${PERF_SMOKE_TIMEOUT_S}" env JAX_PLATFORMS=cpu \
+  GOCHUGARU_ROOFLINE_CACHE_PATH="$ROOFLINE_TMP" python - <<'EOF'
+import json
+import os
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+
+from gochugaru_tpu import consistency, rel
+from gochugaru_tpu.client import (
+    new_tpu_evaluator, with_latency_mode, with_telemetry,
+)
+from gochugaru_tpu.utils import metrics, perf, trace
+from gochugaru_tpu.utils.context import background
+
+D = tempfile.mkdtemp(prefix="gochugaru_perf_incidents_")
+m = metrics.default
+c = new_tpu_evaluator(
+    with_latency_mode(), with_telemetry(port=0, incident_dir=D)
+)
+url = c.telemetry.url
+ctx = background()
+c.write_schema(ctx, """
+definition user {}
+definition doc { relation reader: user  permission read = reader }
+""")
+txn = rel.Txn()
+for i in range(256):
+    txn.create(rel.must_from_triple(f"doc:d{i}", "reader", f"user:u{i % 32}"))
+c.write(ctx, txn)
+
+# -- 1: the roofline microbench (fresh cache path → a real measurement) --
+bw = perf.measure_bandwidth(size_mb=16, reps=3)
+assert bw["gbps"] > 0 and not bw["cached"], bw
+bw2 = perf.measure_bandwidth()
+assert bw2["cached"], "second read must hit the fingerprint cache"
+print(f"# roofline: {bw['gbps']} GB/s ({bw['fingerprint']})")
+
+# -- pin + batch-path programs into the cost ledger ----------------------
+qs = [rel.must_from_triple(f"doc:d{i}", "read", f"user:u{i % 32}")
+      for i in range(64)]
+for _ in range(3):
+    got = c.check(ctx, consistency.full(), *qs)
+assert all(bool(v) for v in got), got
+big = [rel.must_from_triple(f"doc:d{i % 256}", "read", f"user:u{i % 32}")
+       for i in range(8192)]
+c.check(ctx, consistency.full(), *big)  # > top tier → throughput path
+kinds = {e["kind"] for e in perf.cost_entries()}
+assert "latency_pin" in kinds, kinds
+assert "batch" in kinds, kinds  # pending thunk registered at cache time
+
+# -- 4: the wall-time ledger closes under serving traffic ----------------
+ledger = perf.WallLedger().start()
+with c.with_serving() as h:
+    futs = [h.submit(ctx, *qs[:16], client_id=w % 4) for w in range(64)]
+    for f in futs:
+        f.result(timeout=60.0)
+wall = ledger.stop()
+assert wall["closure_frac"] >= 0.95, wall
+assert wall["dropped"] == 0 and wall["named_frac"] > 0, wall
+assert wall["seconds"]["kernel"] > 0, wall
+print("# wall ledger: " + " ".join(
+    f"{b}={wall['fracs'][b]:.1%}" for b in (*perf.WALL_BUCKETS, "idle")
+    if wall["fracs"][b] > 0) + f" closure={wall['closure_frac']:.1%}")
+
+# -- 2: /perf serves the ledger (+ ?compile=1 realizes the batch thunk) --
+def get(path):
+    with urllib.request.urlopen(url + path, timeout=60) as r:
+        return json.loads(r.read().decode())
+
+rep = get("/perf?compile=1")
+assert rep["bytes_model"] and rep["bytes_model"]["total"] > 0, rep
+assert rep["bytes_model"]["per_table"], rep
+batch_entries = [e for e in rep["cost"] if e["kind"] == "batch"]
+assert batch_entries and not any(e.get("pending") for e in batch_entries), (
+    "batch-path cost thunk not realized by ?compile=1"
+)
+realized = [e for e in rep["cost"]
+            if e.get("flops") is not None or e.get("unavailable")]
+assert realized, rep["cost"]
+assert rep["pad"]["total_lanes"] > 0, rep["pad"]
+assert rep["roofline"] and rep["roofline"]["gbps"] > 0, rep["roofline"]
+assert rep["wall"] and rep["wall"]["closure_frac"] >= 0.95, rep["wall"]
+print(f"# /perf: {len(rep['cost'])} cost entries "
+      f"(batch flops={batch_entries[0].get('flops')}), "
+      f"pad_fraction={rep['pad']['pad_fraction']}")
+
+# -- 3: the bench columns derive from the measured ceiling ---------------
+from benchmarks.common import roofline_columns
+
+snap = c.store.snapshot_for(consistency.full())
+eng = c._engine_for(snap)
+ds = c._dsnap_for(eng, snap)
+cols = roofline_columns(1_000_000.0, dsnap=ds)
+for k in ("bytes_per_check", "achieved_gbps", "roofline_gbps",
+          "roofline_frac"):
+    assert k in cols, cols
+assert cols["roofline_gbps"] == bw["gbps"], (cols, bw)
+assert cols["achieved_gbps"] > 0 and 0 < cols["roofline_frac"] < 1, cols
+print(f"# bench columns: {cols}")
+
+# -- 5: incident bundles carry the perf context --------------------------
+iid = trace.trigger_incident("perf.smoke")
+assert iid, "incident did not fire"
+c.recorder.flush()
+bundle = None
+t0 = time.time()
+while bundle is None and time.time() - t0 < 20:
+    hits = [f for f in os.listdir(D) if "perf.smoke" in f]
+    if hits:
+        bundle = os.path.join(D, sorted(hits)[0])
+        break
+    time.sleep(0.2)
+assert bundle, f"no perf.smoke bundle under {D}"
+head = json.loads(open(bundle).readline())
+pctx = next((v for k, v in head["context"].items() if k.startswith("perf")),
+            None)
+assert pctx, head["context"].keys()
+assert pctx["bytes_per_check"] and pctx["pad"]["total_lanes"] > 0, pctx
+assert pctx["roofline_gbps"], pctx
+print(f"# incident context: bytes/check={pctx['bytes_per_check']} "
+      f"roofline={pctx['roofline_gbps']} GB/s")
+
+print(json.dumps({
+    "metric": "perf_smoke", "value": 1, "unit": "ok", "vs_baseline": 1.0,
+    "roofline_gbps": bw["gbps"],
+    "bytes_per_check": rep["bytes_model"]["total"],
+    "pad_fraction": rep["pad"]["pad_fraction"],
+    "wall_closure_frac": wall["closure_frac"],
+    "cost_entries": len(rep["cost"]),
+    "note": "microbench + /perf ledger + bench columns + wall closure"
+            " + incident perf context",
+}))
+print(f"PERF-SMOKE-OK gbps={bw['gbps']} "
+      f"bytes_per_check={rep['bytes_model']['total']} "
+      f"wall_closure={wall['closure_frac']}")
+EOF
+rc=$?
+exit "$rc"

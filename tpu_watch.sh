@@ -43,6 +43,15 @@ while true; do
     if grep -q '^{' "tpu_attempts/bench_${TS}.out"; then
       touch tpu_attempts/TPU_CONTACT
       SLEEP=900
+      # roofline note (perf ledger): measure the window's HBM bandwidth
+      # ceiling once (cached per backend fingerprint) and dump it next
+      # to the capture — every config-2 row above already carries
+      # achieved_gbps/roofline_frac against this denominator, so the
+      # first silicon number ships its roofline note mechanically
+      mkdir -p "tpu_attempts/trace_${TS}"
+      timeout 180 python -m gochugaru_tpu.utils.perf --refresh \
+        > "tpu_attempts/trace_${TS}/roofline.json" 2>> tpu_attempts/log.txt
+      log "roofline rc=$? → tpu_attempts/trace_${TS}/roofline.json"
       # priority 2: profiler trace of the aligned kernel
       timeout 420 python benchmarks/bench_tpu_harvest.py \
         --trace "tpu_attempts/trace_${TS}" \
