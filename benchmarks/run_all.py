@@ -208,6 +208,13 @@ def main() -> int:
         ["bash", "scripts/perf_smoke.sh"],
         600,
     ))
+    configs.append((
+        "17 — verdict-cache smoke (oracle parity incl. cached answers,"
+        " cache-off bitwise parity, hit-rate floor, chaos on"
+        " cache.lookup)",
+        ["bash", "scripts/cache_smoke.sh"],
+        600,
+    ))
     if not q:
         # Leopard-scale CPU proxy (VERDICT r04 item 3): the same Watch
         # re-index loop at a 100M-edge base — BASELINE config 5's

@@ -45,6 +45,7 @@ from .consistency import OVERLAP_KEY, Strategy
 from .engine.device import DeviceEngine, DeviceSnapshot
 from .engine.oracle import Oracle, SnapshotOracle, T, U
 from .engine.plan import EngineConfig
+from .engine import vcache as _vcache
 from .rel.filter import Filter, PreconditionedFilter
 from .rel.relationship import (
     Relationship,
@@ -116,6 +117,7 @@ class _Options:
         self.trace_slow_ms: Optional[float] = 100.0
         self.incident_dir: Optional[str] = None
         self.slos = None  # None → utils/slo.default_slos(); () disables
+        self.verdict_cache = None  # VerdictCache | max_bytes int | None
 
 
 Option = Callable[[_Options], None]
@@ -198,6 +200,25 @@ def with_mesh(mesh, *, partitioned: bool = False) -> Option:
     def opt(o: _Options) -> None:
         o.mesh = mesh
         o.mesh_partitioned = partitioned
+
+    return opt
+
+
+def with_verdict_cache(cache=True) -> Option:
+    """Enable the revision-pinned verdict cache (engine/vcache.py) on
+    this client's check paths: definite verdicts key on (snapshot
+    revision, slot, resource, subject, query-context fingerprint) under
+    a byte-bounded LRU, and the consistency strategy of each call is the
+    read policy — ``snapshot``/``at_least`` hit the resolved revision's
+    shard, ``min_latency`` the freshest resident one, ``full`` bypasses
+    entirely.  Caveated verdicts that read live query context are never
+    cached; time-gated verdicts cache with a pinned now_us.
+
+    ``cache`` may be ``True`` (default 64 MB cache), an int byte budget,
+    or a prebuilt ``VerdictCache`` (shared between clients)."""
+
+    def opt(o: _Options) -> None:
+        o.verdict_cache = cache
 
     return opt
 
@@ -288,7 +309,11 @@ class Client:
         o = _Options()
         for opt in opts:
             opt(o)
-        self._store = o.store or Store()
+        # identity check, NOT truthiness: Store.__len__ counts only the
+        # live-dict rows, so a store populated purely through columnar
+        # imports is falsy — `o.store or Store()` silently dropped a
+        # shared store and built a fresh empty one
+        self._store = o.store if o.store is not None else Store()
         self._overlap_required = o.overlap_required
         self._engine_config = o.engine_config
         self._use_device = o.use_device
@@ -308,6 +333,9 @@ class Client:
         #: dispatch admission: bounded in-flight gate + deadline budget +
         #: latency-path circuit breaker (utils/admission.py)
         self._admission = AdmissionController(o.admission)
+        #: revision-pinned verdict cache (engine/vcache.py) — None keeps
+        #: every check path byte-for-byte on the pre-cache code
+        self._vcache = self._make_vcache(o.verdict_cache)
         #: telemetry endpoint (utils/telemetry.py), via with_telemetry()
         self.telemetry = None
         #: flight recorder + SLO engine (armed by with_telemetry)
@@ -361,6 +389,12 @@ class Client:
                     # roofline, last wall-time window) — cheap by
                     # contract: no compiles, no microbench
                     "perf": _perf.context_state,
+                    # verdict-cache state (read at capture time, so a
+                    # cache attached later by with_serving(cache=...)
+                    # still shows up in bundles)
+                    "vcache": lambda c=self: (
+                        None if c._vcache is None else c._vcache.stats()
+                    ),
                 },
                 cap=self.TELEMETRY_CONTEXT_MAX,
             )
@@ -391,6 +425,19 @@ class Client:
                 port=o.telemetry_port, host=o.telemetry_host,
                 registry=self._metrics, slo=self.slo, recorder=rec,
             )
+
+    @staticmethod
+    def _make_vcache(spec):
+        """Normalize the with_verdict_cache / with_serving(cache=...)
+        spec: None/False → off, True → default cache, int → byte
+        budget, VerdictCache → shared instance."""
+        if spec is None or spec is False:
+            return None
+        if spec is True:
+            return _vcache.VerdictCache()
+        if isinstance(spec, int):
+            return _vcache.VerdictCache(max_bytes=spec)
+        return spec
 
     # -- store access (shared by watch etc.) -----------------------------
     @property
@@ -444,14 +491,19 @@ class Client:
         return v
 
     @classmethod
-    def _lru_put(cls, cache: Dict[int, Any], key: int, v: Any) -> None:
+    def _lru_put(cls, cache: Dict[int, Any], key: int, v: Any) -> List[int]:
         """Insert + evict least-recently-USED (round-2 Weak #5: evicting
         the lowest revision thrashed Snapshot-pinned readers under head
         writes — a pinned generation stays warm because every read
-        refreshes it)."""
+        refreshes it).  Returns the evicted keys so dependent caches
+        (the verdict cache's revision shards) can drop with them."""
         cache[key] = v
+        evicted: List[int] = []
         while len(cache) > cls.SNAPSHOT_CACHE_MAX:
-            cache.pop(next(iter(cache)))
+            k = next(iter(cache))
+            cache.pop(k)
+            evicted.append(k)
+        return evicted
 
     def _dsnap_for(self, engine: DeviceEngine, snap: Snapshot) -> DeviceSnapshot:
         with self._lock:
@@ -475,7 +527,13 @@ class Client:
                     ds = engine.prepare_snapshot_partitioned(snap, prev=prev)
                 else:
                     ds = engine.prepare(snap, prev=prev)
-                self._lru_put(self._dsnap_cache, snap.revision, ds)
+                evicted = self._lru_put(self._dsnap_cache, snap.revision, ds)
+                # dsnap-LRU eviction drops the matching verdict shard:
+                # a no-longer-resident revision's cached verdicts would
+                # only pin bytes (pinned readers fail upstream anyway)
+                if self._vcache is not None:
+                    for r in evicted:
+                        self._vcache.drop_revision(r)
             return ds
 
     def _oracle_for(self, snap: Snapshot) -> Oracle:
@@ -598,10 +656,114 @@ class Client:
             snap = self._store.snapshot_for(cs)
             dsp.set_attr("revision", int(snap.revision))
             return self._evaluate_rels(
-                snap, rels, latency=self._latency_mode, span=dsp
+                snap, rels, latency=self._latency_mode, span=dsp, cs=cs
             )
 
     def _evaluate_rels(
+        self,
+        snap: Snapshot,
+        rels: List[Relationship],
+        *,
+        latency: bool,
+        span=_trace.NOOP,
+        cs: Optional[Strategy] = None,
+        dedup: bool = False,
+    ) -> List[bool]:
+        """Evaluate a formed batch at one snapshot, through the verdict
+        cache and in-batch dedup when enabled: cache hits answer without
+        touching the evaluator (read policy = the call's consistency
+        strategy, engine/vcache.policy_for), remaining unique rows
+        dispatch once (``dedup``, the serving batcher's flag) and
+        verdicts fan back out, definite results populate the revision's
+        shard.  Items carrying live query caveat context NEVER read or
+        write the cache.  With no cache attached and dedup off this is
+        byte-for-byte the pre-cache path (``_evaluate_rels_direct``)."""
+        vc = self._vcache
+        pol = _vcache.policy_for(cs) if vc is not None else _vcache.CACHE_OFF
+        if not (pol.read or pol.write) and not dedup:
+            return self._evaluate_rels_direct(
+                snap, rels, latency=latency, span=span
+            )
+        import time as _time
+
+        B = len(rels)
+        keys = [_vcache.rel_key(r) for r in rels]
+        # live-context items (non-empty query caveat_context) bypass the
+        # cache entirely — their caveat may read the live context
+        cacheable = [k[1] == _vcache.EMPTY_CTX_FP for k in keys]
+        out: List[Optional[bool]] = [None] * B
+        now_us = int(_time.time() * 1_000_000)
+        if pol.read:
+            vals = vc.lookup_rels(
+                snap.revision,
+                [k if cacheable[i] else None for i, k in enumerate(keys)],
+            )
+            for i, v in enumerate(vals):
+                if v is not None:
+                    out[i] = v[0]
+        pend = [i for i in range(B) if out[i] is None]
+        nh = B - len(pend)
+        if nh:
+            span.event("cache.hits", items=nh)
+            span.set_attr("cache_hits", nh)
+        if not pend:
+            return [bool(v) for v in out]
+        if dedup and len(pend) > 1:
+            first: Dict[Any, int] = {}
+            uidx: List[int] = []
+            inverse: List[int] = []
+            for i in pend:
+                u = first.get(keys[i])
+                if u is None:
+                    u = first[keys[i]] = len(uidx)
+                    uidx.append(i)
+                inverse.append(u)
+            dups = len(pend) - len(uidx)
+            if dups:
+                self._metrics.inc("dedup.batch_dups", dups)
+        else:
+            uidx = pend
+            inverse = list(range(len(pend)))
+        try:
+            sub = self._evaluate_rels_direct(
+                snap, [rels[i] for i in uidx], latency=latency, span=span
+            )
+        except BulkCheckItemError as e:
+            raise self._remap_bulk_error(
+                e, out, pend, inverse, lambda vs: list(vs)
+            ) from (e.__cause__ or e)
+        for j, i in enumerate(pend):
+            out[i] = bool(sub[inverse[j]])
+        if pol.write:
+            vc.insert_rels(
+                snap.revision,
+                [(keys[i], sub[j]) for j, i in enumerate(uidx)
+                 if cacheable[i]],
+                now_us,
+            )
+        return [bool(v) for v in out]
+
+    @staticmethod
+    def _remap_bulk_error(e, out, pend, inverse, as_seq):
+        """Translate a unique-space BulkCheckItemError (from the deduped
+        direct dispatch) back to caller-space: unique verdicts [0,
+        e.index) scatter onto their duplicate rows, and the error is
+        re-anchored at the first caller row that is NOT fully resolved
+        (cache hits resolved rows past it stay unreported — the prefix
+        contract only promises rows before the index)."""
+        part = e.results
+        first_bad = None
+        for j, i in enumerate(pend):
+            if inverse[j] < e.index:
+                out[i] = bool(part[inverse[j]])
+            elif first_bad is None or i < first_bad:
+                first_bad = i
+        if first_bad is None:  # defensive: nothing unresolved
+            first_bad = pend[-1]
+        prefix = as_seq(out[:first_bad])
+        return BulkCheckItemError(first_bad, prefix, e.__cause__ or e)
+
+    def _evaluate_rels_direct(
         self,
         snap: Snapshot,
         rels: List[Relationship],
@@ -719,6 +881,102 @@ class Client:
         *,
         latency: bool,
         span=_trace.NOOP,
+        cs: Optional[Strategy] = None,
+        dedup: bool = False,
+    ) -> np.ndarray:
+        """Columnar mirror of ``_evaluate_rels``' cache/dedup layer.
+        The columnar path carries no live query context by construction,
+        so every verdict is cacheable (expiry gates pin now_us on the
+        entry).  Cache hits and duplicate rows never reach the device —
+        only the unique misses dispatch, at whatever (smaller) pow2 tier
+        they land on.  With no cache and dedup off this is byte-for-byte
+        the pre-cache path."""
+        vc = self._vcache
+        pol = _vcache.policy_for(cs) if vc is not None else _vcache.CACHE_OFF
+        if not (pol.read or pol.write) and not dedup:
+            return self._evaluate_columns_direct(
+                snap, q_res, q_perm, q_subj, latency=latency, span=span
+            )
+        import time as _time
+
+        B = int(q_res.shape[0])
+        keys = _vcache.pack_cols(q_perm, q_res, q_subj)
+        res = np.zeros(B, bool)
+        resolved = np.zeros(B, bool)
+        now_us = int(_time.time() * 1_000_000)
+        if pol.read:
+            arr = vc.lookup_cols(snap.revision, keys)
+            if arr is not None:
+                resolved = arr >= 0
+                res = (arr & 1).astype(bool)
+                res[~resolved] = False
+        pend = np.nonzero(~resolved)[0]
+        nh = B - int(pend.shape[0])
+        if nh:
+            span.event("cache.hits", items=nh)
+            span.set_attr("cache_hits", nh)
+        if pend.shape[0] == 0:
+            return res
+        if dedup and pend.shape[0] > 1:
+            if isinstance(keys, np.ndarray):
+                _, uix, inverse = np.unique(
+                    keys[pend], return_index=True, return_inverse=True
+                )
+                uidx = pend[uix]
+            else:
+                first: Dict[Any, int] = {}
+                ulist: List[int] = []
+                inverse = np.empty(pend.shape[0], np.int64)
+                for j, i in enumerate(pend):
+                    k = keys[i]
+                    u = first.get(k)
+                    if u is None:
+                        u = first[k] = len(ulist)
+                        ulist.append(int(i))
+                    inverse[j] = u
+                uidx = np.asarray(ulist, np.int64)
+            dups = int(pend.shape[0] - uidx.shape[0])
+            if dups:
+                self._metrics.inc("dedup.batch_dups", dups)
+        else:
+            uidx = pend
+            inverse = np.arange(pend.shape[0])
+        try:
+            sub = self._evaluate_columns_direct(
+                snap, np.ascontiguousarray(q_res[uidx]),
+                np.ascontiguousarray(q_perm[uidx]),
+                np.ascontiguousarray(q_subj[uidx]),
+                latency=latency, span=span,
+            )
+        except BulkCheckItemError as e:
+            # unique-space → caller-space: scatter the resolved unique
+            # prefix onto its duplicates, re-anchor at the first
+            # unresolved caller row (everything before it IS resolved)
+            part = np.asarray(e.results, bool)
+            ok = inverse < e.index
+            res[pend[ok]] = part[inverse[ok]]
+            resolved[pend[ok]] = True
+            first_bad = int(np.nonzero(~resolved)[0][0])
+            raise BulkCheckItemError(
+                first_bad, res[:first_bad], e.__cause__ or e
+            ) from (e.__cause__ or e)
+        res[pend] = np.asarray(sub, bool)[inverse]
+        if pol.write:
+            ku = keys[uidx] if isinstance(keys, np.ndarray) else [
+                keys[int(i)] for i in uidx
+            ]
+            vc.insert_cols(snap.revision, ku, np.asarray(sub, bool), now_us)
+        return res
+
+    def _evaluate_columns_direct(
+        self,
+        snap: Snapshot,
+        q_res: np.ndarray,
+        q_perm: np.ndarray,
+        q_subj: np.ndarray,
+        *,
+        latency: bool,
+        span=_trace.NOOP,
     ) -> np.ndarray:
         """The columnar mirror of ``_evaluate_rels`` for the serving
         batcher: pre-interned int32 columns straight onto the pinned
@@ -815,7 +1073,7 @@ class Client:
     # Continuous-batching serving front-end (serve/batcher.py)
     # ------------------------------------------------------------------
     def with_serving(
-        self, cs: Optional[Strategy] = None, config=None
+        self, cs: Optional[Strategy] = None, config=None, cache=None
     ) -> "Any":
         """Open a continuous-batching serving handle over this client:
         an async micro-batch former that coalesces concurrent Check /
@@ -835,12 +1093,25 @@ class Client:
         ``min_latency()``): coalesced requests in one formed batch
         evaluate at one snapshot, the same revision discipline the
         reference's bulk RPCs have.  Close the handle (or use it as a
-        context manager) to drain and stop its threads."""
+        context manager) to drain and stop its threads.
+
+        ``cache`` arms the revision-pinned verdict cache on this
+        client's evaluate paths (``True`` = default 64 MB, an int byte
+        budget, a shared ``VerdictCache``, or ``False`` to force this
+        handle cache-off even when the client carries one); the
+        handle's pinned strategy is the read policy (``full()``
+        bypasses).  In-flight/in-batch check deduplication is governed
+        by ``ServeConfig.dedup`` and is on by default."""
         from .serve import ServingHandle
 
+        if cache is not None and cache is not False:
+            # True reuses an already-attached cache; an explicit
+            # instance or byte budget replaces it
+            if self._vcache is None or cache is not True:
+                self._vcache = self._make_vcache(cache)
         return ServingHandle(
             self, cs if cs is not None else _consistency.min_latency(),
-            config,
+            config, use_cache=cache is not False,
         )
 
     # ------------------------------------------------------------------

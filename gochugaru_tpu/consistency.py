@@ -14,6 +14,14 @@ snapshot generation of the tuple store (SURVEY.md §5 "Checkpoint / resume").
 - ``at_least(rev)`` — at least as fresh as ``rev``; read-after-write
                       (consistency/consistency.go:54-62).
 - ``snapshot(rev)`` — exactly ``rev`` (consistency/consistency.go:69-77).
+
+The strategy is also the **verdict cache's read policy**
+(engine/vcache.policy_for): a check made with ``snapshot``/``at_least``
+reads and populates the cache shard of the exact revision the store
+resolved, ``min_latency`` hits the freshest resident revision's shard,
+and ``full`` bypasses the cache entirely — cached verdicts are always
+revision-exact, so no strategy can ever observe a verdict from a
+revision it would not have evaluated at.
 """
 
 from __future__ import annotations

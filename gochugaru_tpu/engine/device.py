@@ -690,6 +690,19 @@ class DeviceEngine:
         #: context-free qctx singletons (host + device forms)
         self._empty_qctx_np: Optional[Dict[str, np.ndarray]] = None
         self._empty_qctx_jnp = None
+        #: per-client string→node-id memo over the interner (bounded):
+        #: the interner's own dict spans EVERY node in the store, so a
+        #: lookup under zipf-skewed traffic thrashes CPU cache on a
+        #: structure ~10^6× larger than the hot working set — this map
+        #: holds just the hot keys.  Sound because node ids are append-
+        #: only and stable; MISSES are never memoized (an unknown object
+        #: can be interned by a later write, so -1 is not stable)
+        self._intern_memo: Dict[Tuple[str, str], int] = {}
+        self._intern_memo_src = None  # the Interner the memo is valid for
+
+    #: hot-key memo capacity; on overflow the map clears and re-warms
+    #: (zipf traffic repopulates the head in a few batches)
+    INTERN_MEMO_MAX = 1 << 16
 
     #: every per-edge/lookup column _host_arrays emits (the sharded engine
     #: derives its shard_map specs from this — keep in lockstep, enforced
@@ -1052,10 +1065,36 @@ class DeviceEngine:
                         ctx_rows.append(r.caveat_context)
                     q_ctx[i] = at
 
+        if self._intern_memo_src is not interner:
+            # memoized ids are only valid against the interner that
+            # assigned them — a snapshot from a different store resets
+            # the memo (id identity, not equality: interners only grow)
+            self._intern_memo = {}
+            self._intern_memo_src = interner
+        memo = self._intern_memo
+        memo_get = memo.get
+        lookup = interner.lookup
+        memo_hits = 0
+        memo_max = self.INTERN_MEMO_MAX
+
+        def node_of(tname: str, oid: str) -> int:
+            nonlocal memo_hits
+            k = (tname, oid)
+            v = memo_get(k)
+            if v is not None:
+                memo_hits += 1
+                return v
+            v = lookup(tname, oid)
+            if v >= 0:
+                if len(memo) >= memo_max:
+                    memo.clear()
+                memo[k] = v
+            return v
+
         for i, r in enumerate(rels):
-            q_res[i] = interner.lookup(r.resource_type, r.resource_id)
+            q_res[i] = node_of(r.resource_type, r.resource_id)
             q_perm[i] = slot_of.get(r.resource_relation, -1)
-            q_subj[i] = interner.lookup(r.subject_type, r.subject_id)
+            q_subj[i] = node_of(r.subject_type, r.subject_id)
             if r.subject_relation:
                 srel = slot_of.get(r.subject_relation)
                 if srel is None:
@@ -1077,6 +1116,8 @@ class DeviceEngine:
                 and r.subject_relation != ""
             )
 
+        if memo_hits:
+            metrics.default.inc("intern.memo_hits", memo_hits)
         # unique (subject, query-context) rows for Phase A — context is part
         # of the key because caveat gates make closures context-dependent
         subj_key = np.stack([q_subj, q_srel, q_wc, q_ctx], axis=1)

@@ -59,6 +59,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..engine import vcache as _vcache
 from ..engine.latency import tier_for
 from ..utils import faults
 from ..utils import metrics as _metrics
@@ -98,42 +99,79 @@ class ServeConfig:
     form_queue_depth: int = 1
     #: seconds close() waits for the drain before rejecting leftovers
     drain_timeout_s: float = 10.0
+    #: check deduplication (engine/vcache.py): identical checks in one
+    #: formed batch dispatch once (the evaluate layer collapses them and
+    #: fans verdicts back out), a submission duplicating a batch already
+    #: in flight parks on that batch's resolution (no queue slot, no
+    #: tier lane), and the residual unique misses land on the SMALLEST
+    #: covering pinned tier — effective tier occupancy counts unique
+    #: work and padding shrinks with it, while the former keeps forming
+    #: the next batch from the queue in parallel.  False restores the
+    #: pre-dedup former byte-for-byte (the bench A/B baseline lever)
+    dedup: bool = True
+
+
+#: guards lazy waiter-event creation on SubmitFuture (module-global: a
+#: per-future lock would put the allocation back on the submit path)
+_FUT_EV_LOCK = threading.Lock()
 
 
 class SubmitFuture:
     """The coalesced-result handle one submission awaits.  Resolves
     exactly once (a double resolve is a bug, asserted); ``result``
-    honors context cancellation/deadline while waiting."""
+    honors context cancellation/deadline while waiting.
 
-    __slots__ = ("_ev", "_value", "_error", "t_submit", "t_done")
+    The wakeup Event is created LAZILY by the first waiter: a
+    threading.Event costs ~8µs to build, and at serving rates most
+    futures resolve before anyone blocks on them — the submit path
+    (front-end critical on the 1-core proxy) must not pay for a wait
+    that usually never happens."""
+
+    __slots__ = ("_done", "_ev", "_value", "_error", "t_submit", "t_done")
 
     def __init__(self, t_submit: float) -> None:
-        self._ev = threading.Event()
+        self._done = False
+        self._ev: Optional[threading.Event] = None
         self._value = None
         self._error: Optional[BaseException] = None
         self.t_submit = t_submit
         self.t_done: Optional[float] = None
 
     def done(self) -> bool:
-        return self._ev.is_set()
+        return self._done
+
+    def _settle(self) -> None:
+        self._done = True
+        ev = self._ev
+        if ev is None:
+            # a waiter may be creating its event right now: re-check
+            # under the same lock the waiter holds while creating it
+            with _FUT_EV_LOCK:
+                ev = self._ev
+        if ev is not None:
+            ev.set()
 
     def _resolve(self, value, t_done: float) -> None:
-        assert not self._ev.is_set(), "future resolved twice"
+        assert not self._done, "future resolved twice"
         self._value = value
         self.t_done = t_done
-        self._ev.set()
+        self._settle()
 
     def _reject(self, err: BaseException, t_done: float) -> None:
-        assert not self._ev.is_set(), "future resolved twice"
+        assert not self._done, "future resolved twice"
         self._error = err
         self.t_done = t_done
-        self._ev.set()
+        self._settle()
 
     def result(self, ctx=None, timeout: Optional[float] = None):
         """Block until the coalesced answer (or its error) arrives.
         ``ctx`` cancellation/deadline interrupts the wait."""
         deadline = None if timeout is None else time.monotonic() + timeout
-        while not self._ev.is_set():
+        if not self._done and self._ev is None:
+            with _FUT_EV_LOCK:
+                if self._ev is None:
+                    self._ev = threading.Event()
+        while not self._done:
             if ctx is not None:
                 err = ctx.err()
                 if err is not None:
@@ -228,6 +266,7 @@ class MicroBatcher:
         dispatch_cols: Optional[Callable] = None,
         registry: Optional[_metrics.Metrics] = None,
         start: bool = True,
+        inflight_dedup: bool = True,
     ) -> None:
         self.config = config or ServeConfig()
         self.tiers = tuple(sorted(int(t) for t in tiers))
@@ -242,6 +281,13 @@ class MicroBatcher:
         self._dispatch_rels = dispatch_rels
         self._dispatch_cols = dispatch_cols
         self._m = registry or _metrics.default
+        #: cross-batch singleflight window (engine/vcache.py) — only
+        #: when dedup is on AND the pinned strategy tolerates serving a
+        #: duplicate from its in-flight twin (everything but Full)
+        self._sf = (
+            _vcache.Singleflight(self._m)
+            if (self.config.dedup and inflight_dedup) else None
+        )
         #: occupancy histogram buckets: the ladder itself plus half/
         #: quarter marks, so "flushed at 61 of 256" is visible
         self._fill_buckets = tuple(sorted(
@@ -324,6 +370,27 @@ class MicroBatcher:
             # the SAME cost model + counters as the caller-formed path
             if self._adm is not None:
                 self._adm.check_deadline(ctx, span=span)
+        sf = self._sf
+        if sf is not None and sf.active:
+            # cross-batch singleflight: a submission whose rows ALL
+            # duplicate the currently-dispatching batch's checks parks
+            # on that batch's resolution — no queue slot, no tier lane.
+            # One Python-scalar probe rules out the common non-dup case
+            # before any per-row key packing happens
+            if kind == "cols":
+                k0 = _vcache.pack_one(
+                    int(cols[1][0]), int(cols[0][0]), int(cols[2][0])
+                )
+            else:
+                k0 = _vcache.rel_key(rels[0])
+            if sf.probe(k0):
+                if kind == "cols":
+                    keys = _vcache.pack_cols(cols[1], cols[0], cols[2])
+                else:
+                    keys = [_vcache.rel_key(r) for r in rels]
+                if sf.try_park(keys, fut, kind, n):
+                    span.event("serve.dedup_parked", checks=n)
+                    return fut
         shed_depth = None
         with self._cond:
             if self._closed:
@@ -559,7 +626,13 @@ class MicroBatcher:
         settle every future exactly once.  Dispatch failures classify
         onto the retry taxonomy and reject the batch's futures — the
         submitters' envelopes re-submit, so a transient fault (or the
-        breaker tripping mid-queue) loses nothing."""
+        breaker tripping mid-queue) loses nothing.
+
+        With dedup on, the batch's key→row map opens a singleflight
+        WINDOW for the duration of the dispatch: submissions arriving
+        meanwhile whose rows all duplicate in-flight checks park on it
+        and settle here, from the same verdicts (engine/vcache.py
+        Singleflight) — the window closes on every exit path."""
         m = self._m
         if not batch.subs:
             return
@@ -576,6 +649,9 @@ class MicroBatcher:
             kind=batch.kind, submissions=len(batch.subs),
             occupancy=round(batch.total / batch.target, 4),
         )
+        sf = self._sf
+        window_open = False
+        verdicts = None
         try:
             try:
                 faults.fire("batcher.dispatch")
@@ -587,11 +663,35 @@ class MicroBatcher:
                         q_res = np.concatenate([s.cols[0] for s in batch.subs])
                         q_perm = np.concatenate([s.cols[1] for s in batch.subs])
                         q_subj = np.concatenate([s.cols[2] for s in batch.subs])
+                    if sf is not None:
+                        keys = _vcache.pack_cols(q_perm, q_res, q_subj)
+                        if isinstance(keys, np.ndarray):
+                            ks = np.sort(keys)
+                            # unique-work count off the same sort the
+                            # window probes use — effective occupancy
+                            unique = int(
+                                1 + (ks[1:] != ks[:-1]).sum()
+                            ) if ks.shape[0] else 0
+                            sf.open_cols(keys, ks)
+                        else:
+                            key_map = dict(zip(keys, range(len(keys))))
+                            unique = len(key_map)
+                            sf.open_map(key_map)
+                        sp.set_attr("unique", unique)
+                        m.inc("serve.unique_checks", unique)
+                        window_open = True
                     verdicts = self._dispatch_cols(
                         q_res, q_perm, q_subj, use_latency, sp
                     )
                 else:
                     rels = [r for s in batch.subs for r in s.rels]
+                    if sf is not None:
+                        kl = [_vcache.rel_key(r) for r in rels]
+                        key_map = dict(zip(kl, range(len(kl))))
+                        sp.set_attr("unique", len(key_map))
+                        m.inc("serve.unique_checks", len(key_map))
+                        sf.open_map(key_map)
+                        window_open = True
                     verdicts = self._dispatch_rels(rels, use_latency, sp)
             except BulkCheckItemError as e:
                 # a per-item oracle failure is batch-relative: slice it
@@ -666,13 +766,25 @@ class MicroBatcher:
             # settle-exactly-once backstop: a BaseException escaping the
             # paths above (interpreter shutdown, a settle-path bug) must
             # not strand futures mid-dispatch — whoever is still waiting
-            # gets a classified rejection instead of a hang
+            # gets a classified rejection instead of a hang.  The
+            # singleflight window settles the same way: on success the
+            # parked futures resolve from this batch's verdicts, on any
+            # failure they reject retriable and their envelopes
+            # re-submit
             for s in batch.subs:
                 if not s.future.done():
                     s.future._reject(
                         UnavailableError("serve dispatch aborted"),
                         time.perf_counter(),
                     )
+            if window_open:
+                sf.close(
+                    verdicts,
+                    None if verdicts is not None else UnavailableError(
+                        "deduplicated twin's batch failed; re-submit"
+                    ),
+                    time.perf_counter(),
+                )
             _perf.report_wall("filter", t0, time.perf_counter())
             sp.end()
 
@@ -755,6 +867,12 @@ class MicroBatcher:
                 break
             if b is not None:
                 leftovers.extend(s for s in b.subs if not s.future.done())
+        if self._sf is not None:
+            # a window left open by a killed dispatcher: fail its parked
+            # futures closed instead of stranding them
+            self._sf.close(None, UnavailableError(
+                "serving handle closed before dispatch"
+            ), time.perf_counter())
         with self._cond:
             for q in self._queues.values():
                 leftovers.extend(s for s in q if not s.future.done())

@@ -30,11 +30,21 @@ class ServingHandle:
     snapshot).  Context-manager friendly: closing drains the queue and
     stops the former/dispatcher threads."""
 
-    def __init__(self, client, cs, config: Optional[ServeConfig] = None) -> None:
+    def __init__(
+        self, client, cs, config: Optional[ServeConfig] = None,
+        *, use_cache: bool = True,
+    ) -> None:
         self._client = client
         self._cs = cs
+        #: with_serving(cache=False) forces this handle's evaluates
+        #: cache-off even when the client carries a verdict cache (the
+        #: bench A/B lever); the pinned strategy is otherwise the
+        #: cache's read policy (full() bypasses by policy)
+        self._use_cache = use_cache
         ecfg = client._engine_config or EngineConfig()
         adm = client._admission
+        from ..consistency import Requirement
+
         self.batcher = MicroBatcher(
             tiers=ecfg.latency_tiers,
             cost=adm.cost,
@@ -43,6 +53,13 @@ class ServingHandle:
             config=config,
             dispatch_rels=self._dispatch_rels,
             dispatch_cols=self._dispatch_cols,
+            # cross-batch singleflight parks a duplicate on its in-
+            # flight twin's resolution — sound for MinLatency (the twin
+            # is at least as fresh as if the duplicate had arrived when
+            # its twin did), AtLeast (the twin's revision is >= the
+            # floor) and Snapshot (same pinned revision); Full must see
+            # the head at its own dispatch, so it never parks
+            inflight_dedup=cs.requirement != Requirement.FULL,
         )
 
     # -- batch evaluation (called from the dispatcher thread) ------------
@@ -50,14 +67,20 @@ class ServingHandle:
         client = self._client
         snap = client._store.snapshot_for(self._cs)
         span.set_attr("revision", int(snap.revision))
-        return client._evaluate_rels(snap, rels, latency=latency, span=span)
+        return client._evaluate_rels(
+            snap, rels, latency=latency, span=span,
+            cs=self._cs if self._use_cache else None,
+            dedup=self.batcher.config.dedup,
+        )
 
     def _dispatch_cols(self, q_res, q_perm, q_subj, latency, span):
         client = self._client
         snap = client._store.snapshot_for(self._cs)
         span.set_attr("revision", int(snap.revision))
         return client._evaluate_columns(
-            snap, q_res, q_perm, q_subj, latency=latency, span=span
+            snap, q_res, q_perm, q_subj, latency=latency, span=span,
+            cs=self._cs if self._use_cache else None,
+            dedup=self.batcher.config.dedup,
         )
 
     # -- blocking check surface ------------------------------------------

@@ -911,7 +911,30 @@ def render_report(
         "roofline": bw,
         "fingerprint": fp,
         "wall": last_wall(),
+        **{
+            k: _safe_section(fn) for k, fn in sorted(_EXTRA_REPORT.items())
+        },
     }
+
+
+#: extra /perf report sections registered by other subsystems (the
+#: verdict cache registers its stats here — engine/vcache.py — so one
+#: scrape answers "where do the checks go" AND "what never reached the
+#: device").  Cheap-by-contract, same rule as context providers
+_EXTRA_REPORT: Dict[str, Any] = {}
+
+
+def register_report_section(name: str, fn) -> None:
+    """Attach a callable whose result rides /perf under ``name``
+    (last registration per name wins)."""
+    _EXTRA_REPORT[name] = fn
+
+
+def _safe_section(fn):
+    try:
+        return fn()
+    except Exception as e:  # a broken section must not break the scrape
+        return {"error": repr(e)}
 
 
 def context_state() -> Dict[str, Any]:

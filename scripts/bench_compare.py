@@ -47,13 +47,23 @@ _LOWER_BETTER_SUFFIXES = (
 #: suffixes that are HIGHER-better regardless of unit — checked FIRST,
 #: so the perf columns can't be misread by a unit heuristic
 #: (``achieved_gbps`` must not fall into the "gb" lower-better unit
-#: bucket; ``roofline_frac`` closer to the ceiling is the win)
-_HIGHER_BETTER_SUFFIXES = ("achieved_gbps", "roofline_frac")
+#: bucket; ``roofline_frac`` closer to the ceiling is the win;
+#: ``hit_rate``/``dedup_frac`` are the verdict-cache columns — a round
+#: that serves fewer checks from cache/dedup at the same workload has
+#: regressed, and ``_frac``'s trailing "_s" must not read as seconds)
+_HIGHER_BETTER_SUFFIXES = (
+    "achieved_gbps", "roofline_frac", "hit_rate", "dedup_frac",
+    "cache_speedup",
+)
 #: extra fields of a metric line promoted to their own comparison rows
 #: (the perf-attribution columns ride headline rows as extra fields —
 #: promoting them guards the roofline trajectory from round one)
+#: (``dedup_frac`` is direction-registered above but NOT promoted: its
+#: absolute value is workload-noise-sized on the uniform-window bench,
+#: and a 0.0003→0.0001 wiggle must not fail a round)
 _PROMOTED_FIELDS = (
     "true_rate", "p99_ms", "achieved_gbps", "roofline_frac", "pad_fraction",
+    "cache_hit_rate",
 )
 #: boolean/one-shot rows that carry no trajectory signal
 _SKIP_UNITS = {"ok", "capture", "keys"}

@@ -1,0 +1,139 @@
+#!/usr/bin/env bash
+# Verdict-cache smoke: concurrent duplicate-heavy load through the
+# continuous-batching front-end with the revision-pinned verdict cache +
+# in-flight dedup armed (engine/vcache.py), oracle parity asserted on
+# EVERY answer — including cache-served and dedup-fanned ones — then a
+# cache-off pass over the SAME query set asserting bitwise parity (the
+# cache-off path is byte-for-byte the pre-cache serving code), a
+# hit-rate floor, and a chaos round with the cache.lookup fault site
+# armed.  Prints CACHE-SMOKE-OK on success — the CI-runnable proof the
+# cache layer answers correctly under concurrency, mirroring
+# scripts/serve_smoke.sh.
+#
+# Usage:
+#   scripts/cache_smoke.sh                       # 8 submitters, 12 rounds
+#   CACHE_SMOKE_SUBMITTERS=16 scripts/cache_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+: "${CACHE_SMOKE_SUBMITTERS:=8}"
+: "${CACHE_SMOKE_ROUNDS:=12}"
+: "${CACHE_SMOKE_TIMEOUT_S:=420}"
+
+export CACHE_SMOKE_SUBMITTERS CACHE_SMOKE_ROUNDS
+
+timeout -k 10 "${CACHE_SMOKE_TIMEOUT_S}" env JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+import threading
+
+import numpy as np
+
+from gochugaru_tpu import consistency, rel
+from gochugaru_tpu.client import (
+    new_tpu_evaluator, with_host_only_evaluation, with_latency_mode,
+    with_store,
+)
+from gochugaru_tpu.serve import ServeConfig
+from gochugaru_tpu.utils import faults, metrics
+from gochugaru_tpu.utils.context import background
+
+N = int(os.environ.get("CACHE_SMOKE_SUBMITTERS", "8"))
+ROUNDS = int(os.environ.get("CACHE_SMOKE_ROUNDS", "12"))
+
+c = new_tpu_evaluator(with_latency_mode())
+ctx = background()
+c.write_schema(ctx, """
+definition user {}
+definition org { relation admin: user  relation member: user }
+definition repo {
+    relation org: org
+    relation reader: user
+    permission admin = org->admin
+    permission read = reader + admin + org->member
+}
+""")
+rng = np.random.default_rng(20260804)
+txn = rel.Txn()
+for i in range(150):
+    txn.touch(rel.must_from_triple(
+        f"repo:r{i}", "reader", f"user:u{rng.integers(80)}"))
+    txn.touch(rel.must_from_triple(f"repo:r{i}", "org", f"org:o{i % 4}"))
+for o in range(4):
+    txn.touch(rel.must_from_triple(f"org:o{o}", "admin", f"user:u{o}"))
+    txn.touch(rel.must_from_triple(f"org:o{o}", "member", f"user:u{o + 20}"))
+c.write(ctx, txn)
+oracle = new_tpu_evaluator(with_host_only_evaluation(), with_store(c.store))
+cs = consistency.full()
+ml = consistency.min_latency()
+m = metrics.default
+
+# a SMALL duplicate-heavy pool: 40 distinct checks shared by every
+# submitter — concurrency guarantees in-flight twins and the cache
+# guarantees steady-state hits
+POOL = [rel.must_from_triple(
+    f"repo:r{i % 40}", "read", f"user:u{(i * 7) % 80}") for i in range(40)]
+WANT = oracle.check(ctx, cs, *POOL)
+
+# -- phase 1: cache+dedup on, concurrent, parity on EVERY answer --------
+mismatches = []
+with c.with_serving(cs=ml, cache=True) as h:
+    def worker(w):
+        lr = np.random.default_rng(1000 + w)
+        for _ in range(ROUNDS):
+            idx = [int(lr.integers(len(POOL))) for _ in range(6)]
+            got = h.check(ctx.with_timeout(60.0),
+                          *[POOL[i] for i in idx], client_id=w)
+            if list(got) != [WANT[i] for i in idx]:
+                mismatches.append((w, idx))
+    ts = [threading.Thread(target=worker, args=(w,)) for w in range(N)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # warm steady-state answer over the whole pool (columns surface)
+    got_on = [h.check(ctx, *POOL)]
+assert not mismatches, f"{len(mismatches)} cached/deduped answers wrong"
+hits, misses = m.counter("cache.hits"), m.counter("cache.misses")
+hit_rate = hits / max(hits + misses, 1)
+dedup = (m.counter("serve.dedup_parked") + m.counter("dedup.batch_dups"))
+assert hit_rate >= 0.5, f"hit rate {hit_rate:.2%} under duplicate-heavy load"
+assert m.counter("cache.puts") > 0
+print(f"# cache parity: {N} submitters x {ROUNDS} rounds over a "
+      f"{len(POOL)}-check pool — every answer == oracle; "
+      f"hit_rate={hit_rate:.1%} deduped={int(dedup)}")
+
+# -- phase 2: cache-off bitwise parity over the same queries ------------
+with c.with_serving(cs=ml, cache=False,
+                    config=ServeConfig(dedup=False)) as h_off:
+    got_off = [h_off.check(ctx, *POOL)]
+assert got_on == got_off == [WANT], "cache-off parity broke"
+print("# cache-off pass: identical answers through the pre-cache path")
+
+# -- phase 3: chaos — cache.lookup armed, envelope absorbs it -----------
+r0 = m.counter("retry.retries")
+with c.with_serving(cs=ml, cache=True) as h:
+    with faults.default.armed("cache.lookup", probability=0.4,
+                              seed=7) as spec:
+        for i in range(30):
+            got = h.check(ctx.with_timeout(60.0), *POOL[:6])
+            assert list(got) == WANT[:6], f"chaos round {i} wrong"
+    assert spec.fired > 0, "cache.lookup never fired"
+print(f"# chaos: cache.lookup fired {spec.fired}x, "
+      f"{int(m.counter('retry.retries') - r0)} envelope retries, "
+      "parity held")
+
+import json
+print(json.dumps({
+    "metric": "cache_smoke", "value": 1, "unit": "ok", "vs_baseline": 1.0,
+    "submitters": N, "rounds": ROUNDS,
+    "hit_rate": round(hit_rate, 4), "deduped": int(dedup),
+    "cache_lookup_faults": int(spec.fired),
+    "note": "oracle parity incl. cache-served answers + cache-off "
+            "bitwise parity + hit-rate floor + chaos on cache.lookup",
+}))
+print(f"CACHE-SMOKE-OK submitters={N} rounds={ROUNDS} "
+      f"hit_rate={hit_rate:.3f} deduped={int(dedup)} "
+      f"faults={int(spec.fired)}")
+EOF
+rc=$?
+exit "$rc"

@@ -490,6 +490,28 @@ def test_bench_compare_perf_column_directions():
         assert fld in bc._PROMOTED_FIELDS
 
 
+def test_bench_compare_cache_column_directions():
+    """The verdict-cache bench columns are direction-aware from round
+    one: hit_rate/dedup_frac falling is a regression (same pattern as
+    the PR-12 achieved_gbps fix — ``dedup_frac`` must not fall into any
+    lower-better suffix bucket, and ``cache_hit_rate`` ends with
+    ``hit_rate`` so headline and sweep rows both resolve)."""
+    bc = _bench_compare()
+    assert not bc.lower_is_better("serve_openloop_goodput.cache_hit_rate", "")
+    assert not bc.lower_is_better("serve_cache_ab.hit_rate", "checks/sec")
+    assert not bc.lower_is_better("serve_openloop_goodput.dedup_frac", "")
+    assert not bc.lower_is_better("serve_cache_ab.cache_speedup", "x")
+    assert "cache_hit_rate" in bc._PROMOTED_FIELDS
+    # dedup_frac is direction-registered but deliberately NOT promoted
+    # (workload-noise-sized absolute values would flap the trajectory)
+    assert "dedup_frac" not in bc._PROMOTED_FIELDS
+    # direction actually drives the verdict
+    old = {"h.cache_hit_rate": {"value": 0.9, "unit": "", "platform": ""}}
+    new = {"h.cache_hit_rate": {"value": 0.5, "unit": "", "platform": ""}}
+    rows, regressions = bc.compare(old, new, "r01", "r02", 0.10)
+    assert regressions == 1 and "REGRESSED" in "\n".join(rows)
+
+
 def test_bench_compare_flags_roofline_regression():
     bc = _bench_compare()
     old = {

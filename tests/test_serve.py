@@ -186,10 +186,15 @@ def test_fairness_zipf_aggressor_round_robin():
     """A bulk aggressor whose queued volume alone exceeds the formed
     batch cannot starve interactive clients: round-robin formation
     admits every client's head into the batch, while plain FIFO order
-    would place the interactive submissions far past the cut."""
+    would place the interactive submissions far past the cut.
+
+    dedup=False pins the pre-dedup raw-count formation this test's cut
+    arithmetic assumes (the aggressor's zipf%97 rows are duplicate-heavy
+    — with dedup on they collapse into one batch by design; the dedup
+    accounting has its own tests in test_vcache.py)."""
     b = MicroBatcher(
         tiers=(256, 1024, 4096), cost=CostModel(), start=False,
-        registry=metrics.Metrics(),
+        registry=metrics.Metrics(), config=ServeConfig(dedup=False),
     )
     zipf = np.random.default_rng(1).zipf(1.3, 64 * 70)
     # the aggressor queues 70 CheckMany submissions of 64 first ...

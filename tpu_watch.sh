@@ -76,6 +76,16 @@ while true; do
       timeout 700 python benchmarks/bench7_hbm.py --scale 0.2 \
         > "tpu_attempts/hbm_${TS}.out" 2> "tpu_attempts/hbm_${TS}.err"
       log "packed-vs-unpacked A/B rc=$? → tpu_attempts/hbm_${TS}.out"
+      # priority 3.7: verdict-cache on/off A/B on silicon (bench9's
+      # serve_cache_ab + serve_cache_openloop_ab rows): on the 1-core
+      # CPU proxy the open-loop arm reads ~parity because the device
+      # kernel hides under host Python — on TPU, where the device is
+      # the bottleneck and the host core is free, the cache's 100x
+      # device-row collapse should finally convert into open-loop
+      # goodput (the request-path arm is the CPU-side headline)
+      timeout 700 python benchmarks/bench9_serve.py --quick \
+        > "tpu_attempts/cache_${TS}.out" 2> "tpu_attempts/cache_${TS}.err"
+      log "verdict-cache A/B rc=$? → tpu_attempts/cache_${TS}.out"
       # priority 4: the wider ladder while the window lasts
       timeout 420 python benchmarks/bench1_founders.py \
         > "tpu_attempts/b1_${TS}.out" 2> "tpu_attempts/b1_${TS}.err"
