@@ -246,7 +246,7 @@ def small_batch_latency(
     engine, dsnap, q_res, q_perm, q_subj, *,
     q_ctx=None, qctx_rows=None, now_us=None,
     warmup: int = 30, reps: int = 600,
-    interleave_tracer=None,
+    interleave_tracer=None, interleave=None,
 ) -> dict:
     """Warm latency-mode p50/p99 + mean per-stage budget for one small
     batch (engine/latency.py).  Every rep is a full dispatch — host
@@ -268,7 +268,14 @@ def small_batch_latency(
     drowns a <5% effect in drift).  Adds ``p50_ms_off``/``p50_ms_on``/
     ``p90_ms_off``/``p90_ms_on``/``p99_ms_off``/``p99_ms_on`` and
     ``delta_p50_ms``/``delta_p90_ms`` to the result; the headline
-    quantiles then cover the mixed stream."""
+    quantiles then cover the mixed stream.
+
+    ``interleave`` generalizes the same per-rep A/B to ANY toggle: an
+    ``(on_fn, off_fn)`` pair called before each rep (odd reps on, even
+    off) — the decision-provenance benches use it to price witness
+    extraction (``lp.arm_witness``) and decision-log recording with the
+    identical paired-noise methodology.  Mutually composable with
+    ``interleave_tracer`` (both flip on the same rep parity)."""
     import jax  # noqa: F401  (ensures backend selection happened)
 
     from gochugaru_tpu.utils import trace as _trace
@@ -290,7 +297,13 @@ def small_batch_latency(
             sp.end()
 
     for i in range(warmup):
+        if interleave is not None:
+            # warm BOTH arms of the A/B (same parity as the measured
+            # loop) so the no-recompile assertion can stay armed below
+            interleave[0 if (i & 1) else 1]()
         once(i)
+    if interleave is not None:
+        interleave[1]()
     # frozen GC is the standard latency-service tuning (collection
     # pauses land straight in p99) — same recipe as bench1's client
     # loop, but unfrozen after the window: this helper runs MID-bench
@@ -309,11 +322,13 @@ def small_batch_latency(
             mode = i & 1
             if interleave_tracer is not None:
                 _trace.install(interleave_tracer if mode else None)
+            if interleave is not None:
+                interleave[0 if mode else 1]()
             t0 = time.perf_counter()
             once(i)
             dt = (time.perf_counter() - t0) * 1000
             ts.append(dt)
-            if interleave_tracer is not None:
+            if interleave_tracer is not None or interleave is not None:
                 by_mode[mode].append(dt)
             b = lp.last_budget
             for k in stages:
@@ -321,7 +336,12 @@ def small_batch_latency(
     finally:
         if interleave_tracer is not None:
             _trace.install(prev_tracer)
+        if interleave is not None:
+            interleave[1]()  # leave the toggle OFF
         gc.unfreeze()
+    # armed for the interleave A/B too (both arms pre-warmed above): a
+    # pin eviction mid-window would inject a compile rep into one arm
+    # and silently corrupt the paired deltas — fail loudly instead
     assert lp.compile_count == compiles_before, (
         "latency path recompiled during the warm measurement window"
     )
@@ -338,7 +358,7 @@ def small_batch_latency(
         "tier": int(lp.last_budget.tier),
         "n": int(reps),
     }
-    if interleave_tracer is not None:
+    if interleave_tracer is not None or interleave is not None:
         off, on = np.asarray(by_mode[0]), np.asarray(by_mode[1])
         for q in (50, 90, 99):
             out[f"p{q}_ms_off"] = round(float(np.percentile(off, q)), 3)

@@ -215,6 +215,13 @@ def main() -> int:
         ["bash", "scripts/cache_smoke.sh"],
         600,
     ))
+    configs.append((
+        "18 — decision-provenance smoke (explain==oracle parity, witness"
+        " subset, denial frontier, cache re-derivation, decision-log"
+        " rotation + denial-rate SLO)",
+        ["bash", "scripts/explain_smoke.sh"],
+        600,
+    ))
     if not q:
         # Leopard-scale CPU proxy (VERDICT r04 item 3): the same Watch
         # re-index loop at a 100M-edge base — BASELINE config 5's

@@ -58,6 +58,7 @@ from .rel.txn import Txn
 from .rel.update import Update, UpdateFilter
 from .store.snapshot import Snapshot
 from .store.store import Store, parse_revision
+from .utils import decisions as _decisions
 from .utils import faults
 from .utils import metrics as _metrics
 from .utils import trace as _trace
@@ -100,6 +101,15 @@ class LookupPage(NamedTuple):
     cursor: Optional[str]
 
 
+class ExplainedCheck(NamedTuple):
+    """One ``check(..., explain=True)`` item: the boolean verdict plus
+    its full resolution tree (engine/explain.py — the reference's
+    CheckPermission debug-trace shape)."""
+
+    allowed: bool
+    explanation: Dict[str, Any]
+
+
 class _Options:
     def __init__(self) -> None:
         self.overlap_required = False
@@ -118,6 +128,7 @@ class _Options:
         self.incident_dir: Optional[str] = None
         self.slos = None  # None → utils/slo.default_slos(); () disables
         self.verdict_cache = None  # VerdictCache | max_bytes int | None
+        self.decision_log = None  # (spec, kwargs) from with_decision_log
 
 
 Option = Callable[[_Options], None]
@@ -219,6 +230,29 @@ def with_verdict_cache(cache=True) -> Option:
 
     def opt(o: _Options) -> None:
         o.verdict_cache = cache
+
+    return opt
+
+
+def with_decision_log(log=True, **kw) -> Option:
+    """Arm the structured decision log (utils/decisions.py): a sampled
+    always-on ring (+ optional rotating JSONL sink) of authorization
+    DECISIONS — client id, resource, permission, subject, verdict,
+    revision, consistency strategy, cache_hit/dedup_parked provenance,
+    latency, trace id — with an always-keep-denied rule (the slow-tail
+    analogue: "why was this user denied" always has an answer).  Served
+    live at ``/decisions`` (with_telemetry), carried in incident
+    bundles, and feeding the per-strategy verdict counters the stock
+    ``denial_rate`` SLO alerts on.
+
+    ``log`` may be ``True`` (defaults) or a prebuilt ``DecisionLog``;
+    keyword arguments (``capacity``, ``sample_rate``, ``sink_path``,
+    ``rotate_bytes``, ``rotate_keep``) pass through to the constructor.
+    The log is process-global (the trace.py tracer discipline) — one
+    stream per process however many clients arm it."""
+
+    def opt(o: _Options) -> None:
+        o.decision_log = (log, kw)
 
     return opt
 
@@ -336,6 +370,20 @@ class Client:
         #: revision-pinned verdict cache (engine/vcache.py) — None keeps
         #: every check path byte-for-byte on the pre-cache code
         self._vcache = self._make_vcache(o.verdict_cache)
+        #: structured decision log (utils/decisions.py): process-global,
+        #: installed by with_decision_log(); None ⇒ recording is one
+        #: load + branch (verdict counters stay on regardless)
+        if o.decision_log is not None:
+            spec, kw = o.decision_log
+            if spec is True:
+                # bare arming REUSES an already-installed log (the
+                # slo.install_engine discipline): a second client must
+                # not silently close the first one's configured sink.
+                # Explicit kwargs are an explicit reconfiguration.
+                if kw or _decisions.get() is None:
+                    _decisions.install(_decisions.DecisionLog(**kw))
+            elif spec:
+                _decisions.install(spec)
         #: telemetry endpoint (utils/telemetry.py), via with_telemetry()
         self.telemetry = None
         #: flight recorder + SLO engine (armed by with_telemetry)
@@ -591,16 +639,62 @@ class Client:
         if batch:
             yield from self.check(ctx, cs, *batch)
 
-    def check(self, ctx: Context, cs: Strategy, *rs: RelationshipLike) -> List[bool]:
+    def check(
+        self, ctx: Context, cs: Strategy, *rs: RelationshipLike,
+        explain: bool = False,
+    ) -> List[bool]:
         """Batched permission check — the core path.  The reference folds N
         checks into one CheckBulkPermissions RPC (client/client.go:238-266);
         here they fold into one device dispatch, with host-oracle resolution
         for conditional/overflowed items, wrapped in the same retry
-        envelope."""
+        envelope.
+
+        ``explain=True`` returns ``List[ExplainedCheck]`` instead:
+        verdicts AND their typed resolution trees (engine/explain.py),
+        evaluated + explained at ONE pinned snapshot — the device
+        witness seeds each allowed tree's walk, cache-served verdicts
+        re-derive against the pinned revision."""
         self._check_overlap(ctx)
         rels = [as_relationship(r) for r in rs]
         if not rels:
             return []
+        if explain:
+            self._metrics.inc("checks.requested", len(rels))
+            root = _trace.root_span("check.explain", batch=len(rels))
+            ectx = _trace.ctx_with_span(ctx, root)
+
+            def run() -> List[ExplainedCheck]:
+                import time as _time
+
+                sp = _trace.span_of(ectx)
+                # ONE snapshot for the verdicts and every tree: explain
+                # must describe the world the verdict was computed in,
+                # not whatever head a later write minted
+                snap = self._store.snapshot_for(cs)
+                # cache residency is probed BEFORE the dispatch: the
+                # entries this very dispatch inserts must not masquerade
+                # as cache-served provenance
+                cache_ents = self._peek_cached(snap, rels, cs)
+                # ... and ONE evaluation instant: the walks' expiry
+                # gates pin to the dispatch time, not tree-build time
+                now_us = int(_time.time() * 1_000_000)
+                # the SAME admission envelope as a plain check, covering
+                # the evaluate dispatch AND the one batched witness
+                # dispatch; only the host-oracle walks run outside it
+                verdicts, codes = self._admitted(ectx, sp, lambda: (
+                    self._evaluate_rels(
+                        snap, rels, latency=self._latency_mode,
+                        span=sp, cs=cs,
+                    ),
+                    self._witness_batch(snap, rels),
+                ))
+                return self._explain_batch(
+                    snap, rels, verdicts, cs, now_us=now_us,
+                    cache_ents=cache_ents, codes=codes,
+                )
+
+            with root:
+                return retry_retriable_errors(ectx, run)
         self._metrics.inc("checks.requested", len(rels))
         # request-scoped tracing (utils/trace.py): head-sampled root
         # span riding the context chain.  The unsampled/disabled path is
@@ -610,18 +704,11 @@ class Client:
         ctx = _trace.ctx_with_span(ctx, root)
 
         def dispatch() -> List[bool]:
-            import time as _time
-
-            adm = self._admission
             sp = _trace.span_of(ctx)
-            # deadline budget: a dispatch that cannot finish inside the
-            # context deadline sheds BEFORE any snapshot/device work
-            adm.check_deadline(ctx, span=sp)
-            t_disp = _time.perf_counter()
-            with adm.gate.admit(span=sp):
-                out = self._dispatch_admitted(ctx, cs, rels, span=sp)
-            adm.observe_cost(_time.perf_counter() - t_disp)
-            return out
+            return self._admitted(
+                ctx, sp,
+                lambda: self._dispatch_admitted(ctx, cs, rels, span=sp),
+            )
 
         if root is _trace.NOOP:
             # keep-slow tail rule: even unsampled requests leave a
@@ -634,6 +721,24 @@ class Client:
         # Span.__exit__ records the exception type as the `error` attr
         with root:  # activates the thread-local current span + ends it
             return retry_retriable_errors(ctx, dispatch)
+
+    def _admitted(self, ctx: Context, span, work):
+        """The ONE admission envelope every device-dispatching request
+        path runs under: deadline-budget shed before any device work,
+        the bounded in-flight gate around ``work()``, and the cost-model
+        observation feeding the shared per-tier EWMA after — plain
+        checks, explain batches, and the serving handle's explain
+        derivation all call this, so a change to admission behavior
+        cannot silently miss one of them."""
+        import time as _time
+
+        adm = self._admission
+        adm.check_deadline(ctx, span=span)
+        t_disp = _time.perf_counter()
+        with adm.gate.admit(span=span):
+            out = work()
+        adm.observe_cost(_time.perf_counter() - t_disp)
+        return out
 
     def _dispatch_admitted(
         self,
@@ -677,14 +782,26 @@ class Client:
         verdicts fan back out, definite results populate the revision's
         shard.  Items carrying live query caveat context NEVER read or
         write the cache.  With no cache attached and dedup off this is
-        byte-for-byte the pre-cache path (``_evaluate_rels_direct``)."""
+        byte-for-byte the pre-cache path (``_evaluate_rels_direct``).
+
+        Decision provenance rides every exit: per-strategy verdict
+        counters always (utils/decisions.count_verdicts — the stock
+        denial-rate SLO's feed), and when a decision log is installed,
+        sampled + always-keep-denied entries carrying revision,
+        strategy, cache_hit and the evaluate latency."""
+        import time as _time
+
+        t_ev = _time.perf_counter()
         vc = self._vcache
         pol = _vcache.policy_for(cs) if vc is not None else _vcache.CACHE_OFF
         if not (pol.read or pol.write) and not dedup:
-            return self._evaluate_rels_direct(
+            out = self._evaluate_rels_direct(
                 snap, rels, latency=latency, span=span
             )
-        import time as _time
+            self._provenance_rels(
+                rels, out, snap, cs, None, _time.perf_counter() - t_ev, span
+            )
+            return out
 
         B = len(rels)
         keys = [_vcache.rel_key(r) for r in rels]
@@ -702,12 +819,18 @@ class Client:
                 if v is not None:
                     out[i] = v[0]
         pend = [i for i in range(B) if out[i] is None]
+        hitflags = [out[i] is not None for i in range(B)]
         nh = B - len(pend)
         if nh:
             span.event("cache.hits", items=nh)
             span.set_attr("cache_hits", nh)
         if not pend:
-            return [bool(v) for v in out]
+            res = [bool(v) for v in out]
+            self._provenance_rels(
+                rels, res, snap, cs, hitflags,
+                _time.perf_counter() - t_ev, span,
+            )
+            return res
         if dedup and len(pend) > 1:
             first: Dict[Any, int] = {}
             uidx: List[int] = []
@@ -741,7 +864,11 @@ class Client:
                  if cacheable[i]],
                 now_us,
             )
-        return [bool(v) for v in out]
+        res = [bool(v) for v in out]
+        self._provenance_rels(
+            rels, res, snap, cs, hitflags, _time.perf_counter() - t_ev, span
+        )
+        return res
 
     @staticmethod
     def _remap_bulk_error(e, out, pend, inverse, as_seq):
@@ -762,6 +889,57 @@ class Client:
             first_bad = pend[-1]
         prefix = as_seq(out[:first_bad])
         return BulkCheckItemError(first_bad, prefix, e.__cause__ or e)
+
+    def _provenance_rels(
+        self, rels, out, snap, cs, cache_hits, dt, span
+    ) -> None:
+        """Decision provenance for one relationship batch: always-on
+        verdict counters (cheap, per batch), plus decision-log entries
+        when a log is installed (one load + branch otherwise)."""
+        sname = _decisions.strategy_name(cs)
+        allowed = sum(1 for v in out if v)
+        _decisions.count_verdicts(
+            self._metrics, allowed, len(out) - allowed, sname,
+            cache_hits=sum(cache_hits) if cache_hits is not None else 0,
+        )
+        if _decisions.enabled():
+            _decisions.record_rels(
+                rels, out, revision=snap.revision, strategy=sname,
+                cache_hits=cache_hits, latency_s=dt,
+                trace_id=span.trace_id if span.sampled else None,
+            )
+
+    def _provenance_cols(
+        self, snap, q_res, q_perm, q_subj, res, cs, cache_resolved, dt, span
+    ) -> None:
+        """Columnar mirror: counters from numpy reductions; decision-log
+        entries decode interned ids ONLY for the sampled/denied rows the
+        log actually keeps."""
+        sname = _decisions.strategy_name(cs)
+        allowed = int(res.sum())
+        _decisions.count_verdicts(
+            self._metrics, allowed, int(res.shape[0]) - allowed, sname,
+            cache_hits=int(cache_resolved.sum())
+            if cache_resolved is not None else 0,
+        )
+        if _decisions.enabled():
+            name_of_slot = snap.compiled.name_of_slot
+            interner = snap.interner
+
+            def decode(i: int):
+                rt, rid = interner.key_of(int(q_res[i]))
+                st, sid = interner.key_of(int(q_subj[i]))
+                return (
+                    f"{rt}:{rid}", name_of_slot[int(q_perm[i])],
+                    f"{st}:{sid}",
+                )
+
+            _decisions.record_cols(
+                int(res.shape[0]), res, decode,
+                revision=snap.revision, strategy=sname,
+                cache_hits=cache_resolved, latency_s=dt,
+                trace_id=span.trace_id if span.sampled else None,
+            )
 
     def _evaluate_rels_direct(
         self,
@@ -891,13 +1069,20 @@ class Client:
         only the unique misses dispatch, at whatever (smaller) pow2 tier
         they land on.  With no cache and dedup off this is byte-for-byte
         the pre-cache path."""
+        import time as _time
+
+        t_ev = _time.perf_counter()
         vc = self._vcache
         pol = _vcache.policy_for(cs) if vc is not None else _vcache.CACHE_OFF
         if not (pol.read or pol.write) and not dedup:
-            return self._evaluate_columns_direct(
+            out = self._evaluate_columns_direct(
                 snap, q_res, q_perm, q_subj, latency=latency, span=span
             )
-        import time as _time
+            self._provenance_cols(
+                snap, q_res, q_perm, q_subj, np.asarray(out, bool), cs,
+                None, _time.perf_counter() - t_ev, span,
+            )
+            return out
 
         B = int(q_res.shape[0])
         keys = _vcache.pack_cols(q_perm, q_res, q_subj)
@@ -916,6 +1101,10 @@ class Client:
             span.event("cache.hits", items=nh)
             span.set_attr("cache_hits", nh)
         if pend.shape[0] == 0:
+            self._provenance_cols(
+                snap, q_res, q_perm, q_subj, res, cs, resolved,
+                _time.perf_counter() - t_ev, span,
+            )
             return res
         if dedup and pend.shape[0] > 1:
             if isinstance(keys, np.ndarray):
@@ -966,6 +1155,10 @@ class Client:
                 keys[int(i)] for i in uidx
             ]
             vc.insert_cols(snap.revision, ku, np.asarray(sub, bool), now_us)
+        self._provenance_cols(
+            snap, q_res, q_perm, q_subj, res, cs, resolved,
+            _time.perf_counter() - t_ev, span,
+        )
         return res
 
     def _evaluate_columns_direct(
@@ -1068,6 +1261,130 @@ class Client:
         perm = snap.compiled.name_of_slot[int(perm_slot)]
         r = rel_must_from_triple(f"{rtype}:{rid}", perm, f"{stype}:{sid}")
         return oracle.check_relationship(r) == T
+
+    # ------------------------------------------------------------------
+    # Decision provenance (engine/explain.py)
+    # ------------------------------------------------------------------
+    def explain(
+        self, ctx: Context, cs: Strategy, r: RelationshipLike
+    ) -> Dict[str, Any]:
+        """Full resolution tree for ONE check at the strategy's pinned
+        revision — the reference's CheckPermission debug-trace surface.
+        The device witness (engine/flat.py armed kernel) seeds the walk
+        toward the branch the kernel proved winning; verdicts the
+        verdict cache would have served are re-derived against the
+        pinned revision and flagged ``cached``.  Runs under the same
+        retry envelope as checks (the ``explain.walk`` chaos site
+        classifies into it)."""
+        self._check_overlap(ctx)
+        rel_ = as_relationship(r)
+
+        def run() -> Dict[str, Any]:
+            snap = self._store.snapshot_for(cs)
+            return self._explain_at(snap, rel_, cs)
+
+        return retry_retriable_errors(ctx, run)
+
+    _WITNESS_UNSET = object()
+
+    def _witness_batch(self, snap: Snapshot, rels) -> Optional[Any]:
+        """Best-effort device witness codes for a whole batch (ONE armed
+        dispatch, not one per item) — a hint, never a failure: any error
+        degrades to the unseeded walk."""
+        engine = self._engine_for(snap)
+        if engine is None:
+            return None
+        try:
+            dsnap = self._dsnap_for(engine, snap)
+            return engine.witness_codes(dsnap, rels)
+        except Exception:
+            self._metrics.inc("explain.witness_errors")
+            return None
+
+    def _peek_cached(
+        self, snap: Snapshot, rels, cs: Optional[Strategy]
+    ) -> List[Optional[tuple]]:
+        """Per-rel verdict-cache entries ``(verdict, pinned now_us)`` or
+        None — a metric-free residency probe for explain provenance.
+        Must run BEFORE the evaluate dispatch: an entry that exists only
+        because this request's dispatch inserted it is fresh work, not a
+        cache-served verdict."""
+        from .engine import vcache as _vc
+
+        vc = self._vcache
+        if vc is None or not _vc.policy_for(cs).read:
+            return [None] * len(rels)
+        out: List[Optional[tuple]] = []
+        for r in rels:
+            key = _vc.rel_key(r)
+            out.append(
+                vc.peek_rel(snap.revision, key)
+                if key[1] == _vc.EMPTY_CTX_FP else None
+            )
+        return out
+
+    def _explain_batch(
+        self, snap: Snapshot, rels, verdicts, cs: Optional[Strategy],
+        *, now_us: Optional[int] = None, cache_ents=None,
+        codes=_WITNESS_UNSET,
+    ) -> List["ExplainedCheck"]:
+        """Derive one explain tree per already-computed verdict at one
+        pinned snapshot — the ONE implementation behind both
+        ``check(explain=True)`` and ``ServingHandle.check(explain=True)``.
+        A tree disagreeing with its served verdict (head moved, entry
+        expired) is flagged ``verdict_skew`` instead of silently posing
+        as the verdict's derivation."""
+        if codes is Client._WITNESS_UNSET:
+            codes = self._witness_batch(snap, rels)
+        out = []
+        for i, (v, r) in enumerate(zip(verdicts, rels)):
+            tree = self._explain_at(
+                snap, r, cs,
+                witness=None if codes is None else int(codes[i]),
+                now_us=now_us,
+                cache_ent=(
+                    cache_ents[i] if cache_ents is not None
+                    else Client._WITNESS_UNSET
+                ),
+            )
+            if (tree["result"] == "allowed") != bool(v):
+                tree["verdict_skew"] = True
+            out.append(ExplainedCheck(bool(v), tree))
+        return out
+
+    def _explain_at(
+        self, snap: Snapshot, r: Relationship, cs: Optional[Strategy],
+        witness=_WITNESS_UNSET, now_us: Optional[int] = None,
+        cache_ent=_WITNESS_UNSET,
+    ) -> Dict[str, Any]:
+        """One explain tree at one pinned snapshot: witness extraction
+        (unless the caller already extracted a batch's worth), cache
+        provenance, then the instrumented oracle walk.  ``now_us`` pins
+        the walk's expiry gates to the instant the verdict was computed;
+        a cache-served verdict re-derives at its ENTRY's pinned now_us
+        (overriding the caller's), so the tree describes the world the
+        cached verdict saw, not wall clock at explain time.
+        ``cache_ent`` is the pre-dispatch residency probe result (None =
+        known uncached); left unset, the probe runs here — only correct
+        when no verdict dispatch preceded this call (``client.explain``)."""
+        from .engine import explain as _explain
+
+        if witness is Client._WITNESS_UNSET:
+            codes = self._witness_batch(snap, [r])
+            wit = int(codes[0]) if codes is not None else None
+        else:
+            wit = witness
+        if cache_ent is Client._WITNESS_UNSET:
+            cache_ent = self._peek_cached(snap, [r], cs)[0]
+        cached = cache_ent is not None
+        if cached:
+            now_us = cache_ent[1]
+        self._metrics.inc("explain.requests")
+        oracle = self._oracle_for(snap)
+        return _explain.explain_relationship(
+            oracle, r, witness=wit, revision=snap.revision, cached=cached,
+            now_us=now_us, strategy=_decisions.strategy_name(cs),
+        )
 
     # ------------------------------------------------------------------
     # Continuous-batching serving front-end (serve/batcher.py)

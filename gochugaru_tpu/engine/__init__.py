@@ -12,6 +12,11 @@ Two implementations of the same semantics:
 - ``device`` — the JAX/TPU engine: schemas compile to batched reachability
   programs; checks run as vmapped two-phase evaluation (subject closure +
   resource-subgraph fixpoint) over the snapshot's sorted columnar arrays.
+
+``explain`` bridges the two for decision provenance: the device kernels
+optionally emit a per-query witness code (winning branch) that seeds an
+instrumented oracle walk into a typed resolution tree — "why was this
+check allowed/denied" at a pinned revision.
 """
 
 from .oracle import Oracle, PermTri

@@ -1279,15 +1279,15 @@ class DeviceEngine:
             dsnap.legacy_cache = merged
         return dsnap.legacy_cache
 
-    def _flat_fn_for(self, slots: Tuple[int, ...], meta):
-        key = (slots, meta)
+    def _flat_fn_for(self, slots: Tuple[int, ...], meta, witness: bool = False):
+        key = (slots, meta) if not witness else (slots, meta, "wit")
         fn = self._flat_fns.get(key)
         if fn is None:
             from .flat import make_flat_fn
 
             fn = make_flat_fn(
                 self.compiled, self.plan, self.config, meta, slots,
-                caveat_plan=self.caveat_plan,
+                caveat_plan=self.caveat_plan, witness=witness,
             )
             while len(self._flat_fns) >= self.FLAT_FN_CACHE_MAX:
                 self._flat_fns.pop(next(iter(self._flat_fns)))
@@ -1303,12 +1303,17 @@ class DeviceEngine:
         B: int,
         jit: bool = True,
         bucket_min: int = 0,
+        witness: bool = False,
     ):
         """The flat kernel + its lowered padded argument tuple — the ONE
-        place that knows the kernel's signature (check paths, bench.py and
-        __graft_entry__ all call this).  None when the flat path is
-        unavailable (disabled, unpackable graph, or more distinct
-        permissions in the batch than flat_max_slots)."""
+        place that knows the kernel's signature (check paths, bench.py,
+        __graft_entry__ and the witness extraction all call this).  None
+        when the flat path is unavailable (disabled, unpackable graph, or
+        more distinct permissions in the batch than flat_max_slots).
+        ``witness=True`` selects the armed kernel (same signature, extra
+        witness-plane output) — cached separately, never registered in
+        the device cost ledger (the ledger key names the serving
+        kernel)."""
         if dsnap.flat_meta is None:
             return None
         slots = tuple(
@@ -1319,13 +1324,14 @@ class DeviceEngine:
         from .flat import build_qm
 
         if jit:
-            fn = self._flat_fn_for(slots, dsnap.flat_meta)
+            fn = self._flat_fn_for(slots, dsnap.flat_meta, witness=witness)
         else:
             from .flat import make_flat_fn
 
             fn = make_flat_fn(
                 self.compiled, self.plan, self.config, dsnap.flat_meta,
                 slots, caveat_plan=self.caveat_plan, jit=False,
+                witness=witness,
             )
         BP = _ceil_pow2(B, max(bucket_min, self.config.batch_bucket_min))
         # ONE packed query matrix (flat.QM_LAYOUT) → one device transfer
@@ -1334,7 +1340,7 @@ class DeviceEngine:
             jnp.asarray(build_qm(queries, BP, dsnap.flat_meta)),
             self._qctx_device(qctx),
         )
-        if jit:
+        if jit and not witness:
             # device cost ledger: the batch-path program registers a
             # LAZY capture over ShapeDtypeStruct avals (no device
             # buffers pinned, no compile here) — realized only when a
@@ -1376,6 +1382,42 @@ class DeviceEngine:
             return None
         fn, args = got
         return fn(*args)
+
+    # -- decision provenance (engine/explain.py) -------------------------
+    def witness_codes(
+        self,
+        dsnap: DeviceSnapshot,
+        rels: Sequence[Relationship],
+        *,
+        now_us: Optional[int] = None,
+    ) -> Optional[np.ndarray]:
+        """Per-check device WITNESS codes for a batch: the winning-branch
+        plane the armed flat kernel emits (engine/flat.py
+        ``make_flat_fn(witness=True)``; codes in engine/explain.py).
+        Nonzero only for device-definite allowed verdicts — conditional/
+        overflow rows (host-oracle resolved) report 0, and rows the flat
+        path cannot serve at all return None (the explain walk then runs
+        unseeded).  Armed kernels cache separately from the serving
+        kernels, so calling this never perturbs the disarmed fast path."""
+        meta = dsnap.flat_meta
+        if meta is None or meta.sharded:
+            return None
+        snap = dsnap.snapshot
+        queries, _uniq, qctx = self._lower_queries(snap, rels, dsnap.strings)
+        B = len(rels)
+        got = self.flat_fn_and_args(
+            dsnap, queries, qctx, jnp.int32(snap.now_rel32(now_us)), B,
+            witness=True,
+        )
+        if got is None:
+            return None
+        fn, args = got
+        d, p, ovf, wit = jax.device_get(fn(*args))
+        wit = wit[:B].copy()
+        # host-resolved rows (conditional, overflow) carry no trusted
+        # device witness — the oracle walk explains them unseeded
+        wit[(p[:B] & ~d[:B]) | ovf[:B]] = 0
+        return wit
 
     # -- the batched check ----------------------------------------------
     def check_batch(

@@ -3105,6 +3105,7 @@ def make_flat_fn(
     axis: Optional[str] = None,
     model_size: int = 1,
     routed: bool = False,
+    witness: bool = False,
 ):
     """Build the batched flat check function for a static set of permission
     slots.  Queries select their slot's result with a vectorized compare —
@@ -3130,12 +3131,33 @@ def make_flat_fn(
     are only built for ROUTABLE slot sets (fully folded permissions and
     bare relation leaves, no wildcard edges): the dispatcher enforces
     this, because a routed sub-batch is shard-local and a psum over it
-    would merge unrelated queries."""
+    would merge unrelated queries.
+
+    ``witness=True`` arms DECISION-PROVENANCE extraction: the kernel
+    emits a fourth int32[B] output — a per-query witness code naming the
+    winning branch (direct edge / wildcard / T-probe / fold / userset ×
+    closure / rewrite / reflexive self, plus a recursion-level class in
+    the upper bits; codes in engine/explain.py) for device-definite
+    allowed verdicts, 0 otherwise.  The masks are REUSED from the probe
+    sites the kernel computes anyway — the armed cost is the final
+    select cascade.  Disarmed (the default) the traced program is
+    byte-identical to the pre-witness kernel: no extra output, no extra
+    ops — the trace.py NOOP discipline applied to kernel outputs."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     from ..caveats.device import make_tri_fn
+    from .explain import (
+        WIT_DIRECT,
+        WIT_FOLD,
+        WIT_LEVEL_SHIFT,
+        WIT_REWRITE,
+        WIT_SELF,
+        WIT_TPROBE,
+        WIT_USERSET,
+        WIT_WILDCARD,
+    )
 
     tri = make_tri_fn(caveat_plan) if caveat_plan is not None else None
     SH = axis is not None
@@ -3509,6 +3531,37 @@ def make_flat_fn(
             )
 
         zB = jnp.zeros(q_res.shape, bool)
+
+        class _WitColl:
+            """Witness-mask collector (armed kernels only).  ``add``
+            OR-accumulates a branch's definite mask, gated by the
+            collector's selection mask (which root slot / node type the
+            enclosing program applies to) — an ungated mask from another
+            type's program must never claim a branch for a query it
+            cannot grant.  Deeper node lattices (arrow children, rc
+            ancestors) are skipped: grants found there report as the
+            ``rewrite`` branch."""
+
+            __slots__ = ("store", "mask")
+
+            def __init__(self, store, mask=None):
+                self.store = store
+                self.mask = mask
+
+            def add(self, key, m):
+                if m.ndim != 1:
+                    return
+                if self.mask is not None:
+                    m = m & self.mask
+                prev = self.store.get(key)
+                self.store[key] = m if prev is None else (prev | m)
+
+            def masked(self, mask):
+                return _WitColl(
+                    self.store,
+                    mask if self.mask is None else (self.mask & mask),
+                )
+
         Nc = jnp.int32(meta.N)
         S1c = jnp.int32(meta.S1)
         # packed per-query subject keys: -1 = "matches nothing"
@@ -3631,7 +3684,7 @@ def make_flat_fn(
                     p = p | jnp.any(m & spok.reshape(shp), axis=(-1, -2))
             return d, p
 
-        def pf_probe(slot, nodes):
+        def pf_probe(slot, nodes, coll=None):
             """Folded-permission test at a [B, ...] node lattice: ONE
             direct-identity probe (pf_e) + one bounded-fan userset slice
             (pf_u) intersected with the member closure — the rewrite
@@ -3793,6 +3846,8 @@ def make_flat_fn(
                 r_hit = jnp.any(live & refl, axis=-1)
                 d = d | od | r_hit
                 p = p | op_ | r_hit
+            if coll is not None:
+                coll.add("fold", d)
             return d, p
 
         # Every eval function returns (definite, possible, ovf, used):
@@ -3800,12 +3855,15 @@ def make_flat_fn(
         # Compositional returns let ONE memo serve every root slot while
         # keeping overflow attribution per query.
 
-        def leaf(slot, nodes):
+        def leaf(slot, nodes, coll=None):
             """Direct + wildcard + userset leaf tests at a [B, ...] node
             lattice.  ``slot`` is a static int for program-internal
             references; ``None`` means dynamic — the query's own q_perm
             column is the relation, so ONE probe site at the root covers
-            every slot's direct relation check."""
+            every slot's direct relation check.  ``coll`` (the ROOT
+            dynamic call only, witness armed) collects per-branch
+            definite masks for the witness plane — None compiles to
+            nothing."""
             nd = nodes.ndim
             zn = jnp.zeros(nodes.shape, bool)
             d, p, ovf, used = zn, zn, zB, zB
@@ -3854,9 +3912,13 @@ def make_flat_fn(
                     return hd, hp
 
                 d, p = e_site(bq(q_k2, nd))
+                if coll is not None:
+                    coll.add("direct", d)
                 if meta.has_wc_edges:
                     # wildcard edges only grant direct-object subjects
                     wd, wp = e_site(bq(w_k2, nd))
+                    if coll is not None:
+                        coll.add("wildcard", wd)
                     d, p = d | wd, p | wp
             elif run_e:
                 ecols = (arrs["e_k1"], arrs["e_k2"])
@@ -3865,12 +3927,16 @@ def make_flat_fn(
                     (k1, bq(q_k2, nd)), meta.e_cap, meta.e_n,
                 )
                 d, p = gate2("e", row, (row >= 0) & exists)
+                if coll is not None:
+                    coll.add("direct", d)
                 if meta.has_wc_edges:
                     wrow = probe_rows(
                         arrs["eh_off"], arrs["eh_rows"], ecols,
                         (k1, bq(w_k2, nd)), meta.e_cap, meta.e_n,
                     )
                     wd, wp = gate2("e", wrow, (wrow >= 0) & exists)
+                    if coll is not None:
+                        coll.add("wildcard", wd)
                     d, p = d | wd, p | wp
 
             # T-index fast path: one probe folds {userset edge × closure}
@@ -3919,6 +3985,8 @@ def make_flat_fn(
                     )
                     dirty = jnp.any(blk_hit(dtb, (k1,)), axis=-1)
                     td, tp = td & ~dirty, tp & ~dirty
+                if coll is not None:
+                    coll.add("t", td)
                 d, p = d | td, p | tp
                 if meta.has_ovf:
                     # T is incomplete for overflowed closure sources: flag
@@ -4022,6 +4090,8 @@ def make_flat_fn(
                     ublk, valid,
                     tombstoned=dm is not None and dm.has_ustomb,
                 )
+                if coll is not None:
+                    coll.add("us", kd)
                 d, p, used = d | kd, p | kp, used | ku_used
             elif run_ku and KU_site > 0:
                 # scattered (non-blockslice) layout: no delta level exists
@@ -4055,7 +4125,10 @@ def make_flat_fn(
                     in_d = in_d | refl
                     in_p = in_p | refl
                 ugd, ugp = gate2("us", idxc, valid)
-                d = d | jnp.any(ugd & in_d, axis=-1)
+                kd = jnp.any(ugd & in_d, axis=-1)
+                if coll is not None:
+                    coll.add("us", kd)
+                d = d | kd
                 p = p | jnp.any(ugp & in_p, axis=-1)
 
             # delta-level userset grants (adds with subject relations)
@@ -4068,6 +4141,8 @@ def make_flat_fn(
                 ublk, valid, over = ku_fetch("dl_usr", dm.us_cap, dm.us_fan)
                 ovf = ovf | over
                 kd, kp, ku_used = ku_eval(ublk, valid, tombstoned=False)
+                if coll is not None:
+                    coll.add("us", kd)
                 d, p, used = d | kd, p | kp, used | ku_used
             return d, p, ovf, used
 
@@ -4081,8 +4156,13 @@ def make_flat_fn(
         if dm is not None and dm.has_ar:
             ar_bound = -1
 
-        def eval_progs(slot: int, nodes, stack: Tuple, types, ar_hops: int) -> Tuple:
-            """The permission programs of ``slot`` at ``nodes`` (no leaf)."""
+        def eval_progs(slot: int, nodes, stack: Tuple, types, ar_hops: int,
+                       coll=None) -> Tuple:
+            """The permission programs of ``slot`` at ``nodes`` (no leaf).
+            ``coll`` (witness collection) threads into each program's
+            expression GATED by that program's node-type mask, so a leaf
+            mask from another type's program can never claim a branch
+            for a query it cannot grant."""
             zn = jnp.zeros(nodes.shape, bool)
             d, p, ovf, used = zn, zn, zB, zB
             progs = [
@@ -4129,6 +4209,7 @@ def make_flat_fn(
                 ed, ep, eo, eu = eval_expr(
                     expr, nodes, stack + ((tname, slot),),
                     frozenset((tname,)), ar_hops,
+                    None if coll is None else coll.masked(mask),
                 )
                 d = d | (mask & ed)
                 p = p | (mask & ep)
@@ -4170,7 +4251,8 @@ def make_flat_fn(
                 ro, ru,
             )
 
-        def eval_slot(slot: int, nodes, stack: Tuple, types, ar_hops: int) -> Tuple:
+        def eval_slot(slot: int, nodes, stack: Tuple, types, ar_hops: int,
+                      coll=None) -> Tuple:
             cyc_sig = tuple(
                 sorted((pr, stack.count(pr)) for pr in set(stack) if pr in cyclic)
             )
@@ -4184,23 +4266,25 @@ def make_flat_fn(
             zn = jnp.zeros(nodes.shape, bool)
             d, p, ovf, used = zn, zn, zB, zB
             if slot in rel_slots:
-                d, p, ovf, used = leaf(slot, nodes)
+                d, p, ovf, used = leaf(slot, nodes, coll)
             if slot in pf_slots:
                 # folded permission reached as an arrow target / ref from
                 # an unfolded program: its base answer is the probe pair
-                fd, fp = pf_probe(slot, nodes)
+                fd, fp = pf_probe(slot, nodes, coll)
                 d, p = d | fd, p | fp
-            pd, pp, po, pu = eval_progs(slot, nodes, stack, types, ar_hops)
+            pd, pp, po, pu = eval_progs(slot, nodes, stack, types, ar_hops,
+                                        coll)
             d, p = d | pd, p | pp
             ovf, used = ovf | po, used | pu
             pins.append(nodes)
             memo[key] = (d, p, ovf, used)
             return memo[key]
 
-        def eval_expr(ir: ExprIR, nodes, stack: Tuple, types, ar_hops: int) -> Tuple:
+        def eval_expr(ir: ExprIR, nodes, stack: Tuple, types, ar_hops: int,
+                      coll=None) -> Tuple:
             tag = ir[0]
             if tag == "ref":
-                return eval_slot(ir[1], nodes, stack, types, ar_hops)
+                return eval_slot(ir[1], nodes, stack, types, ar_hops, coll)
             if tag == "nil":
                 z = jnp.zeros(nodes.shape, bool)
                 return z, z, zB, zB
@@ -4302,22 +4386,42 @@ def make_flat_fn(
                 z = jnp.zeros(nodes.shape, bool)
                 d, p, ovf, used = z, z, zB, zB
                 for c in ir[1]:
-                    cd, cp, co, cu = eval_expr(c, nodes, stack, types, ar_hops)
+                    cd, cp, co, cu = eval_expr(c, nodes, stack, types,
+                                               ar_hops, coll)
                     d, p = d | cd, p | cp
                     ovf, used = ovf | co, used | cu
                 return d, p, ovf, used
             if tag == "inter":
+                # children collect into a sub-store gated by the whole
+                # intersection's definite output: a branch hit inside a
+                # FAILED intersection is not on the allowed path and must
+                # not claim the witness
                 o = jnp.ones(nodes.shape, bool)
                 d, p, ovf, used = o, o, zB, zB
+                sub = None if coll is None else _WitColl({})
                 for c in ir[1]:
-                    cd, cp, co, cu = eval_expr(c, nodes, stack, types, ar_hops)
+                    cd, cp, co, cu = eval_expr(c, nodes, stack, types,
+                                               ar_hops, sub)
                     d, p = d & cd, p & cp
                     ovf, used = ovf | co, used | cu
+                if sub is not None:
+                    for wk, wm in sub.store.items():
+                        coll.add(wk, wm & d)
                 return d, p, ovf, used
             if tag == "excl":
-                bd, bp, bo, bu = eval_expr(ir[1], nodes, stack, types, ar_hops)
-                sd, sp, so, su = eval_expr(ir[2], nodes, stack, types, ar_hops)
-                return bd & ~sp, bp & ~sd, bo | so, bu | su
+                # the subtracted operand's grants DENY — never collected;
+                # the base operand's only count where the exclusion as a
+                # whole definitely grants
+                sub = None if coll is None else _WitColl({})
+                bd, bp, bo, bu = eval_expr(ir[1], nodes, stack, types,
+                                           ar_hops, sub)
+                sd, sp, so, su = eval_expr(ir[2], nodes, stack, types,
+                                           ar_hops, None)
+                rd = bd & ~sp
+                if sub is not None:
+                    for wk, wm in sub.store.items():
+                        coll.add(wk, wm & rd)
+                return rd, bp & ~sd, bo | so, bu | su
             raise TypeError(f"bad expression IR {ir!r}")
 
         # subject-closure overflow: the flattened table is incomplete for
@@ -4342,29 +4446,60 @@ def make_flat_fn(
             q_cl_ovf = ovf_probe(q_k2) | ovf_probe(wcl_k)
 
         valid_q = (q_res >= 0) & (q_perm >= 0)
+        # witness collection (armed kernels only): the ROOT-level sites
+        # and the root resource's program expressions drop their definite
+        # masks in here; coll=None compiles every capture to nothing, so
+        # the disarmed program is byte-identical
+        coll = _WitColl({}) if witness else None
         # one dynamic-slot leaf site answers every query whose permission
         # is (also) a stored relation; per-slot work below is programs only
         if meta.e_slots or meta.us_fanout_by_slot:
-            d_out, p_out, lovf, lused = leaf(None, q_res)
+            d_out, p_out, lovf, lused = leaf(None, q_res, coll)
             ovf_out = lovf | (q_cl_ovf & lused)
         else:
             d_out, p_out, ovf_out = zB, zB, zB
         if fold_on and any(s in pf_slots for s in slots):
             # one dynamic pf site answers every folded permission in the
             # dispatch — for a fully folded slot set this IS the kernel
-            fd, fp = pf_probe(None, q_res)
+            fd, fp = pf_probe(None, q_res, coll)
             d_out, p_out = d_out | fd, p_out | fp
         for slot in slots:
             if not perm_programs.get(slot):
                 continue
-            sd, sp, so, su = eval_progs(int(slot), q_res, (), all_types, 0)
             sel = q_perm == slot
+            sd, sp, so, su = eval_progs(
+                int(slot), q_res, (), all_types, 0,
+                None if coll is None else coll.masked(sel),
+            )
             d_out = d_out | (sel & sd)
             p_out = p_out | (sel & sp)
             ovf_out = ovf_out | (sel & (so | (q_cl_ovf & su)))
+            if coll is not None:
+                coll.add("rewrite", sel & sd)
 
         d_out = (d_out & valid_q) | q_self
         p_out = (p_out & valid_q) | q_self
-        return d_out, p_out, ovf_out & ~q_self
+        if coll is None:
+            return d_out, p_out, ovf_out & ~q_self
+        # witness plane: lowest-priority branch first, each later select
+        # overwrites — so the cheapest/leaf-most explanation wins (self >
+        # direct > wildcard > T > fold > userset > rewrite).  Nonzero
+        # only for device-DEFINITE allowed verdicts: conditional/overflow
+        # rows resolve on the host oracle, which needs no seed
+        wit = jnp.zeros(q_res.shape, jnp.int32)
+        for wkey, wcode in (
+            ("rewrite", WIT_REWRITE | (1 << WIT_LEVEL_SHIFT)),
+            ("us", WIT_USERSET),
+            ("fold", WIT_FOLD),
+            ("t", WIT_TPROBE),
+            ("wildcard", WIT_WILDCARD),
+            ("direct", WIT_DIRECT),
+        ):
+            wm = coll.store.get(wkey)
+            if wm is not None:
+                wit = jnp.where(wm & valid_q, jnp.int32(wcode), wit)
+        wit = jnp.where(q_self, jnp.int32(WIT_SELF), wit)
+        wit = jnp.where(d_out, wit, 0)
+        return d_out, p_out, ovf_out & ~q_self, wit
 
     return jax.jit(fn) if jit else fn

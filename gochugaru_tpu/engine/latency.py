@@ -147,6 +147,15 @@ class LatencyPath:
         #: perf ledger's meta model) — sampled dispatch spans carry
         #: ``bytes_gathered_est`` without recomputing the model per call
         self._bpc_cache: Optional[float] = None
+        #: decision-provenance witness extraction (engine/explain.py):
+        #: armed, dispatches run the witness kernel variant (pinned under
+        #: its own key — the disarmed pins are untouched) and the per-
+        #: query winning-branch codes land on ``last_witness``.  Disarmed
+        #: (default) the dispatch path pays ONE flag read; no witness
+        #: buffer exists, no extra device output ships — the same
+        #: zero-cost discipline as trace.NOOP
+        self.witness_armed = False
+        self.last_witness: Optional[np.ndarray] = None
 
     def _bytes_per_check(self) -> float:
         v = self._bpc_cache
@@ -163,6 +172,16 @@ class LatencyPath:
         """Smallest configured tier holding ``B``, or None (→ fall back
         to the throughput path)."""
         return tier_for(self.engine.config.latency_tiers, B)
+
+    def arm_witness(self, on: bool = True) -> None:
+        """Toggle witness extraction for subsequent dispatches.  Armed
+        and disarmed executables pin under distinct keys, so flipping
+        never evicts or retraces the other mode's pins — the first armed
+        dispatch per (slots, tier, qctx shape) pays one compile, warm
+        dispatches after that are pinned like any other."""
+        self.witness_armed = bool(on)
+        if not on:
+            self.last_witness = None
 
     # -- pinning ---------------------------------------------------------
     def _fingerprint(self) -> Tuple:
@@ -201,17 +220,22 @@ class LatencyPath:
 
     def _pinned_for(self, slots, tier, qctx_key, args):
         """The pinned executable for this (slots, tier, qctx shape) —
-        local-first, then the engine-wide cache, then a real compile."""
+        local-first, then the engine-wide cache, then a real compile.
+        Witness-armed dispatches pin the witness kernel variant under a
+        distinct key; disarmed keys are exactly the pre-witness ones."""
         import jax
 
-        key = (slots, tier, qctx_key)
+        wit = self.witness_armed
+        key = (slots, tier, qctx_key) if not wit else (
+            slots, tier, qctx_key, "wit"
+        )
         fn = self._local.get(key)
         if fn is not None:
-            return fn, False
+            return fn, False, key
         with self._lock:
             fn = self._local.get(key)
             if fn is not None:
-                return fn, False
+                return fn, False, key
             full_key = (self.dsnap.flat_meta, self._fingerprint(), key)
             with self.engine._latency_pins_lock:
                 fn = self.engine._latency_pins.get(full_key)
@@ -225,6 +249,7 @@ class LatencyPath:
                             self.engine.compiled, self.engine.plan,
                             self.engine.config, self.dsnap.flat_meta, slots,
                             caveat_plan=self.engine.caveat_plan, jit=False,
+                            witness=wit,
                         ),
                         # donate the query matrix: its device buffer is
                         # re-uploaded fresh every dispatch, so XLA may
@@ -235,7 +260,9 @@ class LatencyPath:
                     # share the engine's jit cache with the throughput
                     # path: the trace is reused, only the AOT compile
                     # at the tier's shape is new
-                    jfn = self.engine._flat_fn_for(slots, self.dsnap.flat_meta)
+                    jfn = self.engine._flat_fn_for(
+                        slots, self.dsnap.flat_meta, witness=wit
+                    )
                 fn = jfn.lower(*args).compile()
                 self.compile_count += 1
                 self._m.inc("latency.compiles")
@@ -257,7 +284,7 @@ class LatencyPath:
             while len(self._local) > self.engine.LATENCY_PIN_CACHE_MAX:
                 self._local.pop(next(iter(self._local)))
             self.pin_count += 1
-            return fn, fresh
+            return fn, fresh, key
 
     def _qm_buf(self, tier: int) -> np.ndarray:
         buf = self._qm_bufs.get(tier)
@@ -347,8 +374,12 @@ class LatencyPath:
 
         # ---- stage 3: pinned kernel (blocked) --------------------------
         args = (self.dsnap.arrays, self.dsnap.tid_map, now_dev, qm_dev, qctx_dev)
-        fn, fresh = self._pinned_for(slots, tier, qctx_key, args)
-        pin_key = (slots, tier, qctx_key)
+        # served-key identity must carry the witness mode: the first
+        # ARMED compile for a combo served warm disarmed is a new pin,
+        # not a lost one — a false latency.retrace incident otherwise.
+        # _pinned_for returns the key it resolved so the mutable
+        # witness_armed flag is read exactly once per dispatch
+        fn, fresh, pin_key = self._pinned_for(slots, tier, qctx_key, args)
         if fresh and pin_key in self._served_keys:
             # retrace detection: this exact shape was served warm before,
             # so the compile we just paid means its pin was evicted —
@@ -366,7 +397,12 @@ class LatencyPath:
         t3 = time.perf_counter()
 
         # ---- stage 4: D2H readback -------------------------------------
-        d, p, ovf = jax.device_get(out)
+        got = jax.device_get(out)
+        if len(got) == 4:  # witness-armed kernel: fourth plane = codes
+            d, p, ovf, w = got
+            self.last_witness = w[:B]
+        else:
+            d, p, ovf = got
         t4 = time.perf_counter()
 
         budget = DispatchBudget(

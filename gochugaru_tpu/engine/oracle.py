@@ -136,6 +136,38 @@ class Oracle:
             return U
         return T if result else F
 
+    def _edge_gate_explain(
+        self, e: _Edge, query_ctx: Mapping[str, Any], now_us: int
+    ):
+        """``_edge_gate`` with the WHY: (gate, detail dict or None) — the
+        expiry stamp that killed the edge, the caveat name, the merged
+        context values that gated it, and the tri-state outcome.  Runs
+        only under an explain recorder (engine/explain.py); the hot
+        fallback path stays on ``_edge_gate``.  The two MUST agree —
+        every return mirrors a ``_edge_gate`` return line-for-line."""
+        detail: Dict[str, Any] = {}
+        if e.expires_us:
+            detail["expires_us"] = e.expires_us
+            if e.expires_us <= now_us:
+                detail["expired"] = True
+                return F, detail
+        if not e.caveat_name:
+            return T, (detail or None)
+        detail["caveat"] = e.caveat_name
+        prog = self.caveat_programs.get(e.caveat_name)
+        if prog is None:
+            detail["caveat_result"] = "uncompiled"
+            return U, detail
+        merged = dict(query_ctx)
+        merged.update(e.caveat_context)
+        detail["context"] = dict(merged)
+        result = prog.evaluate(merged)
+        if result is UNKNOWN:
+            detail["caveat_result"] = "missing_context"
+            return U, detail
+        detail["caveat_result"] = bool(result)
+        return (T if result else F), detail
+
     # ------------------------------------------------------------------
     def check(
         self,
@@ -147,10 +179,29 @@ class Oracle:
         subject_relation: str = "",
         context: Optional[Mapping[str, Any]] = None,
         now_us: Optional[int] = None,
+        *,
+        recorder=None,
+        seed_branch: Optional[str] = None,
     ) -> int:
         """Tri-state check of one (resource, permission, subject).
         ``now_us`` pins the evaluation time for this call (cursor-pinned
-        lookup re-checks); None keeps the oracle's own clock."""
+        lookup re-checks); None keeps the oracle's own clock.
+
+        ``recorder`` (engine/explain.py Recorder, duck-typed: push/pop/
+        leaf) instruments THIS walker into a typed resolution tree —
+        membership/userset/arrow steps, caveat evaluations with the
+        merged context that gated them, expiry gates, wildcard grants,
+        cycle cuts, and (for denials) every explored-and-exhausted edge.
+        With ``recorder=None`` every hook is one ``is not None`` branch:
+        the hot fallback path is unchanged.
+
+        ``seed_branch`` ("direct" | "wildcard" | "userset") reorders the
+        ROOT relation's edge iteration to try the named class first —
+        the device witness seeds the walk toward the branch the kernel
+        already proved won.  Sound by construction: relation evaluation
+        is a short-circuited max over edges, and max is commutative, so
+        reordering can only change WHICH winning path the tree shows,
+        never the verdict."""
         memo: Dict[Tuple[str, str, str], int] = {}
         in_progress: Set[Tuple[str, str, str]] = set()
         # Keys that were returned as F because they were in progress (cycle
@@ -163,20 +214,50 @@ class Oracle:
         if now_us is None:
             now_us = self._now_us()
         subject = (subject_type, subject_id, subject_relation)
+        rec = recorder
+        root_key = (resource_type, resource_id, permission)
+
+        def gate_of(e: _Edge):
+            """(gate, detail) — detail only under a recorder."""
+            if rec is None:
+                return self._edge_gate(e, ctx, now_us), None
+            return self._edge_gate_explain(e, ctx, now_us)
+
+        def subj_str(t: str, i: str, r: str) -> str:
+            return f"{t}:{i}#{r}" if r else f"{t}:{i}"
 
         def eval_item(rtype: str, rid: str, item: str) -> int:
             if (rtype, rid, item) == subject:
+                if rec is not None:
+                    rec.leaf("self", T, resource=f"{rtype}:{rid}", item=item)
                 return T  # a userset is always a member of itself
             d = self.schema.definitions.get(rtype)
             if d is None:
+                if rec is not None:
+                    rec.leaf("missing_type", F, resource=f"{rtype}:{rid}",
+                             item=item)
                 return F
             key = (rtype, rid, item)
             if key in memo:
+                if rec is not None:
+                    rec.leaf("memoized", memo[key],
+                             resource=f"{rtype}:{rid}", item=item)
                 return memo[key]
             if key in in_progress:
                 cut_hits.add(key)
+                if rec is not None:
+                    rec.leaf("cycle_cut", F, resource=f"{rtype}:{rid}",
+                             item=item)
                 return F  # least fixpoint on recursion
             in_progress.add(key)
+            if rec is not None:
+                rec.push(
+                    "relation" if item in d.relations else (
+                        "permission" if item in d.permissions else "missing"
+                    ),
+                    resource=f"{rtype}:{rid}", item=item,
+                )
+            out = F
             try:
                 if item in d.relations:
                     out = eval_relation(rtype, rid, item)
@@ -186,6 +267,8 @@ class Oracle:
                     out = F
             finally:
                 in_progress.discard(key)
+                if rec is not None:
+                    rec.pop(out)
             cut_hits.discard(key)  # cuts to this node are resolved by `out`
             if not (cut_hits & in_progress):
                 memo[key] = out
@@ -193,78 +276,198 @@ class Oracle:
 
         def eval_relation(rtype: str, rid: str, relation: str) -> int:
             out = F
-            for e in self._edges_of(rtype, rid, relation):
-                gate = self._edge_gate(e, ctx, now_us)
-                if gate == F:
-                    continue
+            edges = self._edges_of(rtype, rid, relation)
+            if seed_branch is not None and (rtype, rid) == root_key[:2]:
+                # witness-seeded walk: stable-sort the ROOT RESOURCE's
+                # relation edges (the checked relation itself, or the
+                # leaf relations its permission program references) so
+                # the class the device kernel proved winning is explored
+                # first (short-circuit lands on it)
+                def _cls(e: _Edge) -> int:
+                    if e.subject_relation:
+                        mine = seed_branch == "userset"
+                    elif e.subject_id == WILDCARD_ID:
+                        mine = seed_branch == "wildcard"
+                    else:
+                        mine = seed_branch == "direct"
+                    return 0 if mine else 1
+
+                edges = sorted(edges, key=_cls)
+            skipped = 0
+            for e in edges:
+                if rec is None and e.subject_relation == "" \
+                        and e.subject_id != WILDCARD_ID \
+                        and (e.subject_type, e.subject_id, "") != subject:
+                    continue  # cheap pre-skip of non-matching direct edges
+                gate, gd = gate_of(e)
                 if e.subject_relation == "":
                     if e.subject_id == WILDCARD_ID:
                         # wildcard grants any direct subject of the type
-                        if subject_relation == "" and e.subject_type == subject_type \
+                        if gate != F and subject_relation == "" \
+                                and e.subject_type == subject_type \
                                 and subject_id != WILDCARD_ID:
+                            if rec is not None:
+                                rec.leaf(
+                                    "wildcard", gate,
+                                    subject=f"{e.subject_type}:*",
+                                    gate=gd,
+                                )
                             out = max(out, gate)
-                        elif (e.subject_type, e.subject_id, "") == subject:
+                        elif gate != F and (
+                            e.subject_type, e.subject_id, ""
+                        ) == subject:
+                            if rec is not None:
+                                rec.leaf(
+                                    "direct", gate,
+                                    subject=f"{e.subject_type}:*",
+                                    gate=gd,
+                                )
                             out = max(out, gate)  # checking the wildcard itself
+                        elif rec is not None and gate == F:
+                            rec.leaf("wildcard", F,
+                                     subject=f"{e.subject_type}:*", gate=gd)
                     elif (e.subject_type, e.subject_id, "") == subject:
+                        if rec is not None:
+                            rec.leaf(
+                                "direct", gate,
+                                subject=subj_str(e.subject_type,
+                                                 e.subject_id, ""),
+                                gate=gd,
+                            )
                         out = max(out, gate)
+                    else:
+                        skipped += 1  # direct edge for another subject
                 else:
-                    sub = eval_item(e.subject_type, e.subject_id, e.subject_relation)
+                    if gate == F:
+                        if rec is not None:
+                            rec.leaf(
+                                "userset", F,
+                                subject=subj_str(
+                                    e.subject_type, e.subject_id,
+                                    e.subject_relation,
+                                ),
+                                gate=gd,
+                            )
+                        continue
+                    if rec is not None:
+                        rec.push(
+                            "userset",
+                            subject=subj_str(e.subject_type, e.subject_id,
+                                             e.subject_relation),
+                            gate=gd,
+                        )
+                    sub = eval_item(e.subject_type, e.subject_id,
+                                    e.subject_relation)
+                    if rec is not None:
+                        rec.pop(min(gate, sub))
                     out = max(out, min(gate, sub))
                 if out == T:
+                    if rec is not None and skipped:
+                        rec.set("edges_skipped", skipped)
                     return T
+            if rec is not None and skipped:
+                rec.set("edges_skipped", skipped)
             return out
 
         def eval_expr(rtype: str, rid: str, expr: Expr) -> int:
             if isinstance(expr, RelationRef):
                 return eval_item(rtype, rid, expr.name)
             if isinstance(expr, Nil):
+                if rec is not None:
+                    rec.leaf("nil", F)
                 return F
             if isinstance(expr, Arrow):
+                if rec is not None:
+                    rec.push("arrow", left=expr.left, right=expr.right,
+                             resource=f"{rtype}:{rid}")
                 out = F
-                for e in self._edges_of(rtype, rid, expr.left):
-                    if e.subject_relation != "" or e.subject_id == WILDCARD_ID:
-                        continue  # arrows traverse direct (ellipsis) subjects
-                    gate = self._edge_gate(e, ctx, now_us)
-                    if gate == F:
-                        continue
-                    sub_def = self.schema.definitions.get(e.subject_type)
-                    if sub_def is None or sub_def.item(expr.right) is None:
-                        continue
-                    sub = eval_item(e.subject_type, e.subject_id, expr.right)
-                    out = max(out, min(gate, sub))
-                    if out == T:
-                        return T
-                return out
+                try:
+                    for e in self._edges_of(rtype, rid, expr.left):
+                        if e.subject_relation != "" or e.subject_id == WILDCARD_ID:
+                            continue  # arrows traverse direct (ellipsis) subjects
+                        gate, gd = gate_of(e)
+                        if gate == F:
+                            if rec is not None:
+                                rec.leaf(
+                                    "arrow_edge", F,
+                                    via=subj_str(e.subject_type,
+                                                 e.subject_id, ""),
+                                    gate=gd,
+                                )
+                            continue
+                        sub_def = self.schema.definitions.get(e.subject_type)
+                        if sub_def is None or sub_def.item(expr.right) is None:
+                            continue
+                        if rec is not None:
+                            rec.push(
+                                "arrow_edge",
+                                via=subj_str(e.subject_type, e.subject_id, ""),
+                                gate=gd,
+                            )
+                        sub = eval_item(e.subject_type, e.subject_id, expr.right)
+                        if rec is not None:
+                            rec.pop(min(gate, sub))
+                        out = max(out, min(gate, sub))
+                        if out == T:
+                            return T
+                    return out
+                finally:
+                    if rec is not None:
+                        rec.pop(out)
             if isinstance(expr, Union):
+                if rec is not None:
+                    rec.push("union")
                 out = F
-                for c in expr.children:
-                    out = max(out, eval_expr(rtype, rid, c))
-                    if out == T:
-                        return T
-                return out
+                try:
+                    for c in expr.children:
+                        out = max(out, eval_expr(rtype, rid, c))
+                        if out == T:
+                            return T
+                    return out
+                finally:
+                    if rec is not None:
+                        rec.pop(out)
             if isinstance(expr, Intersection):
+                if rec is not None:
+                    rec.push("intersection")
                 out = T
-                for c in expr.children:
-                    out = min(out, eval_expr(rtype, rid, c))
-                    if out == F:
-                        return F
-                return out
+                try:
+                    for c in expr.children:
+                        out = min(out, eval_expr(rtype, rid, c))
+                        if out == F:
+                            return F
+                    return out
+                finally:
+                    if rec is not None:
+                        rec.pop(out)
             if isinstance(expr, Exclusion):
-                base = eval_expr(rtype, rid, expr.base)
-                if base == F:
-                    return F
-                sub = eval_expr(rtype, rid, expr.subtracted)
-                return min(base, 2 - sub)
+                if rec is not None:
+                    rec.push("exclusion")
+                out = F
+                try:
+                    base = eval_expr(rtype, rid, expr.base)
+                    if base == F:
+                        return F
+                    sub = eval_expr(rtype, rid, expr.subtracted)
+                    out = min(base, 2 - sub)
+                    return out
+                finally:
+                    if rec is not None:
+                        rec.pop(out)
             raise TypeError(f"unknown expression node {expr!r}")
 
         return eval_item(resource_type, resource_id, permission)
 
     def check_relationship(
-        self, r: Relationship, context: Optional[Mapping[str, Any]] = None
+        self, r: Relationship, context: Optional[Mapping[str, Any]] = None,
+        *, now_us: Optional[int] = None, recorder=None,
+        seed_branch: Optional[str] = None,
     ) -> int:
         """Check where the query is phrased as a relationship, as the whole
         Check family does (client/client.go:238-259): resource_relation is
-        the permission, caveat_context is the request context."""
+        the permission, caveat_context is the request context.
+        ``recorder``/``seed_branch`` thread through to the instrumented
+        walk (engine/explain.py)."""
         ctx = dict(context or {})
         if r.caveat_context:
             ctx.update(r.caveat_context)
@@ -276,6 +479,9 @@ class Oracle:
             r.subject_id,
             r.subject_relation,
             ctx,
+            now_us=now_us,
+            recorder=recorder,
+            seed_branch=seed_branch,
         )
 
     # ------------------------------------------------------------------

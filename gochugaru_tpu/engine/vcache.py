@@ -487,6 +487,17 @@ class VerdictCache:
                 self._evict_locked()
                 self._publish_locked()
 
+    def peek_rel(self, revision: int, key) -> Optional[tuple]:
+        """Metric-free single-key probe: the explain surface records
+        whether a verdict WOULD have been cache-served (provenance)
+        without polluting hit/miss counters, firing the chaos site, or
+        refreshing the shard's LRU position."""
+        with self._lock:
+            sh = self._revs.get(revision)
+        if sh is None:
+            return None
+        return sh["r"].get(key)
+
     # -- lifecycle / introspection ---------------------------------------
     def drop_revision(self, revision: int) -> None:
         """Structural invalidation hook: when the client's dsnap LRU

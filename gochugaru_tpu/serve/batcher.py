@@ -127,7 +127,8 @@ class SubmitFuture:
     (front-end critical on the 1-core proxy) must not pay for a wait
     that usually never happens."""
 
-    __slots__ = ("_done", "_ev", "_value", "_error", "t_submit", "t_done")
+    __slots__ = ("_done", "_ev", "_value", "_error", "t_submit", "t_done",
+                 "dedup_parked")
 
     def __init__(self, t_submit: float) -> None:
         self._done = False
@@ -136,6 +137,11 @@ class SubmitFuture:
         self._error: Optional[BaseException] = None
         self.t_submit = t_submit
         self.t_done: Optional[float] = None
+        #: True when this submission PARKED on an in-flight twin batch
+        #: (engine/vcache.Singleflight) — decision-log provenance: its
+        #: verdicts never passed the evaluate layer themselves, so the
+        #: serving handle records them with ``dedup_parked: true``
+        self.dedup_parked = False
 
     def done(self) -> bool:
         return self._done
@@ -389,6 +395,7 @@ class MicroBatcher:
                 else:
                     keys = [_vcache.rel_key(r) for r in rels]
                 if sf.try_park(keys, fut, kind, n):
+                    fut.dedup_parked = True
                     span.event("serve.dedup_parked", checks=n)
                     return fut
         shed_depth = None

@@ -13,12 +13,14 @@ open-loop callers that must not block on their own traffic
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, List, Optional
 
 import numpy as np
 
 from ..engine.plan import EngineConfig
 from ..rel.relationship import RelationshipLike, as_relationship
+from ..utils import decisions as _decisions
 from ..utils import trace as _trace
 from ..utils.retry import retry_retriable_errors
 from .batcher import MicroBatcher, ServeConfig, SubmitFuture
@@ -91,12 +93,25 @@ class ServingHandle:
         return client_id if client_id is not None else threading.get_ident()
 
     def check(
-        self, ctx, *rs: RelationshipLike, client_id=None
+        self, ctx, *rs: RelationshipLike, client_id=None,
+        explain: bool = False,
     ) -> List[bool]:
         """Batched permission check through the micro-batcher: submits
         into the next formed tier slot and awaits the coalesced result,
         under the same retry envelope ``client.check`` uses (a shed or
-        a transient batch fault re-submits)."""
+        a transient batch fault re-submits).
+
+        ``explain=True`` additionally re-derives each verdict's typed
+        resolution tree at the handle's pinned strategy — ONE snapshot
+        for the whole batch's trees (witness codes extracted in one
+        armed dispatch), returning ``List[ExplainedCheck]``: the
+        coalesced verdict plus the tree.  The verdict came from the
+        batcher's own dispatch snapshot; under ``min_latency`` a write
+        landing between the coalesced dispatch and the explain can move
+        the head, so a tree disagreeing with its served verdict is
+        flagged ``verdict_skew`` (the tree's ``revision`` names the
+        world it describes) instead of silently posing as the verdict's
+        derivation."""
         self._client._check_overlap(ctx)
         rels = [as_relationship(r) for r in rs]
         if not rels:
@@ -104,13 +119,69 @@ class ServingHandle:
         cid = self._client_id(client_id)
         root = _trace.root_span("serve.check", batch=len(rels))
         ctx = _trace.ctx_with_span(ctx, root)
+        pre_snap = pre_ents = None
+        if explain:
+            # cache residency probed BEFORE submitting: entries the
+            # coalesced dispatch itself inserts are fresh work, not
+            # cache-served provenance
+            pre_snap = self._client._store.snapshot_for(self._cs)
+            pre_ents = self._client._peek_cached(pre_snap, rels, self._cs)
 
         def attempt():
             fut = self.batcher.submit_rels(cid, rels, ctx)
-            return fut.result(ctx)
+            out = fut.result(ctx)
+            if fut.dedup_parked:
+                # parked on an in-flight twin: these verdicts never ran
+                # the evaluate layer themselves, so their provenance is
+                # recorded HERE — counted, and logged dedup_parked
+                _decisions.count_verdicts(
+                    self.batcher._m,
+                    sum(1 for v in out if v),
+                    sum(1 for v in out if not v),
+                    _decisions.strategy_name(self._cs),
+                )
+                if _decisions.enabled():
+                    _decisions.record_rels(
+                        rels, out, strategy=self._cs, dedup_parked=True,
+                        latency_s=(
+                            (fut.t_done or time.perf_counter())
+                            - fut.t_submit
+                        ),
+                        trace_id=root.trace_id if root.sampled else None,
+                        client_id=cid,
+                    )
+            return out
 
         with root:
-            return retry_retriable_errors(ctx, attempt)
+            verdicts = retry_retriable_errors(ctx, attempt)
+            if not explain:
+                return verdicts
+            client = self._client
+
+            def derive():
+                sp = _trace.span_of(ctx)
+                snap = client._store.snapshot_for(self._cs)
+                # if a write moved the head since the pre-submit probe,
+                # its entries describe another revision: treat every
+                # item as uncached rather than mislabel provenance
+                ents = (
+                    pre_ents
+                    if pre_snap is not None
+                    and snap.revision == pre_snap.revision
+                    else [None] * len(rels)
+                )
+                # the witness extraction is a real device dispatch: it
+                # runs under the client's admission envelope (deadline
+                # shed + in-flight gate), same as client explain
+                codes = client._admitted(
+                    ctx, sp, lambda: client._witness_batch(snap, rels)
+                )
+                return client._explain_batch(
+                    snap, rels, verdicts, self._cs, cache_ents=ents,
+                    codes=codes,
+                )
+
+            return retry_retriable_errors(ctx, derive)
 
     def check_one(self, ctx, r: RelationshipLike, *, client_id=None) -> bool:
         return self.check(ctx, r, client_id=client_id)[0]

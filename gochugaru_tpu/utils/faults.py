@@ -56,6 +56,11 @@ Injection sites threaded through the tree (grep ``faults.fire``):
     batcher.dispatch         formed-batch dispatch (serve/batcher.py;
                              classified onto the futures, so the
                              submitters' retry envelopes re-submit)
+    cache.lookup             verdict-cache read (engine/vcache.py)
+    explain.walk             explain-tree derivation (engine/explain.py;
+                             fires BEFORE any tree state exists, so the
+                             client envelope's retry can never observe
+                             a torn tree)
 """
 
 from __future__ import annotations

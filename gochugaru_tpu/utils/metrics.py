@@ -200,6 +200,17 @@ class Metrics:
         with self._lock:
             return self._counters.get(name, 0.0)
 
+    def counters_prefixed(self, prefix: str) -> Dict[str, float]:
+        """Every counter under ``prefix`` — the tagged-family accessor
+        (per-strategy verdict counters ``check.verdicts.*``, decision
+        drop counters ``decisions.*``) for endpoints and tests that want
+        one family without a full snapshot."""
+        with self._lock:
+            return {
+                k: v for k, v in self._counters.items()
+                if k.startswith(prefix)
+            }
+
     def percentile(self, name: str, q: float) -> Optional[float]:
         """The q-th percentile (seconds) over the timer's sample ring, or
         None when the timer has no samples.  Honest within the ring: at

@@ -112,7 +112,15 @@ def default_slos() -> Tuple[SLO, ...]:
       vs. offered work;
     - transient-fault budget: retry-envelope activity vs. requested
       checks (a fault storm burns this one — scripts/slo_smoke.sh's
-      subject).
+      subject);
+    - denial-rate budget: denied verdicts vs. all verdicts (the
+      per-strategy ``check.verdicts.*`` counters, utils/decisions.py) —
+      a sustained denial spike is the authorization-domain anomaly an
+      operator wants paged on (bad schema push, revoked-edges sweep,
+      token confusion), and the breach edge freezes the flight ring
+      with the deciding traces AND the last-N decisions in the bundle.
+      Generous on purpose: burn-threshold 2 × budget 0.25 ⇒ a sustained
+      ≥50% denial fraction pages, ordinary deny-heavy traffic doesn't.
     """
     return (
         latency_slo("check.dispatch", "checks.dispatch", objective_ms=50.0),
@@ -130,6 +138,12 @@ def default_slos() -> Tuple[SLO, ...]:
             bad=("retry.retries",),
             total=("checks.requested", "serve.submissions"),
             budget=0.01,
+        ),
+        ratio_slo(
+            "denial_rate",
+            bad=("check.verdicts.denied",),
+            total=("check.verdicts.allowed", "check.verdicts.denied"),
+            budget=0.25,
         ),
     )
 

@@ -320,6 +320,36 @@ class TelemetryServer:
                             ),
                             "application/json",
                         )
+                    elif path == "/decisions":
+                        from urllib.parse import parse_qs
+
+                        from . import decisions as _decisions
+
+                        q = parse_qs(query)
+                        try:
+                            n = max(0, int(q.get("n", ["256"])[0]))
+                        except ValueError:
+                            n = 256
+                        log = _decisions.get()
+                        head = {
+                            "kind": "summary",
+                            "enabled": log is not None,
+                            "verdicts": outer._registry.counters_prefixed(
+                                "check.verdicts."
+                            ),
+                        }
+                        if log is not None:
+                            head["stats"] = log.stats()
+                        lines = [json.dumps(head, default=repr)]
+                        if log is not None:
+                            lines.extend(
+                                json.dumps(e, default=repr)
+                                for e in log.tail(n)
+                            )
+                        self._reply(
+                            200, "\n".join(lines) + "\n",
+                            "application/x-ndjson; charset=utf-8",
+                        )
                     elif path == "/slo":
                         slo = _live_slo(outer._slo)
                         body = (

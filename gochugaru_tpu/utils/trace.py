@@ -747,6 +747,20 @@ class FlightRecorder:
                     context[k] = fn()
                 except Exception as e:  # a broken provider loses itself only
                     context[k] = {"provider_error": type(e).__name__}
+            # decision provenance: every bundle carries the last-N
+            # authorization DECISIONS (utils/decisions.py) — "what was
+            # being decided when the breaker tripped / the denial-rate
+            # SLO burned" ships inside the bundle, not in a separate
+            # store an operator has to correlate by timestamp
+            decisions = None
+            try:
+                from . import decisions as _decisions
+
+                dlog = _decisions.get()
+                if dlog is not None:
+                    decisions = dlog.tail(32)
+            except Exception:  # provenance must never lose the bundle
+                decisions = None
             head = {
                 "kind": "incident",
                 "id": meta["id"],
@@ -762,6 +776,8 @@ class FlightRecorder:
                 "device_bytes": gauges.get("snapshot.device_bytes"),
                 "context": context,
             }
+            if decisions is not None:
+                head["decisions"] = decisions
             # default=repr: a provider returning a numpy scalar (or a
             # span attr holding one) must degrade to its repr, not lose
             # the whole bundle to a TypeError mid-capture

@@ -41,8 +41,14 @@ import sys
 _LOWER_BETTER_UNITS = {"ms", "s", "seconds", "mb", "mib", "bytes", "gb"}
 #: metric-name suffixes that mark lower-better numbers regardless of unit
 #: (``pad_fraction``: the perf ledger's wasted-lanes share)
+#: (``explain_overhead_frac``: the armed decision-log median shift on
+#: the client check path as a fraction of its p99, from the smoke's
+#: interleaved-rep A/B — growing means provenance is creeping into the
+#: serving budget; ``decisions_dropped``: decision-log entries lost to
+#: sink failures — any growth is an audit-trail hole)
 _LOWER_BETTER_SUFFIXES = (
     "_ms", "_s", "_latency", "_bytes", "_rss_mb", "pad_fraction",
+    "explain_overhead_frac", "decisions_dropped",
 )
 #: suffixes that are HIGHER-better regardless of unit — checked FIRST,
 #: so the perf columns can't be misread by a unit heuristic
@@ -63,7 +69,7 @@ _HIGHER_BETTER_SUFFIXES = (
 #: and a 0.0003→0.0001 wiggle must not fail a round)
 _PROMOTED_FIELDS = (
     "true_rate", "p99_ms", "achieved_gbps", "roofline_frac", "pad_fraction",
-    "cache_hit_rate",
+    "cache_hit_rate", "explain_overhead_frac", "decisions_dropped",
 )
 #: boolean/one-shot rows that carry no trajectory signal
 _SKIP_UNITS = {"ok", "capture", "keys"}

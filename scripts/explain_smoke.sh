@@ -1,0 +1,224 @@
+#!/usr/bin/env bash
+# Decision-provenance smoke: explain-vs-oracle path parity on a
+# caveat+wildcard+fold world (witness-seeded device explain == the
+# instrumented oracle walk, witness ⊆ oracle path), a denial tree
+# carrying the exhausted frontier, cache-hit re-derivation at the pinned
+# revision, decision-log ring + JSONL rotation, live /decisions +
+# per-strategy verdict counters + the stock denial-rate SLO + a
+# decision-carrying incident bundle, and an interleaved-rep A/B pricing
+# the provenance layer's disarmed cost (explain_overhead_frac).  Prints
+# EXPLAIN-SMOKE-OK on success — the CI-runnable proof, mirroring
+# scripts/cache_smoke.sh.
+#
+# Usage:
+#   scripts/explain_smoke.sh
+#   EXPLAIN_SMOKE_CHECKS=60 scripts/explain_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+: "${EXPLAIN_SMOKE_CHECKS:=40}"
+: "${EXPLAIN_SMOKE_TIMEOUT_S:=420}"
+
+export EXPLAIN_SMOKE_CHECKS
+
+timeout -k 10 "${EXPLAIN_SMOKE_TIMEOUT_S}" env JAX_PLATFORMS=cpu python - <<'EOF'
+import datetime as dt
+import json
+import os
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+
+from gochugaru_tpu import consistency, rel
+from gochugaru_tpu.client import (
+    new_tpu_evaluator, with_decision_log, with_host_only_evaluation,
+    with_latency_mode, with_store, with_telemetry,
+)
+from gochugaru_tpu.engine import explain as ex
+from gochugaru_tpu.utils import decisions as dec
+from gochugaru_tpu.utils import metrics, trace
+from gochugaru_tpu.utils.context import background
+
+N = int(os.environ.get("EXPLAIN_SMOKE_CHECKS", "40"))
+m = metrics.default
+tmp = tempfile.mkdtemp(prefix="gochugaru_decisions_")
+sink = os.path.join(tmp, "decisions.jsonl")
+
+c = new_tpu_evaluator(
+    with_latency_mode(),
+    with_decision_log(sink_path=sink, rotate_bytes=4096, rotate_keep=3),
+    with_telemetry(port=0),
+)
+ctx = background()
+c.write_schema(ctx, """
+caveat tier_at_least(tier int, minimum int) { tier >= minimum }
+definition user {}
+definition team { relation member: user | team#member }
+definition org { relation admin: user }
+definition doc {
+    relation org: org
+    relation reader: user | user:* | team#member | user with tier_at_least
+    relation banned: user
+    permission admin = org->admin
+    permission read = reader - banned
+}
+""")
+rng = np.random.default_rng(20260804)
+now_s = time.time()
+txn = rel.Txn()
+for t in range(4):
+    for u in rng.choice(30, 3, replace=False):
+        txn.touch(rel.must_from_tuple(f"team:t{t}#member", f"user:u{u}"))
+    if t + 1 < 4:
+        txn.touch(rel.must_from_tuple(f"team:t{t}#member",
+                                      f"team:t{t + 1}#member"))
+for d in range(20):
+    txn.touch(rel.must_from_triple(f"doc:d{d}", "org", f"org:o{d % 3}"))
+    txn.touch(rel.must_from_triple(
+        f"doc:d{d}", "reader", f"user:u{rng.integers(30)}"))
+    if d % 5 == 0:
+        txn.touch(rel.must_from_triple(f"doc:d{d}", "reader", "user:*"))
+    if d % 4 == 0:
+        txn.touch(rel.must_from_tuple(
+            f"doc:d{d}#reader", f"team:t{rng.integers(4)}#member"))
+    if d % 6 == 0:
+        txn.touch(rel.must_from_triple(
+            f"doc:d{d}", "reader", f"user:cv{d}"
+        ).with_caveat("tier_at_least", {"minimum": 5}))
+    if d % 7 == 0:
+        txn.touch(rel.must_from_triple(
+            f"doc:d{d}", "reader", f"user:exp{d}"
+        ).with_expiration(dt.datetime.fromtimestamp(
+            now_s - 60, tz=dt.timezone.utc)))
+    if d % 3 == 0:
+        txn.touch(rel.must_from_triple(
+            f"doc:d{d}", "banned", f"user:u{rng.integers(30)}"))
+for o in range(3):
+    txn.touch(rel.must_from_triple(f"org:o{o}", "admin", f"user:u{o}"))
+c.write(ctx, txn)
+oracle = new_tpu_evaluator(with_host_only_evaluation(), with_store(c.store))
+cs = consistency.full()
+
+# -- phase 1: explain-vs-oracle parity + witness containment ------------
+queries = []
+for i in range(N):
+    perm = ["read", "admin", "reader"][i % 3]
+    queries.append(rel.must_from_triple(
+        f"doc:d{rng.integers(20)}", perm, f"user:u{rng.integers(30)}"))
+want = oracle.check(ctx, cs, *queries)
+snap = c.store.snapshot_for(cs)
+engine = c._engine_for(snap)
+codes = engine.witness_codes(c._dsnap_for(engine, snap), queries)
+assert codes is not None, "witness extraction unavailable on this world"
+branches = {}
+t0 = time.perf_counter()
+for i, q in enumerate(queries):
+    tree = c.explain(ctx, cs, q)
+    assert (tree["result"] == "allowed") == want[i], (q, tree["result"])
+    w = int(codes[i])
+    assert ex.witness_consistent(tree, w), (q, w)
+    if w:
+        branches[ex.witness_name(w)] = branches.get(ex.witness_name(w), 0) + 1
+explain_ms = (time.perf_counter() - t0) / N * 1000.0
+assert {"direct", "fold"} & set(branches), branches
+print(f"# explain parity: {N} checks == oracle (bool collapse), witness "
+      f"subset held; branches={branches}; mean explain {explain_ms:.2f} ms")
+
+# -- phase 2: denial tree carries the exhausted frontier ----------------
+denied = next(i for i, w in enumerate(want) if not w)
+tree = c.explain(ctx, cs, queries[denied])
+assert tree["result"] != "allowed"
+
+
+def _nodes(n, out):
+    out.append(n)
+    for ch in (n or {}).get("children", ()):
+        _nodes(ch, out)
+    return out
+
+
+frontier = _nodes(tree["tree"], [])
+assert all("verdict" in n for n in frontier), "torn denial tree"
+print(f"# denial tree: {len(frontier)} explored nodes, root verdict "
+      f"{tree['result']}")
+
+# -- phase 3: cache-hit re-derivation at the pinned revision ------------
+ml = consistency.min_latency()
+with c.with_serving(cs=ml, cache=True) as h:
+    hit = next(q for i, q in enumerate(queries) if want[i])
+    h.check(ctx, hit)
+    h.check(ctx, hit)  # cache-served now
+    t = c.explain(ctx, ml, hit)
+    assert t.get("cached") is True and t["result"] == "allowed"
+    assert t["revision"] == c.store.snapshot_for(ml).revision
+print("# cache-hit re-derivation: cached=true, tree re-derived at the "
+      f"pinned revision {t['revision']}")
+
+# -- phase 4: decision log ring + rotation + counters + endpoints -------
+log = dec.get()
+assert log is not None and len(log) > 0
+rotated = [p for p in os.listdir(tmp) if p.startswith("decisions.jsonl.")]
+assert rotated, "decision-log sink never rotated"
+dropped = int(m.counter("decisions.dropped"))
+assert m.counter("check.verdicts.allowed.full") > 0
+assert m.counter("check.verdicts.denied.full") > 0
+base = c.telemetry.url
+lines = urllib.request.urlopen(base + "/decisions?n=8").read().decode()
+head = json.loads(lines.splitlines()[0])
+assert head["enabled"] and head["verdicts"]["check.verdicts.denied"] > 0
+slo = json.loads(urllib.request.urlopen(base + "/slo").read())
+assert "denial_rate" in [s["name"] for s in slo["slos"]]
+mtx = urllib.request.urlopen(base + "/metrics").read().decode()
+assert "gochugaru_check_verdicts_denied_full_total" in mtx
+rec = trace.recorder()
+iid = rec.trigger("explain_smoke.proof")
+rec.flush()
+bhead = json.loads(rec.bundle(iid).splitlines()[0])
+assert bhead.get("decisions"), "incident bundle carries no decisions"
+print(f"# decision log: ring={len(log)} rotated={len(rotated)} "
+      f"dropped={dropped}; /decisions + denial_rate SLO + "
+      f"decision-carrying bundle live")
+
+# -- phase 5: armed decision-log cost (interleaved-rep A/B) -------------
+# The DISARMED cost is bounded by tests/test_trace_overhead.py on the
+# pinned path; this prices the ARMED log (100% sample + live sink) at
+# the client layer, paired per rep so scheduler noise cancels.
+ab = [([], [])]
+probe = [rel.must_from_triple(f"doc:d{i % 20}", "read",
+                              f"user:u{i % 30}") for i in range(8)]
+reps = 400
+for i in range(reps):
+    on = i & 1
+    # set_recording, NOT install: install(None) closes the sink, and the
+    # next armed rep's file reopen would land inside the timed window
+    dec.set_recording(log if on else None)
+    t0 = time.perf_counter()
+    c.check(ctx, cs, *probe)
+    ab[0][on].append((time.perf_counter() - t0) * 1000.0)
+dec.set_recording(log)
+off, on = (np.asarray(x) for x in ab[0])
+p99_off = float(np.percentile(off, 99))
+delta_p50 = float(np.percentile(on, 50) - np.percentile(off, 50))
+explain_overhead_frac = round(max(delta_p50, 0.0) / max(p99_off, 1e-9), 4)
+print(f"# provenance overhead (interleaved A/B, {reps} reps): "
+      f"delta_p50={delta_p50:.4f} ms, p99_off={p99_off:.3f} ms, "
+      f"frac={explain_overhead_frac}")
+
+print(json.dumps({
+    "metric": "explain_smoke", "value": 1, "unit": "ok", "vs_baseline": 1.0,
+    "checks": N, "explain_ms": round(explain_ms, 3),
+    "explain_overhead_frac": explain_overhead_frac,
+    "decisions_dropped": dropped,
+    "decision_ring": len(log), "rotated_files": len(rotated),
+    "witness_branches": branches,
+    "note": "explain==oracle parity + witness subset + denial frontier + "
+            "cache re-derivation + decision-log rotation + denial-rate SLO",
+}))
+print(f"EXPLAIN-SMOKE-OK checks={N} explain_ms={explain_ms:.2f} "
+      f"overhead_frac={explain_overhead_frac} dropped={dropped} "
+      f"rotated={len(rotated)}")
+EOF
+rc=$?
+exit "$rc"

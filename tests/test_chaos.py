@@ -63,6 +63,7 @@ CHAOS_SITES = (
     "store.snapshot_for",
     "store.materialize",
     "snapshot.finish",
+    "explain.walk",
 )
 
 
@@ -173,8 +174,16 @@ def test_chaos_soak():
         ]
         ctx = background().with_timeout(30.0)
         result = None
+        explained = None
         try:
             result = chaos.check(ctx, consistency.full(), *queries)
+            if rnd % 3 == 0:
+                # explain under the same armed faults: the explain.walk
+                # site (and any armed dispatch/prepare site the witness
+                # extraction hits) classifies into the retry envelope
+                explained = chaos.explain(
+                    ctx, consistency.full(), queries[0]
+                )
         except (UnavailableError, DeadlineExceededError):
             sheds += 1  # allowed: a classified shed, within the deadline
         except BaseException as e:
@@ -189,6 +198,16 @@ def test_chaos_soak():
             want = oracle.check(background(), consistency.full(), *queries)
             if result != want:
                 mismatches.append((rnd, result, want))
+        if explained is not None:
+            # no torn trees: a returned tree is complete (popped root)
+            # and verdict-exact against the oracle at the same head
+            w0 = oracle.check(
+                background(), consistency.full(), queries[0]
+            )[0]
+            if (explained["result"] == "allowed") != w0:
+                mismatches.append((rnd, "explain", explained["result"], w0))
+            if explained["tree"] is None or "verdict" not in explained["tree"]:
+                mismatches.append((rnd, "torn explain tree"))
 
     # ---- drain + verify the watch stream -------------------------------
     drain = background().with_timeout(20.0)

@@ -1,0 +1,231 @@
+"""Structured decision log (utils/decisions.py): ring + sampling +
+always-keep-denied, JSONL sink rotation with drop counters, per-strategy
+verdict counters, the /decisions endpoint, incident-bundle carriage, and
+serve-path provenance (cache_hit / dedup_parked)."""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from gochugaru_tpu import consistency, rel
+from gochugaru_tpu.client import (
+    new_tpu_evaluator,
+    with_decision_log,
+    with_latency_mode,
+    with_telemetry,
+)
+from gochugaru_tpu.utils import decisions as _decisions
+from gochugaru_tpu.utils import metrics as _metrics
+from gochugaru_tpu.utils import trace as _trace
+from gochugaru_tpu.utils.context import background
+from gochugaru_tpu.utils.decisions import DecisionLog, strategy_name
+from gochugaru_tpu.utils.metrics import Metrics
+
+
+@pytest.fixture(autouse=True)
+def _log_hygiene():
+    yield
+    _decisions.install(None)
+
+
+def _r(i=0, allowed=True):
+    return rel.must_from_triple(f"doc:d{i}", "read", f"user:u{i}")
+
+
+def test_strategy_names():
+    assert strategy_name(None) == "direct"
+    assert strategy_name(consistency.full()) == "full"
+    assert strategy_name(consistency.min_latency()) == "min_latency"
+    assert strategy_name(consistency.at_least("3")) == "at_least"
+    assert strategy_name(consistency.snapshot("3")) == "snapshot"
+
+
+def test_sampling_and_always_keep_denied():
+    m = Metrics()
+    log = DecisionLog(capacity=4096, sample_rate=0.0, registry=m, seed=1)
+    _decisions.install(log)
+    rels = [_r(i) for i in range(50)]
+    verdicts = [i % 5 != 0 for i in range(50)]  # 10 denied
+    _decisions.record_rels(rels, verdicts, revision=7,
+                           strategy=consistency.full(), latency_s=0.001)
+    entries = log.tail()
+    # 0% head sample: ONLY the denied decisions survive
+    assert len(entries) == 10
+    assert all(e["verdict"] == "denied" for e in entries)
+    assert all(e["revision"] == 7 and e["strategy"] == "full"
+               for e in entries)
+    assert m.counter("decisions.denied_kept") == 10
+    assert m.counter("decisions.sampled_out") == 40
+    assert m.counter("decisions.recorded") == 10
+    # denied keep is bounded per batch — and the cap is its OWN counter
+    # (never folded into sampling: a capped denied entry is an audit
+    # hole the operator must be able to see)
+    log2 = DecisionLog(sample_rate=0.0, denied_keep_max=3, registry=m)
+    _decisions.install(log2)
+    _decisions.record_rels(rels, [False] * 50, strategy="direct")
+    assert len(log2.tail()) == 3
+    assert m.counter("decisions.denied_capped") == 47
+    assert m.counter("decisions.sampled_out") == 40  # unchanged
+    assert log2.stats()["denied_capped"] == 47
+
+
+def test_ring_bound_and_entry_fields():
+    m = Metrics()
+    log = DecisionLog(capacity=8, registry=m)
+    _decisions.install(log)
+    _decisions.record_rels(
+        [_r(i) for i in range(20)], [True] * 20, revision=3,
+        strategy=consistency.min_latency(),
+        cache_hits=[i % 2 == 0 for i in range(20)],
+        latency_s=0.002, trace_id="tid-1", client_id="w7",
+    )
+    entries = log.tail()
+    assert len(entries) == 8  # ring bound
+    e = entries[-1]
+    assert e["resource"] == "doc:d19" and e["permission"] == "read"
+    assert e["subject"] == "user:u19" and e["verdict"] == "allowed"
+    assert e["latency_ms"] == 2.0 and e["trace_id"] == "tid-1"
+    assert e["client"] == "w7"
+    assert any(x.get("cache_hit") for x in entries)
+
+
+def test_sink_rotation_and_drop_counters(tmp_path):
+    m = Metrics()
+    sink = str(tmp_path / "d.jsonl")
+    log = DecisionLog(sink_path=sink, rotate_bytes=600, rotate_keep=2,
+                      registry=m)
+    _decisions.install(log)
+    for batch in range(20):
+        _decisions.record_rels([_r(batch)], [True], revision=batch,
+                               strategy="direct")
+    files = sorted(p for p in os.listdir(tmp_path))
+    assert any(p.startswith("d.jsonl.") for p in files)
+    assert m.counter("decisions.rotated") > 0
+    # never more than rotate_keep rotated files
+    assert len([p for p in files if p.startswith("d.jsonl.")]) <= 2
+    # rotated content is valid JSONL
+    with open(tmp_path / "d.jsonl.1") as f:
+        for line in f:
+            json.loads(line)
+    # a dead sink counts drops instead of raising into the caller
+    log2 = DecisionLog(sink_path=str(tmp_path / "nodir" / "x.jsonl"),
+                       registry=m)
+    _decisions.install(log2)
+    _decisions.record_rels([_r(1)], [True], strategy="direct")
+    assert m.counter("decisions.dropped") >= 1
+    assert len(log2.tail()) == 1  # the RING still has it
+
+
+def test_verdict_counters_by_strategy_and_cache_hit():
+    m = Metrics()
+    _decisions.count_verdicts(m, 5, 2, "min_latency", cache_hits=3)
+    _decisions.count_verdicts(m, 1, 0, "full")
+    fam = m.counters_prefixed("check.verdicts.")
+    assert fam["check.verdicts.allowed"] == 6
+    assert fam["check.verdicts.denied"] == 2
+    assert fam["check.verdicts.allowed.min_latency"] == 5
+    assert fam["check.verdicts.denied.min_latency"] == 2
+    assert fam["check.verdicts.allowed.full"] == 1
+    assert fam["check.verdicts.cache_hit"] == 3
+
+
+def test_end_to_end_client_decisions_and_endpoint(tmp_path):
+    c = new_tpu_evaluator(
+        with_latency_mode(),
+        with_decision_log(capacity=512),
+        with_telemetry(port=0),
+    )
+    ctx = background()
+    c.write_schema(ctx, """
+definition user {}
+definition doc { relation reader: user  permission read = reader }
+""")
+    txn = rel.Txn()
+    for i in range(10):
+        txn.touch(rel.must_from_triple(f"doc:d{i}", "reader", f"user:u{i}"))
+    c.write(ctx, txn)
+    cs = consistency.full()
+    for i in range(10):
+        c.check(ctx, cs, rel.must_from_triple(f"doc:d{i}", "read",
+                                              f"user:u{(i + 1) % 10}"))
+    m = _metrics.default
+    assert m.counter("check.verdicts.denied.full") > 0
+    log = _decisions.get()
+    assert log is not None and len(log) > 0
+    denied = [e for e in log.tail() if e["verdict"] == "denied"]
+    assert denied and all("revision" in e for e in denied)
+    # /decisions: summary head + JSONL entries
+    body = urllib.request.urlopen(
+        c.telemetry.url + "/decisions?n=4"
+    ).read().decode()
+    lines = body.strip().splitlines()
+    head = json.loads(lines[0])
+    assert head["kind"] == "summary" and head["enabled"] is True
+    assert head["verdicts"]["check.verdicts.denied"] > 0
+    assert head["stats"]["ring"] == len(log)
+    assert len(lines) == 5
+    for ln in lines[1:]:
+        e = json.loads(ln)
+        assert {"resource", "permission", "subject", "verdict"} <= set(e)
+    # incident bundles carry the last-N decisions
+    rec = _trace.recorder()
+    iid = rec.trigger("test.decision_carriage")
+    rec.flush()
+    bundle_head = json.loads(rec.bundle(iid).splitlines()[0])
+    assert bundle_head["decisions"]
+    assert bundle_head["decisions"][-1]["verdict"] in ("allowed", "denied")
+
+
+def test_serving_provenance_dedup_parked_and_cache_hit():
+    c = new_tpu_evaluator(with_latency_mode(), with_decision_log())
+    ctx = background()
+    c.write_schema(ctx, """
+definition user {}
+definition doc { relation reader: user  permission read = reader }
+""")
+    txn = rel.Txn()
+    txn.touch(rel.must_from_triple("doc:a", "reader", "user:u"))
+    c.write(ctx, txn)
+    log = _decisions.get()
+    with c.with_serving(cs=consistency.min_latency(), cache=True) as h:
+        q = rel.must_from_triple("doc:a", "read", "user:u")
+        assert h.check(ctx, q) == [True]
+        assert h.check(ctx, q) == [True]  # cache-served
+    entries = log.tail()
+    assert any(e.get("cache_hit") for e in entries)
+    # the dedup_parked flag rides the future → handle records it
+    from gochugaru_tpu.serve.batcher import SubmitFuture
+
+    fut = SubmitFuture(0.0)
+    assert fut.dedup_parked is False
+    fut.dedup_parked = True
+    _decisions.record_rels([q], [True], strategy=consistency.min_latency(),
+                           dedup_parked=True, latency_s=0.001)
+    assert log.tail()[-1]["dedup_parked"] is True
+
+
+def test_decisions_endpoint_disabled_and_columnar_decode():
+    c = new_tpu_evaluator(with_latency_mode(), with_telemetry(port=0))
+    body = urllib.request.urlopen(
+        c.telemetry.url + "/decisions"
+    ).read().decode()
+    head = json.loads(body.strip().splitlines()[0])
+    assert head["enabled"] is False
+    # columnar recording decodes only kept rows
+    m = Metrics()
+    log = DecisionLog(registry=m, sample_rate=1.0)
+    _decisions.install(log)
+    decoded = []
+
+    def decode(i):
+        decoded.append(i)
+        return f"doc:d{i}", "read", f"user:u{i}"
+
+    _decisions.record_cols(4, [True, False, True, True], decode,
+                           revision=2, strategy="min_latency",
+                           latency_s=0.01)
+    assert len(log.tail()) == 4 and sorted(decoded) == [0, 1, 2, 3]
+    e = log.tail()[1]
+    assert e["verdict"] == "denied" and e["resource"] == "doc:d1"

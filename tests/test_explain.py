@@ -1,0 +1,398 @@
+"""Decision provenance (engine/explain.py): explain-tree parity against
+the host oracle on randomized worlds (caveats / wildcards / expirations
+/ closure overflow / nested-team T-join / arrow chains), device witness
+⊆ oracle path, denial trees carrying the exhausted frontier, cache-hit
+re-derivation at the pinned revision, chaos on the ``explain.walk``
+fault site, and the zero-cost disarmed contract for witness extraction
+on the pinned latency path."""
+
+import datetime as dt
+import time
+
+import numpy as np
+import pytest
+
+from gochugaru_tpu import consistency, rel
+from gochugaru_tpu.client import (
+    new_tpu_evaluator,
+    with_engine_config,
+    with_host_only_evaluation,
+    with_latency_mode,
+    with_store,
+    with_verdict_cache,
+)
+from gochugaru_tpu.engine import explain as ex
+from gochugaru_tpu.engine.plan import EngineConfig
+from gochugaru_tpu.utils import faults
+from gochugaru_tpu.utils import metrics as _metrics
+from gochugaru_tpu.utils.context import background
+
+SCHEMA = """
+caveat tier_at_least(tier int, minimum int) { tier >= minimum }
+definition user {}
+definition team { relation member: user | team#member }
+definition folder {
+    relation parent: folder
+    relation viewer: user | team#member
+    permission view = viewer + parent->view
+}
+definition doc {
+    relation folder: folder
+    relation reader: user | user:* | team#member | user with tier_at_least
+    relation banned: user
+    permission read = (reader - banned) + folder->view
+}
+"""
+
+
+def _build_world(seed, *, n_users=24, n_teams=5, n_folders=6, n_docs=18,
+                 engine_config=None, wildcard_docs=2):
+    """One randomized world through the real client: nested teams
+    (closure/T-join), a folder parent chain (arrow recursion), direct /
+    wildcard / userset / caveated / expiring reader edges, bans."""
+    opts = [with_latency_mode()]
+    if engine_config is not None:
+        opts.append(with_engine_config(engine_config))
+    c = new_tpu_evaluator(*opts)
+    ctx = background()
+    c.write_schema(ctx, SCHEMA)
+    rng = np.random.default_rng(seed)
+    txn = rel.Txn()
+    # nested teams: t0 ⊇ t1 ⊇ … (T-join + closure material)
+    for t in range(n_teams):
+        for u in rng.choice(n_users, 3, replace=False):
+            txn.touch(rel.must_from_tuple(f"team:t{t}#member", f"user:u{u}"))
+        if t + 1 < n_teams:
+            txn.touch(rel.must_from_tuple(
+                f"team:t{t}#member", f"team:t{t + 1}#member"
+            ))
+    # folder chain: f0 ← f1 ← … (arrow recursion)
+    for f in range(n_folders):
+        if f + 1 < n_folders:
+            txn.touch(rel.must_from_triple(
+                f"folder:f{f + 1}", "parent", f"folder:f{f}"
+            ))
+        if rng.random() < 0.7:
+            txn.touch(rel.must_from_triple(
+                f"folder:f{f}", "viewer", f"user:u{rng.integers(n_users)}"
+            ))
+        if rng.random() < 0.3:
+            txn.touch(rel.must_from_tuple(
+                f"folder:f{f}#viewer", f"team:t{rng.integers(n_teams)}#member"
+            ))
+    now_s = time.time()
+    for d in range(n_docs):
+        txn.touch(rel.must_from_triple(
+            f"doc:d{d}", "folder", f"folder:f{d % n_folders}"
+        ))
+        for u in rng.choice(n_users, 2, replace=False):
+            r = rel.must_from_triple(f"doc:d{d}", "reader", f"user:u{u}")
+            roll = rng.random()
+            if roll < 0.2:  # stored-context caveat
+                r = r.with_caveat("tier_at_least", {"minimum": 5})
+            elif roll < 0.35:  # expiring edge: half already expired
+                r = r.with_expiration(dt.datetime.fromtimestamp(
+                    now_s + (3600 if rng.random() < 0.5 else -3600),
+                    tz=dt.timezone.utc,
+                ))
+            txn.touch(r)
+        if rng.random() < 0.5:
+            txn.touch(rel.must_from_tuple(
+                f"doc:d{d}#reader", f"team:t{rng.integers(n_teams)}#member"
+            ))
+        if d < wildcard_docs:
+            txn.touch(rel.must_from_triple(f"doc:d{d}", "reader", "user:*"))
+        if rng.random() < 0.25:
+            txn.touch(rel.must_from_triple(
+                f"doc:d{d}", "banned", f"user:u{rng.integers(n_users)}"
+            ))
+    c.write(ctx, txn)
+    oracle_client = new_tpu_evaluator(
+        with_host_only_evaluation(), with_store(c.store)
+    )
+    return c, oracle_client, rng
+
+
+def _random_checks(rng, n, *, n_users=24, n_docs=18):
+    out = []
+    for _ in range(n):
+        r = rel.must_from_triple(
+            f"doc:d{rng.integers(n_docs)}",
+            rng.choice(["read", "reader"]),
+            f"user:u{rng.integers(n_users)}",
+        )
+        roll = rng.random()
+        if roll < 0.2:  # query caveat context (live-context path)
+            r = r.with_caveat("", {"tier": int(rng.integers(0, 10))})
+        out.append(r)
+    return out
+
+
+@pytest.mark.parametrize("seed", [11, 23, 47])
+def test_explain_parity_and_witness_fuzz(seed):
+    """Witness-seeded device explain == instrumented oracle walk, for
+    allowed AND denied verdicts, on randomized worlds with caveats,
+    wildcards, expirations and fold/T-join paths."""
+    c, oc, rng = _build_world(seed)
+    ctx = background()
+    cs = consistency.full()
+    checks = _random_checks(rng, 30)
+    want = oc.check(ctx, cs, *checks)
+    got = c.check(ctx, cs, *checks)
+    assert got == want  # device parity (pre-existing contract)
+    snap = c.store.snapshot_for(cs)
+    engine = c._engine_for(snap)
+    dsnap = c._dsnap_for(engine, snap)
+    codes = engine.witness_codes(dsnap, checks)
+    assert codes is not None
+    for i, r in enumerate(checks):
+        tree = c.explain(ctx, cs, r)
+        # bool collapse parity: allowed ⇔ True; conditional/denied ⇔ False
+        assert (tree["result"] == "allowed") == want[i], (r, tree)
+        assert tree["revision"] == snap.revision
+        # witness ⊆ oracle path (code 0 ⇒ unseeded, trivially consistent
+        # only for non-allowed — an allowed device-definite verdict must
+        # carry a branch)
+        w = int(codes[i])
+        if w or tree["result"] != "allowed":
+            assert ex.witness_consistent(tree, w), (r, w, tree)
+
+
+def test_witness_branch_classes_deterministic():
+    """Every witness branch class appears and maps onto the matching
+    oracle-tree structure on a hand-built world."""
+    c = new_tpu_evaluator(with_latency_mode())
+    ctx = background()
+    c.write_schema(ctx, """
+definition user {}
+definition team { relation member: user }
+definition org { relation admin: user }
+definition doc {
+    relation org: org
+    relation reader: user | user:* | team#member
+    permission admin = org->admin
+    permission read = reader
+}
+""")
+    txn = rel.Txn()
+    txn.touch(rel.must_from_triple("doc:a", "reader", "user:alice"))
+    txn.touch(rel.must_from_triple("doc:w", "reader", "user:*"))
+    txn.touch(rel.must_from_triple("team:t", "member", "user:bob"))
+    txn.touch(rel.must_from_tuple("doc:t#reader", "team:t#member"))
+    txn.touch(rel.must_from_triple("doc:a", "org", "org:o"))
+    txn.touch(rel.must_from_triple("org:o", "admin", "user:root"))
+    c.write(ctx, txn)
+    cs = consistency.full()
+    snap = c.store.snapshot_for(cs)
+    engine = c._engine_for(snap)
+    dsnap = c._dsnap_for(engine, snap)
+    cases = [
+        (rel.must_from_triple("doc:a", "reader", "user:alice"), "direct"),
+        (rel.must_from_triple("doc:w", "reader", "user:zed"), "wildcard"),
+        (rel.must_from_tuple("team:t#member", "team:t#member"), "self"),
+    ]
+    rels = [r for r, _ in cases]
+    codes = engine.witness_codes(dsnap, rels)
+    for (r, branch), w in zip(cases, codes):
+        assert ex.witness_name(int(w)) == branch, (r, int(w))
+        tree = c.explain(background(), cs, r)
+        assert ex.witness_consistent(tree, int(w))
+    # userset/T and fold/rewrite classes on the remaining shapes
+    t_code = int(engine.witness_codes(
+        dsnap, [rel.must_from_triple("doc:t", "reader", "user:bob")]
+    )[0])
+    assert ex.witness_name(t_code) in ("t_probe", "userset")
+    f_code = int(engine.witness_codes(
+        dsnap, [rel.must_from_triple("doc:a", "admin", "user:root")]
+    )[0])
+    assert ex.witness_name(f_code) in ("fold", "rewrite")
+    # seeded walk: the witness steers the root relation's exploration
+    # order, so the tree's first explored grant is the witness class
+    tree = c.explain(
+        background(), cs,
+        rel.must_from_triple("doc:t", "reader", "user:bob"),
+    )
+    assert tree["witness"] in ("t_probe", "userset")
+    assert ex.witness_consistent(tree, t_code)
+
+
+def test_denial_tree_carries_exhausted_frontier():
+    """A denial's tree lists every explored-and-failed edge: the gated
+    wildcard/caveat/expiry details and sub-verdicts, plus the count of
+    non-matching direct edges."""
+    c = new_tpu_evaluator()
+    ctx = background()
+    c.write_schema(ctx, SCHEMA)
+    now_s = time.time()
+    txn = rel.Txn()
+    txn.touch(rel.must_from_triple("doc:x", "reader", "user:other"))
+    txn.touch(rel.must_from_triple("doc:x", "reader", "user:expired")
+              .with_expiration(dt.datetime.fromtimestamp(
+                  now_s - 60, tz=dt.timezone.utc)))
+    txn.touch(rel.must_from_triple("doc:x", "reader", "user:victim")
+              .with_caveat("tier_at_least", {"minimum": 9}))
+    txn.touch(rel.must_from_tuple("doc:x#reader", "team:empty#member"))
+    c.write(ctx, txn)
+    tree = c.explain(
+        ctx, consistency.full(),
+        rel.must_from_triple("doc:x", "read", "user:victim")
+        .with_caveat("", {"tier": 1}),
+    )
+    assert tree["result"] == "denied"
+
+    def flatten(node, out):
+        out.append(node)
+        for ch in node.get("children", ()):
+            flatten(ch, out)
+        return out
+
+    nodes = flatten(tree["tree"], [])
+    rel_nodes = [
+        n for n in nodes
+        if n["kind"] == "relation" and n.get("item") == "reader"
+    ]
+    assert rel_nodes and rel_nodes[0]["verdict"] == "denied"
+    # the caveat-gated direct edge is IN the frontier with its context
+    gated = [
+        n for n in nodes
+        if n["kind"] == "direct" and n.get("gate", {}).get("caveat")
+    ]
+    assert gated, nodes
+    g = gated[0]["gate"]
+    assert g["caveat"] == "tier_at_least"
+    assert g["caveat_result"] is False
+    assert g["context"]["minimum"] == 9 and g["context"]["tier"] == 1
+    # a skipped non-matching direct edge is counted, the empty userset
+    # expansion appears denied
+    assert rel_nodes[0].get("edges_skipped", 0) >= 1
+    assert any(n["kind"] == "userset" and n["verdict"] == "denied"
+               for n in nodes)
+
+
+def test_closure_overflow_world_explains_exactly():
+    """Worlds past the device's static caps (closure overflow → host
+    fallback) still explain oracle-exactly; overflowed rows carry no
+    device witness."""
+    cfg = EngineConfig(closure_size=8, seed_cap=4, us_leaf_cap=2)
+    c, oc, rng = _build_world(101, engine_config=cfg, n_teams=8)
+    ctx = background()
+    cs = consistency.full()
+    checks = _random_checks(rng, 20)
+    want = oc.check(ctx, cs, *checks)
+    assert c.check(ctx, cs, *checks) == want
+    for i, r in enumerate(checks):
+        tree = c.explain(ctx, cs, r)
+        assert (tree["result"] == "allowed") == want[i], (r, tree)
+
+
+def test_cache_hit_rederivation_at_pinned_revision():
+    """A vcache-served verdict explains with ``cached: true`` and the
+    pinned revision — and the tree is RE-DERIVED (it matches the oracle,
+    not a stored blob), including after the head moves."""
+    c = new_tpu_evaluator(with_latency_mode(), with_verdict_cache())
+    ctx = background()
+    c.write_schema(ctx, SCHEMA)
+    txn = rel.Txn()
+    txn.touch(rel.must_from_triple("doc:c", "reader", "user:hit"))
+    c.write(ctx, txn)
+    cs = consistency.min_latency()
+    q = rel.must_from_triple("doc:c", "read", "user:hit")
+    assert c.check(ctx, cs, q) == [True]
+    assert c.check(ctx, cs, q) == [True]  # now cache-served
+    assert _metrics.default.counter("cache.hits") >= 1
+    snap = c.store.snapshot_for(cs)
+    tree = c.explain(ctx, cs, q)
+    assert tree["cached"] is True
+    assert tree["revision"] == snap.revision
+    assert tree["result"] == "allowed"
+    assert tree["strategy"] == "min_latency"
+    # full() bypasses the cache — provenance must not claim cached
+    tree_full = c.explain(ctx, consistency.full(), q)
+    assert "cached" not in tree_full
+
+
+def test_explain_walk_chaos_no_torn_trees():
+    """The ``explain.walk`` fault site classifies into the client retry
+    envelope; every returned tree is complete and verdict-exact."""
+    c, oc, rng = _build_world(77)
+    ctx = background()
+    cs = consistency.full()
+    checks = _random_checks(rng, 12)
+    want = oc.check(ctx, cs, *checks)
+    m = _metrics.default
+    r0 = m.counter("retry.retries")
+    with faults.default.armed("explain.walk", probability=0.5,
+                              seed=9) as spec:
+        for i, q in enumerate(checks):
+            tree = c.explain(ctx, cs, q)
+            assert (tree["result"] == "allowed") == want[i]
+            assert tree["tree"] is not None
+            assert "verdict" in tree["tree"]  # fully popped root = no tear
+    assert spec.fired > 0
+    assert m.counter("retry.retries") > r0
+
+
+def test_disarmed_witness_zero_cost_on_pinned_path():
+    """The zero-overhead contract: with witness extraction DISARMED the
+    kernel has exactly three outputs (no witness plane ships), the
+    pinned latency path allocates no witness state, and re-dispatching
+    after an arm/disarm cycle reuses the original pins (no retrace)."""
+    import jax
+
+    from test_latency_path import build_rbac_world
+
+    from gochugaru_tpu.engine.device import DeviceEngine
+
+    cs, snap, users, repos, slot = build_rbac_world()
+    engine = DeviceEngine(cs)
+    dsnap = engine.prepare(snap)
+    rng = np.random.default_rng(3)
+    B = 256
+    q_res = rng.choice(repos, B).astype(np.int32)
+    q_perm = np.full(B, slot["read"], np.int32)
+    q_subj = rng.choice(users, B).astype(np.int32)
+
+    # (1) no device output: the disarmed kernel's abstract output is a
+    # 3-tuple, the armed variant a 4-tuple — asserted on the SAME args
+    got = engine.flat_fn_and_args(
+        dsnap,
+        {"q_perm": q_perm, "q_res": q_res, "q_subj": q_subj,
+         "q_srel": np.full(B, -1, np.int32),
+         "q_wc": np.full(B, -1, np.int32),
+         "q_ctx": np.full(B, -1, np.int32),
+         "q_self": np.zeros(B, bool)},
+        engine._encode_query_contexts([], dsnap.strings),
+        np.int32(0), B,
+    )
+    assert got is not None
+    fn, args = got
+    assert len(jax.eval_shape(fn, *args)) == 3
+    wfn = engine._flat_fn_for(
+        tuple(sorted({int(s) for s in np.unique(q_perm)})),
+        dsnap.flat_meta, witness=True,
+    )
+    assert len(jax.eval_shape(wfn, *args)) == 4
+
+    # (2) no host allocations / no witness state on the pinned path
+    lp = engine.latency_path(dsnap)
+    for i in range(4):
+        out = lp.dispatch_columns(np.roll(q_res, i), q_perm, q_subj)
+        assert out is not None and len(out) == 3
+    assert lp.last_witness is None
+    assert lp.witness_armed is False
+    assert all(len(k) == 3 for k in lp._local)  # no armed pin built
+
+    # (3) arming pins a SEPARATE executable; disarming returns to the
+    # original pins without recompiling
+    disarmed_pins = set(lp._local)
+    lp.arm_witness()
+    out = lp.dispatch_columns(q_res, q_perm, q_subj)
+    assert len(out) == 3  # caller contract unchanged
+    assert lp.last_witness is not None and lp.last_witness.shape == (B,)
+    assert set(lp._local) - disarmed_pins  # armed pin is NEW
+    lp.arm_witness(False)
+    assert lp.last_witness is None
+    cc = lp.compile_count
+    lp.dispatch_columns(q_res, q_perm, q_subj)
+    assert lp.compile_count == cc, "disarm retraced the pinned path"
+    assert lp.last_witness is None

@@ -540,3 +540,18 @@ def test_bench_compare_extracts_promoted_perf_fields(tmp_path):
     assert got["m.achieved_gbps"]["value"] == 1.5
     assert got["m.roofline_frac"]["value"] == 0.2
     assert got["m.pad_fraction"]["value"] == 0.1
+
+
+def test_bench_compare_provenance_column_directions():
+    """The decision-provenance columns are direction-aware from round
+    one: explain_overhead_frac growing means the zero-cost contract is
+    eroding, decisions_dropped growing is an audit-trail hole — both
+    lower-better and promoted off headline rows (the PR-12/13 pattern)."""
+    bc = _bench_compare()
+    assert bc.lower_is_better("explain_smoke.explain_overhead_frac", "ok")
+    assert bc.lower_is_better("explain_smoke.decisions_dropped", "ok")
+    # the trailing "_frac" must not read as higher-better via the
+    # roofline_frac rule
+    assert not bc.lower_is_better("serve_openloop_goodput.roofline_frac", "")
+    for fld in ("explain_overhead_frac", "decisions_dropped"):
+        assert fld in bc._PROMOTED_FIELDS

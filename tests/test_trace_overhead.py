@@ -82,3 +82,47 @@ def test_tracing_enabled_overhead_under_5pct():
         f"p90 shift {r['delta_p90_ms']:.3f} ms > "
         f"0.05 x p99_off {r['p99_ms_off']:.3f} ms ({r})"
     )
+
+
+def test_witness_armed_overhead_under_budget():
+    """Decision-provenance extension of the same harness: witness
+    extraction (engine/flat.py armed kernel, the explain seed's source)
+    flipped per REP via the generic ``interleave`` hook.  The armed
+    kernel reuses masks the probe pipeline computes anyway plus a select
+    cascade and one extra [B] output — its median shift must fit the
+    same 5% budget the tracer does.  The disarmed-mode reps double as
+    the no-retrace witness: both modes are pre-warmed, so any compile
+    inside the window is a pin leak."""
+    from gochugaru_tpu.engine.device import DeviceEngine
+
+    cs, snap, users, repos, slot = build_rbac_world()
+    engine = DeviceEngine(cs)
+    dsnap = engine.prepare(snap)
+    rng = np.random.default_rng(12)
+    q_res = rng.choice(repos, B).astype(np.int32)
+    q_perm = rng.choice(np.array([slot["read"], slot["admin"]], np.int32), B)
+    q_subj = rng.choice(users, B).astype(np.int32)
+
+    lp = engine.latency_path(dsnap)
+    # pre-warm BOTH modes so the interleaved window never compiles
+    for armed in (True, False):
+        lp.arm_witness(armed)
+        for i in range(10):
+            lp.dispatch_columns(np.roll(q_res, i), q_perm, q_subj)
+    lp.arm_witness(False)
+    compiles_before = lp.compile_count
+    r = small_batch_latency(
+        engine, dsnap, q_res, q_perm, q_subj,
+        warmup=30, reps=REPS,
+        interleave=(lp.arm_witness, lambda: lp.arm_witness(False)),
+    )
+    assert lp.compile_count == compiles_before, (
+        "witness arm/disarm retraced inside the warm window"
+    )
+    assert lp.witness_armed is False  # interleave leaves the toggle off
+    allowance = BUDGET * r["p99_ms_off"]
+    assert r["delta_p50_ms"] <= allowance, (
+        f"armed witness extraction breaks the 5% budget: "
+        f"median shift {r['delta_p50_ms']:.3f} ms > "
+        f"0.05 x p99_off {r['p99_ms_off']:.3f} ms ({r})"
+    )

@@ -86,6 +86,54 @@ while true; do
       timeout 700 python benchmarks/bench9_serve.py --quick \
         > "tpu_attempts/cache_${TS}.out" 2> "tpu_attempts/cache_${TS}.err"
       log "verdict-cache A/B rc=$? → tpu_attempts/cache_${TS}.out"
+      # priority 3.8 (low): witness-extraction on/off A/B — price the
+      # decision-provenance witness plane (engine/flat.py armed kernel)
+      # on real silicon with the interleaved-rep discipline, so the
+      # first window also answers "does the witness select cascade hide
+      # under the probe pipeline on TPU the way it does on CPU"
+      timeout 300 python - > "tpu_attempts/witness_${TS}.out" \
+          2> "tpu_attempts/witness_${TS}.err" <<'WEOF'
+import json
+import sys
+
+import numpy as np
+
+sys.path.insert(0, "tests")
+from test_latency_path import build_rbac_world
+
+from benchmarks.common import small_batch_latency
+from gochugaru_tpu.engine.device import DeviceEngine
+
+cs, snap, users, repos, slot = build_rbac_world()
+engine = DeviceEngine(cs)
+dsnap = engine.prepare(snap)
+rng = np.random.default_rng(5)
+B = 1024
+q_res = rng.choice(repos, B).astype(np.int32)
+q_perm = rng.choice(np.array([slot["read"], slot["admin"]], np.int32), B)
+q_subj = rng.choice(users, B).astype(np.int32)
+lp = engine.latency_path(dsnap)
+for armed in (True, False):  # pre-warm both pin sets
+    lp.arm_witness(armed)
+    for i in range(10):
+        lp.dispatch_columns(np.roll(q_res, i), q_perm, q_subj)
+lp.arm_witness(False)
+r = small_batch_latency(
+    engine, dsnap, q_res, q_perm, q_subj, warmup=30, reps=600,
+    interleave=(lp.arm_witness, lambda: lp.arm_witness(False)),
+)
+import jax
+
+print(json.dumps({
+    "metric": "witness_ab_small_batch", "value": r["p50_ms_on"],
+    "unit": "ms", "platform": jax.default_backend(), "batch": B,
+    "p50_ms_off": r["p50_ms_off"], "p50_ms_on": r["p50_ms_on"],
+    "p99_ms_off": r["p99_ms_off"], "p99_ms_on": r["p99_ms_on"],
+    "delta_p50_ms": r["delta_p50_ms"],
+    "note": "witness-armed vs disarmed pinned dispatch, interleaved reps",
+}))
+WEOF
+      log "witness on/off A/B rc=$? → tpu_attempts/witness_${TS}.out"
       # priority 4: the wider ladder while the window lasts
       timeout 420 python benchmarks/bench1_founders.py \
         > "tpu_attempts/b1_${TS}.out" 2> "tpu_attempts/b1_${TS}.err"
