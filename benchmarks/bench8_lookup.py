@@ -28,6 +28,16 @@ the host walker's (engine/lookup.py — the superseded O(E log E)
 transposed-index path, kept as the parity oracle) for measured
 subjects; the walker's index build time rides along as
 ``walker_index_build_s`` for contrast.
+
+Every lookup row also carries ``device_dispatches`` — the number of
+device program launches the measured phase actually made (read from the
+``lookup.dispatches`` + ``spmm.dispatches`` counters, engine/spmv.py and
+engine/spmm.py), so dispatch-floor claims are data, not prose.  The
+``lookup_fused_vs_looped`` A/B row runs the SAME mixed-user sample
+through the fused K-hop SpMM path (``EngineConfig.spmm`` on, one pinned
+dispatch per lookup) and the looped per-hop path (off) on the SAME
+prepared snapshot, promoting ``mixed_users_rate`` (higher-better) and
+``dispatches_per_lookup`` (lower-better) for the trajectory guard.
 """
 
 import time
@@ -72,6 +82,13 @@ def main() -> None:
     oracle = SnapshotOracle(snap, {})
     interner = snap.interner
 
+    from gochugaru_tpu.utils.metrics import default as _mt
+
+    def _disp() -> float:
+        return _mt.counter("lookup.dispatches") + _mt.counter(
+            "spmm.dispatches"
+        )
+
     rng = np.random.default_rng(11)
     sample = [int(u) for u in rng.choice(users, 48, replace=False)]
     st = spmv.state_for(engine, dsnap)
@@ -80,9 +97,9 @@ def main() -> None:
     viewer = cs.slot_of_name["viewer"]
     gtid = interner.type_lookup("group")
 
-    def drain_candidates(u: int, srel: int = -1) -> int:
+    def drain_candidates(u: int, srel: int = -1, state=st) -> int:
         n = 0
-        for blk in st.resource_candidates(rtid, u, srel, -1, EPOCH):
+        for blk in state.resource_candidates(rtid, u, srel, -1, EPOCH):
             n += blk.shape[0]
         return n
 
@@ -105,17 +122,20 @@ def main() -> None:
     mixed_of = {u: drain_candidates(u) for u in sample}  # warm (compiles)
     bulk_of = {g: drain_candidates(g, member) for g in bulk}
 
-    def timed(subjects, srel):
+    def timed(subjects, srel, state=st):
+        """(median wall s, device dispatches per drain) over 3 reps."""
         reps = []
+        d0 = _disp()
         for _ in range(3):
             t0 = time.perf_counter()
             for s in subjects:
-                drain_candidates(s, srel)
+                drain_candidates(s, srel, state)
             reps.append(time.perf_counter() - t0)
-        return float(np.median(reps))
+        per_drain = (_disp() - d0) / (3 * max(len(subjects), 1))
+        return float(np.median(reps)), per_drain
 
-    mixed_dt = timed(sample, -1)
-    bulk_dt = timed(bulk, member)
+    mixed_dt, mixed_dpl = timed(sample, -1)
+    bulk_dt, bulk_dpl = timed(bulk, member)
     mixed_rate = sum(mixed_of.values()) / mixed_dt
     total_cands = sum(bulk_of.values())
     cand_rate = total_cands / bulk_dt
@@ -124,8 +144,29 @@ def main() -> None:
     note(
         f"bulk expansion: {len(bulk)} userset subjects, {total_cands} "
         f"candidates in {bulk_dt*1000:.0f}ms → {cand_rate/1e6:.2f}M cand/s"
-        f" (heaviest: {bulk_of[heavy]}); mixed 48 random users: "
-        f"{sum(mixed_of.values())} candidates → {mixed_rate/1e6:.2f}M/s"
+        f" (heaviest: {bulk_of[heavy]}, {bulk_dpl:.1f} dispatches/drain); "
+        f"mixed 48 random users: {sum(mixed_of.values())} candidates → "
+        f"{mixed_rate/1e6:.2f}M/s at {mixed_dpl:.1f} dispatches/lookup"
+    )
+
+    # ---- fused vs looped A/B: same snapshot, same sample ---------------
+    # the looped state serves through a spmm=False engine over the SAME
+    # prepared tables — the pre-PR per-hop path, byte-for-byte
+    import dataclasses as _dc
+
+    from gochugaru_tpu.engine.device import DeviceEngine as _DE
+
+    eng_off = _DE(cs, _dc.replace(engine.config, spmm=False))
+    st_off = spmv.FrontierState(eng_off, dsnap)
+    looped_of = {u: drain_candidates(u, -1, st_off) for u in sample}  # warm
+    assert looped_of == mixed_of, "fused/looped candidate counts differ"
+    looped_dt, looped_dpl = timed(sample, -1, st_off)
+    looped_rate = sum(looped_of.values()) / looped_dt
+    note(
+        f"fused-vs-looped A/B (48 mixed users): fused "
+        f"{mixed_rate/1e6:.2f}M cand/s @ {mixed_dpl:.1f} disp/lookup, "
+        f"looped {looped_rate/1e6:.2f}M @ {looped_dpl:.1f} — "
+        f"{mixed_rate/max(looped_rate,1e-9):.1f}x"
     )
 
     # ---- first-result latency (cursored page 1) ------------------------
@@ -141,11 +182,14 @@ def main() -> None:
         )
         return (time.perf_counter() - t0) * 1000
 
+    fp_d0 = _disp()
     fr = [first_page_ms(u, "user", "") for u in sample[:16]]
     fr_p50 = float(np.percentile(fr, 50))
     heavy_first = first_page_ms(heavy, "group", "member")
+    fp_disp = _disp() - fp_d0
 
     # ---- full bulk answer (exact filter included) ----------------------
+    fa_d0 = _disp()
     t0 = time.perf_counter()
     full = lm.lookup_resources_device(
         engine, dsnap, "document", "view", "group", heavy_id, "member",
@@ -153,6 +197,7 @@ def main() -> None:
     )
     full_dt = time.perf_counter() - t0
     full_rate = len(full) / max(full_dt, 1e-9)
+    fa_disp = _disp() - fa_d0
 
     # ---- oracle parity vs the host walker ------------------------------
     t0 = time.perf_counter()
@@ -193,19 +238,36 @@ def main() -> None:
         total_candidates=int(total_cands),
         heavy_candidates=int(bulk_of[heavy]),
         mixed_rate=round(mixed_rate, 1),
+        mixed_users_rate=round(mixed_rate, 1),
         mixed_candidates=int(sum(mixed_of.values())),
-        hops=int(__import__(
-            "gochugaru_tpu.utils.metrics", fromlist=["default"]
-        ).default.counter("lookup.hops")),
+        device_dispatches=round(bulk_dpl * len(bulk), 1),
+        dispatches_per_lookup=round(mixed_dpl, 2),
+        hops=int(_mt.counter("lookup.hops")),
         note=f"bar {CANDIDATE_RATE_BAR/1e6:.0f}M cand/s; bulk userset "
              "subjects, TRUE-rate (sequential drains, median of 3); "
-             "mixed_rate = 48 random users",
+             "mixed_users_rate = 48 random users; device_dispatches = "
+             "per bulk rep",
+    )
+    emit(
+        "lookup_fused_vs_looped", mixed_rate / max(looped_rate, 1e-9), "x",
+        mixed_rate / max(looped_rate, 1e-9),
+        edges=int(snap.num_edges), batch=len(sample),
+        oracle_match=bool(match),
+        mixed_users_rate=round(mixed_rate, 1),
+        looped_mixed_users_rate=round(looped_rate, 1),
+        dispatches_per_lookup=round(mixed_dpl, 2),
+        looped_dispatches_per_lookup=round(looped_dpl, 2),
+        device_dispatches=round(mixed_dpl * len(sample), 1),
+        note="same snapshot, same 48 mixed users: fused K-hop SpMM "
+             "(EngineConfig.spmm on) vs looped per-hop SpMV (off); "
+             "value = fused/looped candidate-rate ratio",
     )
     emit(
         "lookup_first_result_latency", fr_p50, "ms", 2.0 / max(fr_p50, 1e-9),
         edges=int(snap.num_edges), batch=1_000,
         bulk_first_ms=round(heavy_first, 1),
         bulk_full_ms=round(full_dt * 1000, 1),
+        device_dispatches=int(fp_disp),
         note="time to first 1k-result page (cursored stream); bulk_* = "
              "the heavy userset subject",
     )
@@ -215,6 +277,7 @@ def main() -> None:
         edges=int(snap.num_edges), batch=len(full),
         full_answer_ms=round(full_dt * 1000, 1),
         walker_index_build_s=round(walker_s, 1),
+        device_dispatches=int(fa_disp),
         note="heaviest bulk subject, exact forward filter included",
     )
 

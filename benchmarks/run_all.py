@@ -222,6 +222,13 @@ def main() -> int:
         ["bash", "scripts/explain_smoke.sh"],
         600,
     ))
+    configs.append((
+        "19 — unified-SpMM smoke (fused-vs-legacy parity through"
+        " check/lookup/fold, one-dispatch multi-hop fixpoint, routed"
+        " shards)",
+        ["bash", "scripts/spmm_smoke.sh"],
+        600,
+    ))
     if not q:
         # Leopard-scale CPU proxy (VERDICT r04 item 3): the same Watch
         # re-index loop at a 100M-edge base — BASELINE config 5's

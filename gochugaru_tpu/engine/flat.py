@@ -767,8 +767,6 @@ def _tindex_join(
     elig = ~np.isin(snap.us_rel, bad_slots)
     if not elig.any():
         return None
-    from .fold import t_join_core
-
     pe = pe_all[elig]
     ek1 = us_gk[elig]
     w = np.where(
@@ -776,9 +774,21 @@ def _tindex_join(
         snap.us_exp[elig].astype(np.int64),
     ).astype(np.int32)
     cap_rows = config.flat_tindex_factor * max(int(snap.us_rel.shape[0]), 1024)
-    got = t_join_core(
-        ek1, pe, w, cl_k1, cl_k2, cl.c_d_until, cl.c_p_until, cap_rows
-    )
+    if config.spmm:
+        # the unified sparse core's host instance (engine/spmm.py):
+        # same (min, max) until-semiring product, bitwise-identical
+        # output — t_join_core below stays as the parity oracle
+        from .spmm import tjoin_spmm
+
+        got = tjoin_spmm(
+            ek1, pe, w, cl_k1, cl_k2, cl.c_d_until, cl.c_p_until, cap_rows
+        )
+    else:
+        from .fold import t_join_core
+
+        got = t_join_core(
+            ek1, pe, w, cl_k1, cl_k2, cl.c_d_until, cl.c_p_until, cap_rows
+        )
     if got is None:
         return None
     return (

@@ -236,6 +236,29 @@ class EngineConfig:
     #: regardless (the shared staging buffer must not be refilled while
     #: an async transfer still reads it)
     latency_staged_timing: Optional[bool] = None
+    # -- unified masked-SpMM sparse core (engine/spmm.py) ----------------
+    #: serve multi-hop lookups through the fused K-hop SpMM program (the
+    #: whole reverse/forward frontier fixpoint in ONE pinned dispatch,
+    #: frontier carried on-device between hops) and route the fold
+    #: T-join through the same semiring primitive.  False is the parity
+    #: oracle: the per-hop looped spmv path and the bespoke t_join_core,
+    #: byte-for-byte (the flat_packed=False-style lever)
+    spmm: bool = True
+    #: max fused hop rounds per dispatch; a frontier still live after
+    #: this many rounds overflows to the looped path
+    spmm_rounds: int = 10
+    #: on-device frontier capacity per round (keys AND nodes, pow2);
+    #: wider frontiers overflow to the looped path — bulk subjects with
+    #: ~1M-candidate answers are the looped path's workload anyway
+    spmm_frontier: int = 1_024
+    #: per-round emission budget of each fused probe (pow2).  The emit
+    #: lanes run at full static width every round, so this is the fused
+    #: program's dominant cost — size for the common lookup, not the
+    #: worst case: overflow falls back to the looped path correctly
+    spmm_emit: int = 2_048
+    #: candidate-buffer capacity of one fused dispatch; answers larger
+    #: than this overflow to the looped (streaming) path
+    spmm_candidates: int = 8_192
 
     @staticmethod
     def for_schema(compiled: CompiledSchema, **overrides) -> "EngineConfig":

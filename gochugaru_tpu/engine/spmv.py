@@ -453,6 +453,7 @@ class FrontierKernels:
     def runs(self, kind: str, args: Tuple, keys: np.ndarray):
         """(lo, ln, total) device handles + host total for padded keys."""
         faults.fire("lookup.dispatch")
+        _mt.inc("lookup.dispatches")
         kp = self.pad_keys(keys)
         import jax.numpy as jnp
 
@@ -493,6 +494,7 @@ class FrontierKernels:
         import jax
         import jax.numpy as jnp
 
+        _mt.inc("lookup.dispatches")
         rows, live = self._emits[kind](
             tbl, lo, ln, jnp.int32(chunk0), now, ch or self.CH
         )
@@ -516,6 +518,7 @@ class FrontierKernels:
         _mt.inc("lookup.hops")
         if fused is not None:
             faults.fire("lookup.dispatch")
+            _mt.inc("lookup.dispatches")
             kp = self.pad_keys(keys)
             self._register_cost(
                 f"hop:{kind}", fused,
@@ -702,6 +705,13 @@ class FrontierState:
         )
         #: wildcard-widening cache: sorted unique direct subjects
         self._all_subj: Optional[np.ndarray] = None
+        #: fused K-hop SpMM server (engine/spmm.py): the whole frontier
+        #: fixpoint in ONE pinned dispatch when eligible; None keeps the
+        #: looped per-hop path below byte-for-byte (EngineConfig.spmm
+        #: off, sharded snapshots, or oversized key domains)
+        from . import spmm as _spmm_mod
+
+        self._spmm = _spmm_mod.fused_for(engine, self)
 
     # -- expansion primitives --------------------------------------------
     def _now(self, now_us):
@@ -766,7 +776,24 @@ class FrontierState:
         walker's reverse worklist, each hop one masked SpMV over the
         reverse tables.  Soundness: every DEFINITE grant has a live,
         resolvable positive edge path; the in-kernel gate filter drops
-        only edges that can never be part of one."""
+        only edges that can never be part of one.
+
+        With the fused SpMM core (engine/spmm.py) the whole fixpoint
+        runs in ONE device dispatch; overflow (frontier/emission/
+        candidate capacity, round budget) falls back to the looped
+        per-hop body below, which is also the streaming path big
+        answers want."""
+        if self._spmm is not None:
+            blocks = self._spmm.resources(
+                rtid, subj_node, srel_slot, wc_node, now_us
+            )
+            if blocks is not None:
+                for b in blocks:
+                    if b.size:
+                        _mt.inc("lookup.candidates", b.size)
+                        yield b
+                return
+            _mt.inc("spmm.fallbacks")
         N, S1, logN = self.N, self.S1, self.logN
         now = self._now(now_us)
         seen_keys = _Seen(N * S1)
@@ -860,7 +887,19 @@ class FrontierState:
         now_us: Optional[int],
     ) -> Iterator[np.ndarray]:
         """Forward frontier expansion from the resource over the fw/argx
-        views — the walker's node/pair worklist as device hops."""
+        views — the walker's node/pair worklist as device hops (or ONE
+        fused SpMM dispatch, overflow falling back here)."""
+        if self._spmm is not None:
+            blocks = self._spmm.subjects(
+                res_node, stid, srel_slot, wc_node, now_us
+            )
+            if blocks is not None:
+                for b in blocks:
+                    if b.size:
+                        _mt.inc("lookup.candidates", b.size)
+                        yield b
+                return
+            _mt.inc("spmm.fallbacks")
         N, S1, logN = self.N, self.S1, self.logN
         snap = self.snap
         num_slots = max(snap.num_slots, 1)

@@ -46,6 +46,13 @@ Injection sites threaded through the tree (grep ``faults.fire``):
     lookup.dispatch          frontier-SpMV lookup hop dispatch
                              (engine/spmv.py; the client's lookup
                              surface retries these under the envelope)
+    spmm.dispatch            fused K-hop SpMM lookup dispatch
+                             (engine/spmm.py; fires BEFORE the fused
+                             program launches, so the client retry
+                             re-runs the whole fixpoint cleanly; the
+                             fused launch also fires lookup.dispatch —
+                             it IS one — so coverage armed on either
+                             site reaches it)
     latency.dispatch         pinned small-batch dispatch (engine/latency.py)
     sharded.dispatch         sharded query partition (parallel/sharded.py)
     sharded.collective       shard_map kernel launch (parallel/sharded.py)

@@ -22,6 +22,11 @@ a human re-read the table.  This script makes the comparison mechanical:
 New metrics (no previous value) and retired metrics are reported but
 never fail the run; platform changes between rounds are noted (a cpu
 round vs a tpu round is apples vs oranges — flagged, not failed).
+A higher-better row whose own ``roofline_frac`` is within tolerance of
+1.0 is flagged ``host-bound`` instead of failed: the kernel is at the
+measured memory-bandwidth ceiling of THIS host, so no software change
+can close the gap — the delta is the box (rounds run on whatever
+container the driver got; the triad ceiling is the host fingerprint).
 
 Usage:
   python scripts/bench_compare.py [--dir /root/repo] [--tolerance 0.10]
@@ -45,10 +50,13 @@ _LOWER_BETTER_UNITS = {"ms", "s", "seconds", "mb", "mib", "bytes", "gb"}
 #: the client check path as a fraction of its p99, from the smoke's
 #: interleaved-rep A/B — growing means provenance is creeping into the
 #: serving budget; ``decisions_dropped``: decision-log entries lost to
-#: sink failures — any growth is an audit-trail hole)
+#: sink failures — any growth is an audit-trail hole;
+#: ``dispatches_per_lookup``: device program launches per LookupResources
+#: drain from bench8 — the fused SpMM path's whole point is holding this
+#: at 1.0, so any growth is the K-hop fusion regressing to per-hop loops)
 _LOWER_BETTER_SUFFIXES = (
     "_ms", "_s", "_latency", "_bytes", "_rss_mb", "pad_fraction",
-    "explain_overhead_frac", "decisions_dropped",
+    "explain_overhead_frac", "decisions_dropped", "dispatches_per_lookup",
 )
 #: suffixes that are HIGHER-better regardless of unit — checked FIRST,
 #: so the perf columns can't be misread by a unit heuristic
@@ -57,9 +65,12 @@ _LOWER_BETTER_SUFFIXES = (
 #: ``hit_rate``/``dedup_frac`` are the verdict-cache columns — a round
 #: that serves fewer checks from cache/dedup at the same workload has
 #: regressed, and ``_frac``'s trailing "_s" must not read as seconds)
+#: (``mixed_users_rate`` is candidates/sec over bench8's 48 small-reach
+#: users — the dispatch-floor workload the fused SpMM path exists for;
+#: its trailing "_rate" must never read as anything but higher-better)
 _HIGHER_BETTER_SUFFIXES = (
     "achieved_gbps", "roofline_frac", "hit_rate", "dedup_frac",
-    "cache_speedup",
+    "cache_speedup", "mixed_users_rate",
 )
 #: extra fields of a metric line promoted to their own comparison rows
 #: (the perf-attribution columns ride headline rows as extra fields —
@@ -70,6 +81,7 @@ _HIGHER_BETTER_SUFFIXES = (
 _PROMOTED_FIELDS = (
     "true_rate", "p99_ms", "achieved_gbps", "roofline_frac", "pad_fraction",
     "cache_hit_rate", "explain_overhead_frac", "decisions_dropped",
+    "mixed_users_rate", "dispatches_per_lookup",
 )
 #: boolean/one-shot rows that carry no trajectory signal
 _SKIP_UNITS = {"ok", "capture", "keys"}
@@ -105,7 +117,12 @@ def metrics_of(path: str) -> dict:
         except (TypeError, ValueError):
             return
         plat = parsed.get("platform", "")
-        out[name] = {"value": value, "unit": unit, "platform": plat}
+        rf = parsed.get("roofline_frac")
+        rf = float(rf) if isinstance(rf, (int, float)) else None
+        out[name] = {
+            "value": value, "unit": unit, "platform": plat,
+            "roofline_frac": rf,
+        }
         for fld in _PROMOTED_FIELDS:
             v = parsed.get(fld)
             if isinstance(v, (int, float)):
@@ -113,6 +130,8 @@ def metrics_of(path: str) -> dict:
                     "value": float(v),
                     "unit": "ms" if fld.endswith("ms") else unit,
                     "platform": plat,
+                    #: promoted companions share the parent row's kernel
+                    "roofline_frac": rf,
                 }
 
     for line in (doc.get("tail") or "").splitlines():
@@ -156,10 +175,20 @@ def compare(
             delta = (nv - ov) / abs(ov)
         lower = lower_is_better(name, n["unit"] or o["unit"])
         worse = -delta if lower else delta
+        rf = n.get("roofline_frac")
         if o.get("platform") and n.get("platform") and (
             o["platform"] != n["platform"]
         ):
             verdict = f"platform {o['platform']}->{n['platform']}"
+        elif (
+            worse < -tolerance and not lower
+            and rf is not None and rf >= 1.0 - tolerance
+        ):
+            # the new round measures at the memory-bandwidth ceiling of
+            # its own host — a throughput drop from there is the box,
+            # not the code (lower-better rows get no such excuse: a
+            # latency row can always regress by software)
+            verdict = f"host-bound ({rf:.2f} of ceiling)"
         elif worse < -tolerance:
             verdict = "REGRESSED"
             regressions += 1

@@ -1,0 +1,238 @@
+#!/usr/bin/env bash
+# SpMM smoke: the unified masked-SpMM serving core (engine/spmm.py)
+# end-to-end on a small world, CI-runnable.  Asserts (1) fused-vs-legacy
+# parity through all three re-expressed kernel families — batched checks
+# (bitwise verdict arrays), LookupResources/LookupSubjects (exact ID
+# lists, host oracle as referee), and the fold T-join (bitwise output
+# arrays incl. the closure-overflow size gate); (2) a ≥2-hop
+# LookupResources drains its whole candidate fixpoint in exactly ONE
+# fused device dispatch, counter-asserted on lookup.dispatches /
+# spmm.dispatches; (3) the bucket-sharded owner-routed hop path (which
+# keeps looped per-hop dispatches by design) matches the single-chip
+# fused answer.  Prints SPMM-SMOKE-OK on success, mirroring the chaos/
+# partition/lookup smokes.  Emits one JSON metric line for
+# benchmarks/run_all.py (config 19).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python - <<'EOF'
+import dataclasses
+import json
+import random
+import sys
+import time
+
+import numpy as np
+
+from gochugaru_tpu.utils.platform import force_cpu_platform
+
+force_cpu_platform(8)
+
+sys.path.insert(0, ".")
+from gochugaru_tpu import rel
+from gochugaru_tpu.caveats import compile_cel
+from gochugaru_tpu.engine import lookup as lm
+from gochugaru_tpu.engine import spmv
+from gochugaru_tpu.engine.device import DeviceEngine
+from gochugaru_tpu.engine.fold import t_join_core
+from gochugaru_tpu.engine.oracle import Oracle
+from gochugaru_tpu.engine.spmm import tjoin_spmm
+from gochugaru_tpu.parallel import ShardedEngine, make_mesh
+from gochugaru_tpu.schema import compile_schema, parse_schema
+from gochugaru_tpu.store.interner import Interner
+from gochugaru_tpu.store.snapshot import build_snapshot
+from gochugaru_tpu.utils.metrics import default as _m
+
+t0 = time.time()
+NOW = 1_700_000_000_000_000
+
+# every gate the semiring multiplies: caveats, recursive usersets,
+# wildcards, arrow chains, exclusion, intersection
+SCHEMA = """
+caveat lim(v int, cap int) { v <= cap }
+definition user {}
+definition group {
+    relation member: user | group#member | user:*
+}
+definition folder {
+    relation parent: folder
+    relation viewer: user | group#member
+    permission view = viewer + parent->view
+}
+definition doc {
+    relation parent: folder
+    relation owner: user | group#member
+    relation writer: user | group#member | user with lim
+    relation banned: user
+    permission write = (owner + writer + parent->view) - banned
+    permission manage = owner & writer
+}
+"""
+
+rng = random.Random(7)
+users = [f"user:u{i}" for i in range(40)]
+groups = [f"group:g{i}" for i in range(6)]
+folders = [f"folder:f{i}" for i in range(30)]
+docs = [f"doc:d{i}" for i in range(200)]
+rels = []
+# nested groups (g0 ⊃ g1 ⊃ g2 ...) + direct members + one wildcard
+for i in range(len(groups) - 1):
+    rels.append(rel.must_from_tuple(f"{groups[i]}#member",
+                                    f"{groups[i+1]}#member"))
+for g in groups:
+    for u in rng.sample(users, 4):
+        rels.append(rel.must_from_tuple(f"{g}#member", u))
+rels.append(rel.must_from_tuple(f"{groups[-1]}#member", "user:*"))
+# folder forest (arity 4) with group and user viewers near the roots
+for i in range(1, len(folders)):
+    rels.append(rel.must_from_tuple(f"{folders[i]}#parent",
+                                    f"folder:f{(i - 1) // 4}"))
+rels.append(rel.must_from_tuple(f"{folders[0]}#viewer",
+                                f"{groups[1]}#member"))
+rels.append(rel.must_from_tuple(f"{folders[2]}#viewer",
+                                rng.choice(users)))
+for d in docs:
+    rels.append(rel.must_from_tuple(f"{d}#parent", rng.choice(folders)))
+    if rng.random() < 0.3:
+        rels.append(rel.must_from_tuple(f"{d}#owner", rng.choice(users)))
+    if rng.random() < 0.3:
+        r = rel.must_from_triple(d, "writer", rng.choice(users))
+        if rng.random() < 0.5:
+            r = r.with_caveat("lim", {"v": rng.choice([1, 99]), "cap": 10})
+        rels.append(r)
+    if rng.random() < 0.1:
+        rels.append(rel.must_from_triple(d, "banned", rng.choice(users)))
+
+cs = compile_schema(parse_schema(SCHEMA))
+snap = build_snapshot(1, cs, Interner(), rels, epoch_us=NOW)
+progs = {
+    name: compile_cel(name, decl.params, decl.expression)
+    for name, decl in cs.schema.caveats.items()
+}
+oracle = Oracle(cs, rels, progs, now_us=NOW)
+eng_on = DeviceEngine(cs)
+assert eng_on.config.spmm, "spmm must default on"
+eng_off = DeviceEngine(cs, dataclasses.replace(eng_on.config, spmm=False))
+ds_on = eng_on.prepare(snap)
+ds_off = eng_off.prepare(snap)
+assert spmv.frontier_ok(eng_on, ds_on), "frontier path must serve"
+
+# (1a) check family: bitwise verdict parity, fused vs legacy T-join
+queries = [
+    rel.must_from_triple(rng.choice(docs), perm, rng.choice(users))
+    for perm in ("write", "manage") for _ in range(60)
+]
+d_on, p_on, o_on = eng_on.check_batch(ds_on, queries, now_us=NOW)
+d_off, p_off, o_off = eng_off.check_batch(ds_off, queries, now_us=NOW)
+assert (np.array_equal(d_on, d_off) and np.array_equal(p_on, p_off)
+        and np.array_equal(o_on, o_off)), "check verdicts diverged"
+print(f"check parity: ok ({len(queries)} verdicts bitwise)",
+      file=sys.stderr)
+
+# (1b) fold family: the T-join as an SpMM instance, bitwise incl. the
+# closure-overflow size gate (None == None)
+jrng = np.random.RandomState(7)
+k1 = jrng.randint(0, 50, 150).astype(np.int64)
+pe = jrng.randint(0, 40, 150).astype(np.int64)
+w = jrng.randint(1, 1000, 150).astype(np.int32)
+cl_k1 = jrng.randint(0, 60, 200).astype(np.int64)
+cl_k2 = jrng.randint(0, 40, 200).astype(np.int64)
+c_d = jrng.randint(0, 1000, 200).astype(np.int32)
+c_p = jrng.randint(0, 1000, 200).astype(np.int32)
+for cap in (1 << 30, 220, 1):
+    a = t_join_core(k1, pe, w, cl_k1, cl_k2, c_d, c_p, cap)
+    b = tjoin_spmm(k1, pe, w, cl_k1, cl_k2, c_d, c_p, cap)
+    if a is None:
+        assert b is None, "overflow gate diverged"
+        continue
+    assert b is not None and len(a) == len(b)
+    for x, y in zip(a, b):
+        assert x.dtype == y.dtype
+        np.testing.assert_array_equal(x, y)
+print("fold T-join parity: ok (bitwise, 3 caps)", file=sys.stderr)
+
+# (1c) lookup family: fused == legacy == host oracle, both directions
+checked = 0
+for u in users[:8] + [f"{groups[0]}#member"]:
+    stype, _, q = u.partition(":")
+    sid, _, srel = q.partition("#")
+    fused = lm.lookup_resources_device(
+        eng_on, ds_on, "doc", "write", stype, sid, srel,
+        now_us=NOW, oracle_factory=lambda: oracle,
+    )
+    legacy = lm.lookup_resources_device(
+        eng_off, ds_off, "doc", "write", stype, sid, srel,
+        now_us=NOW, oracle_factory=lambda: oracle,
+    )
+    want = sorted(oracle.lookup_resources("doc", "write", stype, sid, srel))
+    assert fused == legacy == want, f"resources parity broke for {u}"
+    checked += len(fused)
+for d in docs[:6]:
+    fused = lm.lookup_subjects_device(
+        eng_on, ds_on, "doc", d.split(":")[1], "write", "user",
+        now_us=NOW, oracle_factory=lambda: oracle,
+    )
+    legacy = lm.lookup_subjects_device(
+        eng_off, ds_off, "doc", d.split(":")[1], "write", "user",
+        now_us=NOW, oracle_factory=lambda: oracle,
+    )
+    want = sorted(oracle.lookup_subjects(
+        "doc", d.split(":")[1], "write", "user", ""
+    ))
+    assert fused == legacy == want, f"subjects parity broke for {d}"
+    checked += len(fused)
+print(f"lookup parity: ok ({checked} results, both directions)",
+      file=sys.stderr)
+
+# (2) a ≥2-hop lookup (doc -> folder chain -> group closure) drains in
+# exactly ONE fused dispatch: the tentpole's counter-asserted contract
+st = spmv.state_for(eng_on, ds_on)
+assert st._spmm is not None, "fused server must be eligible"
+snap_i = snap.interner
+# the largest-reach user: an answer spanning many docs can only come
+# through group closure -> folder viewer -> parent chain (≥2 hops)
+reach = {
+    u: len(list(oracle.lookup_resources("doc", "write", "user",
+                                        u.split(":")[1], "")))
+    for u in users
+}
+deep_user = max(users, key=lambda u: reach[u])
+assert reach[deep_user] > 20, "no multi-hop bulk subject in this world"
+un = snap_i.lookup("user", deep_user.split(":")[1])
+wc = snap_i.lookup("user", "*")
+rtid = snap_i.type_lookup("doc")
+looped0 = _m.counter("lookup.dispatches")
+fused0 = _m.counter("spmm.dispatches")
+n = 0
+for blk in st.resource_candidates(rtid, un, -1, wc, NOW):
+    n += blk.shape[0]
+assert n >= reach[deep_user], "candidates must be a superset"
+assert _m.counter("spmm.dispatches") - fused0 == 1, "not one fused dispatch"
+assert _m.counter("lookup.dispatches") - looped0 == 0, "looped hops leaked"
+print(f"one-dispatch fixpoint: ok ({n} candidates, ≥2 hops)",
+      file=sys.stderr)
+
+# (3) owner-routed 2-shard hops (looped by design) match the fused answer
+sh = ShardedEngine(cs, make_mesh(1, 2))
+sds = sh.prepare(snap)
+assert spmv.frontier_ok(sh, sds)
+uid = deep_user.split(":")[1]
+routed = lm.lookup_resources_device(
+    sh, sds, "doc", "write", "user", uid,
+    now_us=NOW, oracle_factory=lambda: oracle,
+)
+single = lm.lookup_resources_device(
+    eng_on, ds_on, "doc", "write", "user", uid,
+    now_us=NOW, oracle_factory=lambda: oracle,
+)
+assert routed == single, "routed-shard lookup diverged from fused"
+print("routed-shard parity: ok", file=sys.stderr)
+
+print(json.dumps({
+    "metric": "spmm_smoke", "value": checked, "unit": "parity results",
+    "vs_baseline": 1.0, "edges": int(snap.num_edges), "batch": len(queries),
+    "wall_s": round(time.time() - t0, 1),
+}))
+EOF
+
+echo "SPMM-SMOKE-OK"

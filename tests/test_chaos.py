@@ -64,6 +64,8 @@ CHAOS_SITES = (
     "store.materialize",
     "snapshot.finish",
     "explain.walk",
+    "lookup.dispatch",
+    "spmm.dispatch",
 )
 
 
@@ -175,8 +177,17 @@ def test_chaos_soak():
         ctx = background().with_timeout(30.0)
         result = None
         explained = None
+        looked_up = None
+        lookup_subj = rng.choice(users + ["user:own0", "user:tm1"])
         try:
             result = chaos.check(ctx, consistency.full(), *queries)
+            if rnd % 4 == 1:
+                # lookup under the same armed faults: the fused SpMM
+                # dispatch (spmm.dispatch) and the looped hop dispatch
+                # (lookup.dispatch) both classify into the retry envelope
+                looked_up = sorted(chaos.lookup_resources(
+                    ctx, consistency.full(), "doc#read", lookup_subj
+                ))
             if rnd % 3 == 0:
                 # explain under the same armed faults: the explain.walk
                 # site (and any armed dispatch/prepare site the witness
@@ -198,6 +209,12 @@ def test_chaos_soak():
             want = oracle.check(background(), consistency.full(), *queries)
             if result != want:
                 mismatches.append((rnd, result, want))
+        if looked_up is not None:
+            want_lu = sorted(oracle.lookup_resources(
+                background(), consistency.full(), "doc#read", lookup_subj
+            ))
+            if looked_up != want_lu:
+                mismatches.append((rnd, "lookup", looked_up, want_lu))
         if explained is not None:
             # no torn trees: a returned tree is complete (popped root)
             # and verdict-exact against the oracle at the same head

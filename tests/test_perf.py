@@ -555,3 +555,78 @@ def test_bench_compare_provenance_column_directions():
     assert not bc.lower_is_better("serve_openloop_goodput.roofline_frac", "")
     for fld in ("explain_overhead_frac", "decisions_dropped"):
         assert fld in bc._PROMOTED_FIELDS
+
+
+def test_bench_compare_spmm_column_directions():
+    """The fused-SpMM bench columns are direction-aware from round one:
+    ``mixed_users_rate`` (bench8's 48-random-user candidate rate, the
+    dispatch-floor workload the fused path exists for) falling is a
+    regression; ``dispatches_per_lookup`` growing means the K-hop fusion
+    is regressing to per-hop loops.  Both promoted off headline rows."""
+    bc = _bench_compare()
+    assert not bc.lower_is_better(
+        "lookup_fused_vs_looped.mixed_users_rate", "x"
+    )
+    assert not bc.lower_is_better(
+        "lookup_candidates_per_s.mixed_users_rate", "candidates/sec/chip"
+    )
+    assert bc.lower_is_better(
+        "lookup_fused_vs_looped.dispatches_per_lookup", "x"
+    )
+    assert bc.lower_is_better(
+        "lookup_candidates_per_s.dispatches_per_lookup",
+        "candidates/sec/chip",
+    )
+    for fld in ("mixed_users_rate", "dispatches_per_lookup"):
+        assert fld in bc._PROMOTED_FIELDS
+    # direction actually drives the verdict both ways
+    old = {
+        "l.mixed_users_rate": {"value": 9e5, "unit": "x", "platform": ""},
+        "l.dispatches_per_lookup": {"value": 1.0, "unit": "x",
+                                    "platform": ""},
+    }
+    new = {
+        "l.mixed_users_rate": {"value": 3e5, "unit": "x", "platform": ""},
+        "l.dispatches_per_lookup": {"value": 3.9, "unit": "x",
+                                    "platform": ""},
+    }
+    rows, regressions = bc.compare(old, new, "r05", "r06", 0.10)
+    assert regressions == 2 and "REGRESSED" in "\n".join(rows)
+
+
+def test_bench_compare_host_bound_escape():
+    """A higher-better row measuring at its OWN host's bandwidth ceiling
+    (``roofline_frac`` within tolerance of 1.0) flags ``host-bound``
+    instead of failing: software can't beat the memory wall, so the
+    round-over-round drop is the container, not the code.  Lower-better
+    rows get no such escape, and a row below the ceiling still fails."""
+    bc = _bench_compare()
+    old = {"t": {"value": 12.6e6, "unit": "checks/sec/chip",
+                 "platform": "cpu"}}
+    at_ceiling = {"t": {"value": 5.8e6, "unit": "checks/sec/chip",
+                        "platform": "cpu", "roofline_frac": 0.958}}
+    rows, regressions = bc.compare(old, at_ceiling, "r05", "r06", 0.10)
+    assert regressions == 0 and "host-bound" in "\n".join(rows)
+    below_ceiling = {"t": {"value": 5.8e6, "unit": "checks/sec/chip",
+                           "platform": "cpu", "roofline_frac": 0.55}}
+    rows, regressions = bc.compare(old, below_ceiling, "r05", "r06", 0.10)
+    assert regressions == 1 and "REGRESSED" in "\n".join(rows)
+    # no escape for latency rows: at-ceiling bandwidth doesn't excuse a
+    # p99 that tripled
+    old_ms = {"t_p99_ms": {"value": 9.0, "unit": "ms", "platform": "cpu"}}
+    new_ms = {"t_p99_ms": {"value": 30.0, "unit": "ms", "platform": "cpu",
+                           "roofline_frac": 0.958}}
+    rows, regressions = bc.compare(old_ms, new_ms, "r05", "r06", 0.10)
+    assert regressions == 1
+    # promoted companions inherit the parent row's roofline_frac
+    import json as _json
+    import tempfile, os as _os
+    with tempfile.TemporaryDirectory() as td:
+        p = _os.path.join(td, "BENCH_r06.json")
+        row = {"metric": "m", "value": 1.0, "unit": "checks/sec/chip",
+               "true_rate": 0.9, "roofline_frac": 0.97, "platform": "cpu"}
+        with open(p, "w") as f:
+            _json.dump({"tail": _json.dumps(row), "parsed": None}, f)
+        mets = bc.metrics_of(p)
+    assert mets["m"]["roofline_frac"] == 0.97
+    assert mets["m.true_rate"]["roofline_frac"] == 0.97

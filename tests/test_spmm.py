@@ -1,0 +1,462 @@
+"""Unified masked-SpMM sparse core (engine/spmm.py) — property-fuzz
+parity against BOTH oracles plus the fused-dispatch contracts.
+
+The parity discipline: with ``EngineConfig.spmm`` on (the default) the
+fused K-hop programs serve multi-hop lookups in ONE device dispatch and
+the T-index join runs through the generic semiring product; with it off
+the looped spmv path and the bespoke ``t_join_core`` serve byte-for-byte
+as before.  Every fuzzed world here is answered three ways — fused,
+legacy-looped, host walker oracle — and all three must agree exactly,
+including caveats (conditional-by-construction omitted), expirations,
+wildcards, recursive groups, and exclusion/intersection rewrites.
+
+Dispatch contracts asserted on counters, not logs:
+- a ≥2-hop LookupResources completes in exactly 1 ``spmm.dispatches``
+  with 0 looped ``lookup.dispatches``;
+- 100 fused dispatches on one snapshot trace the program exactly once
+  (the pinned-executable discipline);
+- the ``spmm.dispatch`` fault site classifies into the client retry
+  envelope (same contract as ``lookup.dispatch``) and survives a seeded
+  probabilistic soak with every answer still exact.
+"""
+
+import dataclasses
+import random
+
+import numpy as np
+import pytest
+
+import test_lookup as tl
+from gochugaru_tpu import rel
+from gochugaru_tpu.caveats import compile_cel
+from gochugaru_tpu.engine import lookup as lm
+from gochugaru_tpu.engine import spmv
+from gochugaru_tpu.engine.device import DeviceEngine
+from gochugaru_tpu.engine.fold import t_join_core
+from gochugaru_tpu.engine.oracle import Oracle
+from gochugaru_tpu.engine.spmm import masked_semiring_spmm, tjoin_spmm
+from gochugaru_tpu.schema import compile_schema, parse_schema
+from gochugaru_tpu.store.interner import Interner
+from gochugaru_tpu.store.snapshot import build_snapshot
+from gochugaru_tpu.utils import faults
+from gochugaru_tpu.utils.metrics import default as _m
+
+NOW = tl.NOW
+
+
+def dual_world(schema, rels):
+    """(fused engine+dsnap, legacy engine+dsnap, oracle) over one
+    snapshot — the two engines differ ONLY in ``config.spmm``."""
+    cs = compile_schema(parse_schema(schema))
+    snap = build_snapshot(1, cs, Interner(), rels, epoch_us=NOW)
+    progs = {
+        name: compile_cel(name, decl.params, decl.expression)
+        for name, decl in cs.schema.caveats.items()
+    }
+    oracle = Oracle(cs, rels, progs, now_us=NOW)
+    eng_on = DeviceEngine(cs)
+    assert eng_on.config.spmm, "spmm must default on"
+    eng_off = DeviceEngine(
+        cs, dataclasses.replace(eng_on.config, spmm=False)
+    )
+    return (eng_on, eng_on.prepare(snap)), (eng_off, eng_off.prepare(snap)), oracle
+
+
+def assert_res_parity(on, off, oracle, rtype, perm, s):
+    stype, _, rest = s.partition(":")
+    sid, _, srel = rest.partition("#")
+    fused = lm.lookup_resources_device(
+        on[0], on[1], rtype, perm, stype, sid, srel,
+        now_us=NOW, oracle_factory=lambda: oracle,
+    )
+    legacy = lm.lookup_resources_device(
+        off[0], off[1], rtype, perm, stype, sid, srel,
+        now_us=NOW, oracle_factory=lambda: oracle,
+    )
+    want = sorted(oracle.lookup_resources(rtype, perm, stype, sid, srel))
+    assert fused == legacy == want, (
+        f"resources({rtype}#{perm}, {s}): fused={fused} legacy={legacy} "
+        f"oracle={want}"
+    )
+
+
+def assert_subj_parity(on, off, oracle, rtype, rid, perm, subj):
+    stype, _, srel = subj.partition("#")
+    fused = lm.lookup_subjects_device(
+        on[0], on[1], rtype, rid, perm, stype, srel,
+        now_us=NOW, oracle_factory=lambda: oracle,
+    )
+    legacy = lm.lookup_subjects_device(
+        off[0], off[1], rtype, rid, perm, stype, srel,
+        now_us=NOW, oracle_factory=lambda: oracle,
+    )
+    want = sorted(oracle.lookup_subjects(rtype, rid, perm, stype, srel))
+    assert fused == legacy == want, (
+        f"subjects({rtype}:{rid}#{perm}, {subj}): fused={fused} "
+        f"legacy={legacy} oracle={want}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# property fuzz: fused == legacy == walker on randomized worlds
+# ---------------------------------------------------------------------------
+
+FUZZ_SCHEMA = """
+caveat lim(v int, cap int) { v <= cap }
+definition user {}
+definition group {
+    relation member: user | group#member | user:*
+}
+definition folder {
+    relation parent: folder
+    relation viewer: user | group#member
+    permission view = viewer + parent->view
+}
+definition proj {
+    relation parent: folder
+    relation owner: user | group#member
+    relation writer: user | group#member | user with lim
+    relation banned: user
+    permission write = (owner + writer + parent->view) - banned
+    permission manage = owner & writer
+}
+"""
+
+
+def fuzz_world(seed):
+    """Randomized world exercising every gate the semiring multiplies:
+    caveats (definite / failing / conditional-by-construction),
+    expirations (live and lapsed), wildcards, recursive usersets, arrow
+    chains, exclusion and intersection."""
+    import datetime as dt
+
+    rng = random.Random(seed)
+    users = [f"user:u{i}" for i in range(12)]
+    groups = [f"group:g{i}" for i in range(5)]
+    folders = [f"folder:f{i}" for i in range(6)]
+    projs = [f"proj:p{i}" for i in range(8)]
+    past = dt.datetime.fromtimestamp(
+        (NOW - 5_000_000) / 1e6, tz=dt.timezone.utc
+    )
+    future = dt.datetime.fromtimestamp(
+        (NOW + 3_600_000_000) / 1e6, tz=dt.timezone.utc
+    )
+    rels = []
+
+    def maybe_expire(r):
+        p = rng.random()
+        if p < 0.15:
+            return r.with_expiration(past)  # lapsed: grants nothing
+        if p < 0.3:
+            return r.with_expiration(future)  # live window
+        return r
+
+    for g in groups:
+        for u in rng.sample(users, 3):
+            rels.append(maybe_expire(rel.must_from_tuple(f"{g}#member", u)))
+        if rng.random() < 0.5:
+            rels.append(rel.must_from_tuple(
+                f"{g}#member", f"{rng.choice(groups)}#member"
+            ))
+        if rng.random() < 0.3:
+            rels.append(rel.must_from_tuple(f"{g}#member", "user:*"))
+    for i, f in enumerate(folders):
+        if i and rng.random() < 0.6:
+            rels.append(rel.must_from_tuple(
+                f"{f}#parent", folders[rng.randrange(i)]
+            ))
+        if rng.random() < 0.7:
+            rels.append(maybe_expire(
+                rel.must_from_tuple(f"{f}#viewer", rng.choice(users))
+            ))
+        if rng.random() < 0.4:
+            rels.append(rel.must_from_tuple(
+                f"{f}#viewer", f"{rng.choice(groups)}#member"
+            ))
+    for p in projs:
+        if rng.random() < 0.7:
+            rels.append(rel.must_from_tuple(f"{p}#parent", rng.choice(folders)))
+        rels.append(rel.must_from_tuple(f"{p}#owner", rng.choice(users)))
+        if rng.random() < 0.7:
+            rels.append(rel.must_from_tuple(
+                f"{p}#owner", f"{rng.choice(groups)}#member"
+            ))
+        for u in rng.sample(users, 2):
+            r = rel.must_from_tuple(f"{p}#writer", u)
+            if rng.random() < 0.4:
+                r = r.with_caveat(
+                    "lim",
+                    {"v": rng.randint(0, 9), "cap": 5}
+                    if rng.random() < 0.7 else {},
+                )
+            rels.append(maybe_expire(r))
+        if rng.random() < 0.4:
+            rels.append(rel.must_from_tuple(f"{p}#banned", rng.choice(users)))
+    return rels, users, groups, projs
+
+
+@pytest.mark.parametrize("seed", [1, 2, 7])
+def test_spmm_fuzz_parity(seed):
+    rng = random.Random(seed * 31)
+    rels, users, groups, projs = fuzz_world(seed)
+    on, off, oracle = dual_world(FUZZ_SCHEMA, rels)
+    d0 = _m.counter("spmm.dispatches")
+    for u in rng.sample(users, 5) + ["user:stranger"]:
+        for perm in ("write", "manage"):
+            assert_res_parity(on, off, oracle, "proj", perm, u)
+    for g in groups:
+        assert_res_parity(on, off, oracle, "proj", "write", f"{g}#member")
+    for p in rng.sample(projs, 4):
+        pid = p.split(":")[1]
+        for perm in ("write", "manage"):
+            assert_subj_parity(on, off, oracle, "proj", pid, perm, "user")
+        assert_subj_parity(
+            on, off, oracle, "proj", pid, "write", "group#member"
+        )
+    # the fused path actually served (not silently falling back)
+    assert _m.counter("spmm.dispatches") > d0
+
+
+# ---------------------------------------------------------------------------
+# T-join: the generic semiring product is bitwise the bespoke kernel
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_tjoin_spmm_bitwise_parity(seed):
+    rng = np.random.RandomState(seed)
+    n_us = int(rng.randint(1, 200))
+    n_cl = int(rng.randint(1, 300))
+    k1 = rng.randint(0, 50, n_us).astype(np.int64)
+    pe = rng.randint(0, 40, n_us).astype(np.int64)
+    w = rng.randint(1, 1000, n_us).astype(np.int32)
+    cl_k1 = rng.randint(0, 60, n_cl).astype(np.int64)
+    cl_k2 = rng.randint(0, 40, n_cl).astype(np.int64)
+    c_d = rng.randint(0, 1000, n_cl).astype(np.int32)
+    c_p = rng.randint(0, 1000, n_cl).astype(np.int32)
+    # plenty / tight / guaranteed closure-overflow caps: the size gate
+    # must agree too (None == None)
+    for cap in (1 << 30, n_us + n_cl // 2, 1):
+        a = t_join_core(k1, pe, w, cl_k1, cl_k2, c_d, c_p, cap)
+        b = tjoin_spmm(k1, pe, w, cl_k1, cl_k2, c_d, c_p, cap)
+        if a is None:
+            assert b is None
+            continue
+        assert b is not None
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            assert x.dtype == y.dtype
+            np.testing.assert_array_equal(x, y)
+
+
+def test_masked_semiring_identity_term():
+    # one A row, empty B: the product is exactly A's identity rows
+    got = masked_semiring_spmm(
+        np.asarray([7], np.int64), np.asarray([3], np.int64),
+        np.asarray([9], np.int32),
+        np.empty(0, np.int64), np.empty(0, np.int64),
+        (np.empty(0, np.int32), np.empty(0, np.int32)), 16,
+    )
+    assert got is not None
+    np.testing.assert_array_equal(got[0], [7])
+    np.testing.assert_array_equal(got[1], [3])
+    np.testing.assert_array_equal(got[2], [9])
+    np.testing.assert_array_equal(got[3], [9])
+
+
+# ---------------------------------------------------------------------------
+# dispatch contracts: one dispatch, one trace, exact cursors
+# ---------------------------------------------------------------------------
+
+
+def rbac_dual():
+    rels, users, teams, orgs, repos = tl.rbac_world()
+    on, off, oracle = dual_world(tl.RBAC, rels)
+    return on, off, oracle, users, teams, repos
+
+
+def test_multihop_lookup_is_one_device_dispatch():
+    """A LookupResources crossing ≥2 hops (reader + org->admin arrow)
+    drains its whole candidate fixpoint in exactly ONE fused dispatch —
+    counter-asserted, 0 looped dispatches."""
+    on, off, oracle, users, teams, repos = rbac_dual()
+    engine, dsnap = on
+    st = spmv.state_for(engine, dsnap)
+    assert st._spmm is not None, "fused server must be eligible here"
+    snap = dsnap.snapshot
+    rtid = snap.interner.type_lookup("repo")
+    # a user who reaches repos through the 2-hop org->admin arrow
+    admin_uid = next(
+        u for u in users
+        if oracle.lookup_resources("repo", "admin", "user", u.split(":")[1], "")
+    )
+    un = snap.interner.lookup("user", admin_uid.split(":")[1])
+    d0 = _m.counter("spmm.dispatches")
+    l0 = _m.counter("lookup.dispatches")
+    blocks = list(st.resource_candidates(rtid, un, -1, -1, NOW))
+    assert _m.counter("spmm.dispatches") - d0 == 1
+    assert _m.counter("lookup.dispatches") - l0 == 0
+    cands = set()
+    for b in blocks:
+        cands.update(int(x) for x in b)
+    want = {
+        snap.interner.lookup("repo", r)
+        for r in oracle.lookup_resources(
+            "repo", "admin", "user", admin_uid.split(":")[1], ""
+        )
+    }
+    assert want <= cands, "fused candidates must be a superset"
+
+
+def test_no_retrace_across_100_fused_dispatches():
+    on, off, oracle, users, teams, repos = rbac_dual()
+    engine, dsnap = on
+    st = spmv.state_for(engine, dsnap)
+    assert st._spmm is not None
+    snap = dsnap.snapshot
+    rtid = snap.interner.type_lookup("repo")
+    kern = st._spmm.kern
+    t0 = dict(kern.traces)
+    d0 = _m.counter("spmm.dispatches")
+    rng = random.Random(11)
+    for i in range(100):
+        u = rng.choice(users).split(":")[1]
+        un = snap.interner.lookup("user", u)
+        list(st.resource_candidates(rtid, un, -1, -1, NOW))
+    assert _m.counter("spmm.dispatches") - d0 == 100
+    # the pinned path: ONE trace serves all 100 dispatches
+    assert kern.traces["res"] - t0.get("res", 0) == 1
+
+
+def test_cursor_resume_across_fused_dispatch():
+    """Paged draining over the fused path: cursors round-trip through
+    their string encoding, and an evicted stream recompute-resumes to
+    the identical continuation (the fused program is deterministic)."""
+    on, off, oracle, users, teams, repos = rbac_dual()
+    engine, dsnap = on
+    full = {}
+    for u in users[:4]:
+        sid = u.split(":")[1]
+        full[u] = lm.lookup_resources_device(
+            engine, dsnap, "repo", "read", "user", sid, "",
+            now_us=NOW, oracle_factory=lambda: oracle,
+        )
+    for u in users[:4]:
+        sid = u.split(":")[1]
+        out, cursor, pages = [], None, 0
+        while True:
+            ids, cursor = lm.lookup_resources_page(
+                engine, dsnap, "repo", "read", "user", sid, "",
+                page_size=2, cursor=cursor, now_us=NOW,
+                oracle_factory=lambda: oracle,
+            )
+            out.extend(ids)
+            pages += 1
+            if cursor is None:
+                break
+            cursor = spmv.LookupCursor.decode(cursor.encode())
+            # evict the live stream: the next page exercises the
+            # deterministic recompute-and-skip across a fused dispatch
+            if pages % 2 == 1:
+                dsnap.__dict__.get("_lookup_streams", {}).clear()
+        assert sorted(out) == full[u]
+        assert len(out) == len(set(out)), "no duplicates across pages"
+
+
+def test_spmm_parity_survives_overflow_fallback():
+    """Force every fused capacity to overflow: answers must still be
+    exact (the looped path serves), with fallbacks counted."""
+    rels, users, teams, orgs, repos = tl.rbac_world()
+    cs = compile_schema(parse_schema(tl.RBAC))
+    snap = build_snapshot(1, cs, Interner(), rels, epoch_us=NOW)
+    oracle = Oracle(cs, rels, {}, now_us=NOW)
+    eng = DeviceEngine(cs)
+    eng = DeviceEngine(cs, dataclasses.replace(
+        eng.config, spmm_rounds=1, spmm_candidates=2,
+    ))
+    dsnap = eng.prepare(snap)
+    f0 = _m.counter("spmm.fallbacks")
+    for u in users[:4]:
+        sid = u.split(":")[1]
+        got = lm.lookup_resources_device(
+            eng, dsnap, "repo", "read", "user", sid, "",
+            now_us=NOW, oracle_factory=lambda: oracle,
+        )
+        want = sorted(oracle.lookup_resources("repo", "read", "user", sid, ""))
+        assert got == want
+    assert _m.counter("spmm.fallbacks") > f0
+
+
+# ---------------------------------------------------------------------------
+# the spmm.dispatch fault site: retry envelope + seeded soak
+# ---------------------------------------------------------------------------
+
+
+def _client_world():
+    from gochugaru_tpu import new_tpu_evaluator
+    from gochugaru_tpu.rel.txn import Txn
+    from gochugaru_tpu.utils.context import background
+
+    c = new_tpu_evaluator()
+    ctx = background()
+    c.write_schema(ctx, tl.RBAC)
+    rels, users, teams, orgs, repos = tl.rbac_world(
+        seed=3, n_users=10, n_repos=6
+    )
+    txn = Txn()
+    for r in rels:
+        txn.create(r)
+    rev = c.write(ctx, txn)
+    return c, ctx, rev, users
+
+
+def test_client_envelope_retries_spmm_dispatch_fault():
+    from gochugaru_tpu import consistency
+    from gochugaru_tpu.utils.metrics import default as m
+
+    c, ctx, rev, users = _client_world()
+    cs = consistency.at_least(rev)
+    base_retries = m.counter("retry.retries")
+    with faults.default.armed("spmm.dispatch", times=1) as spec:
+        got = sorted(c.lookup_resources(ctx, cs, "repo#read", users[0]))
+    assert spec.fired == 1
+    assert m.counter("retry.retries") >= base_retries + 1
+    snap = c.store.snapshot_for(cs)
+    oracle = c._oracle_for(snap)
+    stype, sid = users[0].split(":")
+    assert got == sorted(oracle.lookup_resources("repo", "read", stype, sid, ""))
+
+
+def test_spmm_dispatch_chaos_soak():
+    """Seeded probabilistic faulting of the fused dispatch across a
+    burst of client lookups: every call either retries to the exact
+    answer or sheds classified — never a wrong answer, never a raw
+    traceback."""
+    from gochugaru_tpu import consistency
+    from gochugaru_tpu.utils.errors import AuthzError, UnavailableError
+
+    c, ctx, rev, users = _client_world()
+    cs = consistency.at_least(rev)
+    snap = c.store.snapshot_for(cs)
+    oracle = c._oracle_for(snap)
+    rng = random.Random(20260806)
+    sheds = 0
+    faults.arm("spmm.dispatch", probability=0.35, seed=20260806)
+    try:
+        for i in range(25):
+            u = rng.choice(users)
+            stype, sid = u.split(":")
+            try:
+                got = sorted(c.lookup_resources(ctx, cs, "repo#read", u))
+            except UnavailableError:
+                sheds += 1  # classified shed after exhausted retries: ok
+                continue
+            except BaseException as e:
+                assert isinstance(e, AuthzError), f"unclassified: {e!r}"
+                raise
+            assert got == sorted(
+                oracle.lookup_resources("repo", "read", stype, sid, "")
+            )
+    finally:
+        faults.disarm("spmm.dispatch")
+    assert faults.default.spec("spmm.dispatch") is None

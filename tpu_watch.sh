@@ -134,6 +134,20 @@ print(json.dumps({
 }))
 WEOF
       log "witness on/off A/B rc=$? → tpu_attempts/witness_${TS}.out"
+      # priority 3.9: fused-vs-looped hop A/B (unified SpMM core): on
+      # the CPU proxy the fused K-hop program pays its fixed cost
+      # against ~free Python hops — on TPU, where every looped hop eats
+      # a real dispatch floor, the one-dispatch fixpoint is the whole
+      # bet (bench8's lookup_fused_vs_looped row: same snapshot, same
+      # mixed users, spmm on vs off).  Re-dump the roofline note AFTER
+      # the A/B so the fused SpMM programs the window just launched are
+      # in the /perf cost ledger beside the capture.
+      timeout 700 python benchmarks/bench8_lookup.py --scale 0.2 \
+        > "tpu_attempts/spmm_${TS}.out" 2> "tpu_attempts/spmm_${TS}.err"
+      log "fused-vs-looped A/B rc=$? → tpu_attempts/spmm_${TS}.out"
+      timeout 180 python -m gochugaru_tpu.utils.perf --refresh \
+        > "tpu_attempts/trace_${TS}/roofline.json" 2>> tpu_attempts/log.txt
+      log "roofline (post-SpMM) rc=$? → tpu_attempts/trace_${TS}/roofline.json"
       # priority 4: the wider ladder while the window lasts
       timeout 420 python benchmarks/bench1_founders.py \
         > "tpu_attempts/b1_${TS}.out" 2> "tpu_attempts/b1_${TS}.err"
