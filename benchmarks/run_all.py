@@ -229,6 +229,19 @@ def main() -> int:
         ["bash", "scripts/spmm_smoke.sh"],
         600,
     ))
+    configs.append((
+        "20 — fleet serving: replica processes, goodput scaling,"
+        " zero-stale per strategy, seeded kill + failover p99"
+        + (" (quick)" if q else ""),
+        [py, "benchmarks/bench10_fleet.py"] + (["--quick"] if q else []),
+        900,
+    ))
+    configs.append((
+        "21 — fleet smoke (self-joining replica processes, zookie"
+        " read-your-writes, SIGKILL survival with zero lost/dup/stale)",
+        ["bash", "scripts/fleet_smoke.sh"],
+        600,
+    ))
     if not q:
         # Leopard-scale CPU proxy (VERDICT r04 item 3): the same Watch
         # re-index loop at a 100M-edge base — BASELINE config 5's

@@ -24,6 +24,7 @@ Check resolution is a three-tier cascade:
 from __future__ import annotations
 
 import contextlib
+import dataclasses as _dataclasses
 import threading
 from typing import (
     Any,
@@ -90,6 +91,26 @@ DELETE_BATCH = 10_000
 #: edges (the chunk list holds references, not copies — the transient
 #: cost is the flush's own O(buffer) columns).
 IMPORT_BUFFER = 2_097_152
+
+
+@_dataclasses.dataclass(frozen=True)
+class WatchConfig:
+    """Tuning for ``updates`` / ``updates_since_revision`` subscriptions.
+
+    The defaults are the interactive-subscriber posture (mirroring the
+    class attributes they replace); a replica tailing a busy stream
+    (fleet/replica.py) raises both budgets — on a link that faults under
+    sustained load, eight consecutive no-progress resumes is routine
+    churn there, not a storm worth an incident bundle."""
+
+    #: consecutive no-progress resumes before the stream surfaces the
+    #: UnavailableError to its consumer
+    max_resumes: int = 64
+    #: consecutive no-progress resumes that fire the
+    #: ``watch.resume_storm`` incident (carrying the stream cursor)
+    storm_resumes: int = 8
+    #: store poll cadence while the stream is idle
+    poll_interval: float = 0.05
 
 
 class LookupPage(NamedTuple):
@@ -1495,8 +1516,11 @@ class Client:
     # ------------------------------------------------------------------
     # Watch (client/client.go:360-413)
     # ------------------------------------------------------------------
-    def updates(self, ctx: Context, f: UpdateFilter) -> Iterator[Update]:
-        return self.updates_since_revision(ctx, f, "")
+    def updates(
+        self, ctx: Context, f: UpdateFilter,
+        config: Optional["WatchConfig"] = None,
+    ) -> Iterator[Update]:
+        return self.updates_since_revision(ctx, f, "", config=config)
 
     #: consecutive no-progress stream faults tolerated before the watch
     #: surfaces the UnavailableError to its consumer — bounded so a
@@ -1509,7 +1533,8 @@ class Client:
     WATCH_STORM_RESUMES = 8
 
     def updates_since_revision(
-        self, ctx: Context, f: UpdateFilter, revision: str
+        self, ctx: Context, f: UpdateFilter, revision: str,
+        *, config: Optional["WatchConfig"] = None,
     ) -> Iterator[Update]:
         """Subscribe to ordered, filtered, resumable updates.  Cancel via
         the context, exactly like the reference's Watch loop
@@ -1523,8 +1548,17 @@ class Client:
         partially-delivered revision), tracked pre-filter so filtered
         streams resume at the right raw position; redelivered prefixes
         are skipped, so no event is lost or duplicated across stream
-        breaks."""
+        breaks.
+
+        ``config`` tunes the resume budget (WatchConfig): an interactive
+        subscriber keeps the defaults; a replica tailing a busy stream
+        raises ``storm_resumes``/``max_resumes`` so routine churn on a
+        faulted link doesn't page."""
         self._check_overlap(ctx)
+        cfg = config if config is not None else WatchConfig(
+            max_resumes=self.WATCH_MAX_RESUMES,
+            storm_resumes=self.WATCH_STORM_RESUMES,
+        )
         if f.object_types and f.relationship_filters:
             raise ValueError(
                 "UpdateFilter.object_types and relationship_filters are mutually"
@@ -1555,7 +1589,7 @@ class Client:
                     skip_rev, to_skip, skipped = part_rev, part_n, 0
                     try:
                         for rev, u in self._store.updates_since(
-                            base, stop=stop, poll_interval=0.05,
+                            base, stop=stop, poll_interval=cfg.poll_interval,
                             cancelled=ctx.done,
                         ):
                             if ctx.done():
@@ -1587,18 +1621,23 @@ class Client:
                             cursor_offset=part_n,
                         )
                         no_progress += 1
-                        if no_progress == self.WATCH_STORM_RESUMES:
-                            # a resume is routine; EIGHT consecutive
-                            # no-progress resumes is a storm — freeze the
-                            # flight ring while the faulting stream's
-                            # spans are still in it (fires once per
-                            # storm: the counter resets on progress)
+                        if no_progress == cfg.storm_resumes:
+                            # a resume is routine; storm_resumes
+                            # consecutive no-progress resumes is a storm
+                            # — freeze the flight ring while the
+                            # faulting stream's spans are still in it
+                            # (fires once per storm: the counter resets
+                            # on progress).  The incident carries the
+                            # full cursor — (revision, raw offset) — so
+                            # the bundle pinpoints where the stream is
+                            # stuck
                             _trace.trigger_incident(
                                 "watch.resume_storm",
                                 no_progress=no_progress,
                                 cursor_rev=int(base),
+                                cursor_offset=part_n,
                             )
-                        if no_progress > self.WATCH_MAX_RESUMES:
+                        if no_progress > cfg.max_resumes:
                             raise
                         # brief context-aware pause, then re-subscribe
                         # from the (base, part_n) cursor

@@ -84,6 +84,36 @@ def snapshot(revision: str) -> Strategy:
     return Strategy(Requirement.SNAPSHOT, revision)
 
 
+def policy_for(strategy: Strategy) -> tuple:
+    """Map a strategy onto the fleet *placement* policy (SURVEY §L2b):
+    once revisions live on different replica processes, the consistency
+    strategy decides which replicas are eligible to serve the read.
+
+    Returns ``(mode, revision)`` where ``revision`` is the strategy's
+    revision token (or None) and ``mode`` is one of:
+
+    - ``"head"``     — FULL: only a replica at the authoritative head at
+                       dispatch time is fresh enough;
+    - ``"any"``      — MIN_LATENCY: any ring member serves (fastest);
+    - ``"at_least"`` — AT_LEAST: any replica whose resident head has
+                       reached ``revision`` (read-your-writes; zookies
+                       raise the floor the same way);
+    - ``"exact"``    — SNAPSHOT: the replica must hold exactly
+                       ``revision`` (forwarded unchanged — the store's
+                       own RevisionUnavailableError semantics apply).
+    """
+    req = strategy.requirement
+    if req == Requirement.FULL:
+        return "head", None
+    if req == Requirement.MIN_LATENCY:
+        return "any", None
+    if req == Requirement.AT_LEAST:
+        return "at_least", strategy.revision
+    if req == Requirement.SNAPSHOT:
+        return "exact", strategy.revision
+    raise ValueError(f"unknown consistency requirement {req}")
+
+
 # Go-parity aliases.
 Full = full
 MinLatency = min_latency

@@ -523,6 +523,20 @@ class VerdictCache:
         with self._lock:
             return list(self._revs)
 
+    def residency(self) -> Dict[str, Any]:
+        """The revision-shard residency report a fleet replica publishes
+        (fleet/replica.py health): which revisions hold warm verdicts
+        here, and the freshest of them — the router's resident-revision
+        placement reads the store's generations for correctness and this
+        for cache-affinity visibility."""
+        with self._lock:
+            revs = sorted(self._revs)
+        return {
+            "revisions": revs,
+            "freshest": revs[-1] if revs else None,
+            "entries": self._entries,
+        }
+
     def stats(self) -> Dict[str, Any]:
         """Cheap state dump (incident-bundle context, /perf, smokes)."""
         m = self._m

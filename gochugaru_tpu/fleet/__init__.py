@@ -1,0 +1,25 @@
+"""Fleet serving: replicated processes around the shared watch stream.
+
+One process is one failure domain.  This package splits the engine into
+an authoritative **router** (owns the store, mints revisions and
+zookies, serves the replication stream, routes checks over a
+consistent-hash ring with freshness overrides and failover) and N
+**replicas** (bootstrap a world export, tail the stream exactly-once,
+serve checks through a full local Client with verdict cache and
+admission control).  See fleet/router.py and fleet/replica.py for the
+protocol details, scripts/fleetd.py for the process entrypoints, and
+BENCHMARKS.md "Fleet serving" for topology and failover methodology.
+"""
+
+from .config import FleetConfig
+from .replica import Replica
+from .router import FleetRouter, HashRing
+from .zookie import InvalidZookieError
+
+__all__ = [
+    "FleetConfig",
+    "FleetRouter",
+    "HashRing",
+    "Replica",
+    "InvalidZookieError",
+]

@@ -446,6 +446,71 @@ class Store:
             wsp.set_attr("applied", len(applied))
             return RevisionToken(self._head_rev)
 
+    def apply_replicated(self, revision: int, updates: Sequence[Update]) -> str:
+        """Apply an already-committed upstream log entry at EXACTLY the
+        given revision — the replica tail path (fleet/replica.py).
+
+        The upstream store validated, sequenced, and precondition-checked
+        the transaction when it committed; a replica replays the *applied*
+        updates verbatim, so no validation or shadow-overlay pass re-runs
+        here.  CREATE and TOUCH both land as upserts (the upstream already
+        rejected conflicting CREATEs).  Entries at or below the local head
+        are skipped and the current head token returned — the idempotence
+        that makes watch-stream redelivery after a resume exactly-once:
+        the tail re-subscribes from its local head and any replayed prefix
+        is a no-op."""
+        with self._lock:
+            if revision <= self._head_rev:
+                return RevisionToken(self._head_rev)
+            self._require_schema()
+            applied: List[Update] = []
+            for u in updates:
+                key = u.relationship.key()
+                if u.update_type in (UpdateType.CREATE, UpdateType.TOUCH):
+                    hit = self._base_find(u.relationship)
+                    if hit is not None:
+                        hit[0].live[hit[1]] = False
+                    self._live[key] = u.relationship
+                    self._intern(u.relationship)
+                    applied.append(u)
+                else:  # DELETE
+                    if key in self._live:
+                        del self._live[key]
+                        applied.append(u)
+                    else:
+                        hit = self._base_find(u.relationship)
+                        if hit is not None:
+                            hit[0].live[hit[1]] = False
+                            applied.append(u)
+            # land at the UPSTREAM revision, not head+1: replicas share the
+            # authority's revision numbering so zookies minted on write
+            # resolve to the same world on every replica
+            self._head_rev = int(revision)
+            self._log.append(_LogEntry(self._head_rev, applied))
+            self._new_data.notify_all()
+            return RevisionToken(self._head_rev)
+
+    def align_replica_head(self, revision: int) -> None:
+        """Fast-forward the head revision counter to the upstream revision
+        a bootstrap export materialized at (fleet/replica.py).  The
+        schema write and bulk import minted small local revisions; after
+        alignment, streamed entries land at upstream numbers and zookies
+        minted upstream resolve locally.  Rewinding is refused — a replica
+        never travels back below state it already holds."""
+        with self._lock:
+            if revision < self._head_rev:
+                raise ValueError(
+                    f"cannot rewind head from {self._head_rev} to {revision}"
+                )
+            self._head_rev = int(revision)
+
+    def resident_revisions(self) -> List[int]:
+        """Sorted materialized snapshot generations — the store half of a
+        replica's residency report (the verdict cache's revision shards
+        are the other half)."""
+        with self._lock:
+            return sorted(self._snapshots)
+
     def _validate_caveat_context(self, r: Relationship) -> None:
         if not r.caveat_name or not r.caveat_context:
             return
@@ -1207,6 +1272,58 @@ class Store:
                     if stop is not None and stop.is_set():
                         return
                     yield entry.revision, u
+                next_rev = entry.revision
+
+    def entries_since(
+        self, since_rev: int, *, stop: Optional[threading.Event] = None,
+        poll_interval: float = 0.1,
+        cancelled: Optional[Callable[[], bool]] = None,
+        heartbeats: bool = False,
+    ) -> Iterator[Tuple[int, Optional[List[Update]]]]:
+        """Yield whole log entries ``(revision, updates)`` in order,
+        blocking for new writes — the replication feed (fleet/router.py
+        streams these to tailing replicas, which apply each entry
+        atomically at its upstream revision via ``apply_replicated``).
+
+        With ``heartbeats=True`` an idle poll yields ``(head_rev, None)``
+        so a quiescent tail still learns the upstream head — that is what
+        a replica's catchup-lag gauge and readiness gate are computed
+        from.  Ends when ``stop`` is set or ``cancelled()`` returns
+        True."""
+        import bisect
+
+        next_rev = since_rev
+        while True:
+            batch: List[_LogEntry] = []
+            head = 0
+            with self._lock:
+                i = bisect.bisect_right(
+                    self._log, next_rev, key=lambda e: e.revision
+                )
+                batch = self._log[i:]
+                head = self._head_rev
+                if not batch:
+                    if (stop is None or not stop.is_set()) and (
+                        cancelled is None or not cancelled()
+                    ):
+                        self._new_data.wait(timeout=poll_interval)
+                        i = bisect.bisect_right(
+                            self._log, next_rev, key=lambda e: e.revision
+                        )
+                        batch = self._log[i:]
+                        head = self._head_rev
+            if stop is not None and stop.is_set():
+                return
+            if cancelled is not None and cancelled():
+                return
+            if not batch:
+                if heartbeats:
+                    yield head, None
+                continue
+            for entry in batch:
+                if stop is not None and stop.is_set():
+                    return
+                yield entry.revision, list(entry.updates)
                 next_rev = entry.revision
 
     # -- introspection -----------------------------------------------------

@@ -346,6 +346,16 @@ class AdmissionController:
         #: consumers, no duplicated EWMA
         self.cost = CostModel(self.config.deadline_floor_s)
 
+    def report(self) -> dict:
+        """Backpressure snapshot: what a fleet replica publishes in its
+        health payload (fleet/replica.py) so the router can see each
+        member's admission state alongside its freshness."""
+        return {
+            "inflight": self.gate.inflight,
+            "max_inflight": self.config.max_inflight,
+            "breaker": self.breaker.state,
+        }
+
     # -- deadline budget -------------------------------------------------
     def expected_cost_s(self, tier: Optional[int] = None) -> float:
         return self.cost.expected_s(tier)

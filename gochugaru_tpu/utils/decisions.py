@@ -278,6 +278,23 @@ def enabled() -> bool:
     return _LOG is not None
 
 
+#: Process identity stamped on every entry (fleet serving: a replica
+#: process sets its replica id at startup, so merged decision streams
+#: attribute each verdict to the process that served it).  None (the
+#: single-process default) adds nothing to entries.
+_IDENTITY: Optional[str] = None
+
+
+def set_identity(identity: Optional[str]) -> None:
+    """Set (None clears) the ``replica`` label on subsequent entries."""
+    global _IDENTITY
+    _IDENTITY = identity
+
+
+def identity() -> Optional[str]:
+    return _IDENTITY
+
+
 def _entry(
     resource: str, permission: str, subject: str, allowed: bool, *,
     revision, strategy: str, cache_hit: bool, dedup_parked: bool,
@@ -303,6 +320,8 @@ def _entry(
         e["trace_id"] = trace_id
     if client_id is not None:
         e["client"] = str(client_id)
+    if _IDENTITY is not None:
+        e["replica"] = _IDENTITY
     return e
 
 

@@ -106,17 +106,30 @@ class OverlapKeyMissingError(RuntimeError):
 #: backend/transfer hiccups retry; everything else is a real bug.
 TRANSIENT_DISPATCH_MARKERS = ("RESOURCE_EXHAUSTED", "UNAVAILABLE", "ABORTED")
 
+#: Cross-process transport failures (fleet serving, fleet/wire.py) — the
+#: OS-level analogues of gRPC Unavailable.  A replica dying shows up on
+#: the router's socket as one of these (ConnectionResetError and
+#: BrokenPipeError are ConnectionError subclasses; ``socket.timeout`` is
+#: an alias of TimeoutError since 3.10), and the retry envelope must
+#: engage — reroute/backoff — instead of surfacing a raw OSError.
+TRANSPORT_ERRORS = (ConnectionError, TimeoutError, EOFError)
+
 
 def classify_dispatch_exception(err: BaseException):
-    """Map a raw engine/JAX dispatch failure onto the retry taxonomy.
+    """Map a raw engine/JAX dispatch failure — or a cross-process
+    transport failure — onto the retry taxonomy.
 
     Returns an ``UnavailableError`` (with ``err`` as cause) when the
-    failure carries a transient marker, ``err`` itself when it is
-    already a classified ``AuthzError``, and None when it is neither —
-    the caller re-raises unclassifiable errors unchanged so genuine bugs
-    keep their tracebacks."""
+    failure is a transport error or carries a transient marker, ``err``
+    itself when it is already a classified ``AuthzError``, and None when
+    it is neither — the caller re-raises unclassifiable errors unchanged
+    so genuine bugs keep their tracebacks."""
     if isinstance(err, AuthzError):
         return err
+    if isinstance(err, TRANSPORT_ERRORS):
+        e = UnavailableError(f"{type(err).__name__}: {err}")
+        e.__cause__ = err
+        return e
     msg = str(err)
     if any(m in msg for m in TRANSIENT_DISPATCH_MARKERS):
         e = UnavailableError(msg)

@@ -68,9 +68,14 @@ _LOWER_BETTER_SUFFIXES = (
 #: (``mixed_users_rate`` is candidates/sec over bench8's 48 small-reach
 #: users — the dispatch-floor workload the fused SpMM path exists for;
 #: its trailing "_rate" must never read as anything but higher-better)
+#: (``fleet_goodput_scaling`` is the N-replica/1-replica goodput ratio
+#: from bench10 — more replicas helping more is the win, and its value
+#: is an "x" multiplier, not a latency; ``failover_p99_ms`` stays
+#: lower-better via the ``_ms`` suffix and is listed in
+#: ``_PROMOTED_FIELDS`` so rows carrying it as a column also guard it)
 _HIGHER_BETTER_SUFFIXES = (
     "achieved_gbps", "roofline_frac", "hit_rate", "dedup_frac",
-    "cache_speedup", "mixed_users_rate",
+    "cache_speedup", "mixed_users_rate", "fleet_goodput_scaling",
 )
 #: extra fields of a metric line promoted to their own comparison rows
 #: (the perf-attribution columns ride headline rows as extra fields —
@@ -81,7 +86,7 @@ _HIGHER_BETTER_SUFFIXES = (
 _PROMOTED_FIELDS = (
     "true_rate", "p99_ms", "achieved_gbps", "roofline_frac", "pad_fraction",
     "cache_hit_rate", "explain_overhead_frac", "decisions_dropped",
-    "mixed_users_rate", "dispatches_per_lookup",
+    "mixed_users_rate", "dispatches_per_lookup", "failover_p99_ms",
 )
 #: boolean/one-shot rows that carry no trajectory signal
 _SKIP_UNITS = {"ok", "capture", "keys"}

@@ -1,0 +1,158 @@
+#!/usr/bin/env bash
+# Fleet smoke: 3 replica PROCESSES self-joining a router via the wire
+# protocol, zookie read-your-writes through the router, host-oracle
+# parity at full consistency, and a seeded SIGKILL of one replica with
+# zero lost/duplicated/stale answers (ring eviction + fleet.failover
+# incident + kill detection asserted).  Prints FLEET-SMOKE-OK on
+# success — the CI-runnable proof the replicated deployment serves
+# correctly and survives a replica crash, mirroring
+# scripts/serve_smoke.sh / chaos_smoke.sh.
+#
+# Usage:
+#   scripts/fleet_smoke.sh                  # 3 replicas, 30 kill-window checks
+#   FLEET_SMOKE_REPLICAS=5 scripts/fleet_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+: "${FLEET_SMOKE_REPLICAS:=3}"
+: "${FLEET_SMOKE_CHECKS:=30}"
+: "${FLEET_SMOKE_TIMEOUT_S:=420}"
+
+export FLEET_SMOKE_REPLICAS FLEET_SMOKE_CHECKS
+
+timeout -k 10 "${FLEET_SMOKE_TIMEOUT_S}" env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+from dataclasses import replace
+
+from gochugaru_tpu import consistency, rel
+from gochugaru_tpu.client import (
+    new_tpu_evaluator, with_host_only_evaluation, with_store,
+)
+from gochugaru_tpu.fleet import FleetConfig, FleetRouter
+from gochugaru_tpu.utils import metrics, trace
+from gochugaru_tpu.utils.context import background
+
+N = int(os.environ.get("FLEET_SMOKE_REPLICAS", "3"))
+CHECKS = int(os.environ.get("FLEET_SMOKE_CHECKS", "30"))
+m = metrics.default
+rng = random.Random(20260806)
+incident_dir = tempfile.mkdtemp(prefix="fleet-smoke-")
+rec = trace.install_recorder(trace.FlightRecorder(
+    incident_dir=incident_dir, grace_s=0.0, cooldown_s=0.0,
+))
+
+cfg = replace(FleetConfig(), probe_interval_s=0.1, heartbeat_s=0.1)
+router = FleetRouter(config=cfg)
+ctx = background()
+router.write_schema(ctx, """
+definition user {}
+definition doc {
+    relation owner: user
+    relation reader: user
+    permission read = reader + owner
+}
+""")
+txn = rel.Txn()
+for i in range(60):
+    txn.touch(rel.must_from_triple(f"doc:d{i}", "owner", f"user:u{i % 10}"))
+    txn.touch(rel.must_from_triple(f"doc:d{i}", "reader", f"user:v{i % 7}"))
+router.write(ctx, txn)
+oracle = new_tpu_evaluator(with_store(router.store),
+                           with_host_only_evaluation())
+
+# -- phase 1: replica processes self-join via the wire 'join' op --------
+procs = []
+for i in range(N):
+    procs.append(subprocess.Popen(
+        [sys.executable, "-m", "gochugaru_tpu.fleet.replica",
+         "--upstream", f"127.0.0.1:{router.port}",
+         "--id", f"s{i}", "--host-only", "--join"],
+        stdout=subprocess.DEVNULL,
+        stderr=open(os.path.join(incident_dir, f"s{i}.stderr"), "w"),
+    ))
+deadline = time.monotonic() + 180.0
+while time.monotonic() < deadline:
+    if len(router.status()["ring"]) == N:
+        break
+    time.sleep(0.1)
+ring = router.status()["ring"]
+assert len(ring) == N, f"only {ring} joined"
+print(f"# {N} replica processes bootstrapped, caught up, and self-joined:"
+      f" ring={ring}")
+
+# -- phase 2: write -> zookie -> read-your-writes -----------------------
+for k in range(5):
+    txn = rel.Txn()
+    txn.touch(rel.must_from_triple(f"doc:fresh{k}", "reader", "user:me"))
+    zk = router.write(ctx, txn)
+    got = router.check(
+        background().with_timeout(30.0), consistency.min_latency(),
+        rel.must_from_triple(f"doc:fresh{k}", "read", "user:me"),
+        zookie=zk,
+    )
+    assert got == [True], (k, got)
+print("# zookie read-your-writes: 5/5 writes visible through the router"
+      " immediately (min_latency + zookie)")
+
+queries = [
+    rel.must_from_triple(f"doc:d{rng.randrange(60)}", "read",
+                         rng.choice([f"user:u{rng.randrange(10)}",
+                                     f"user:v{rng.randrange(7)}",
+                                     "user:nobody"]))
+    for _ in range(40)
+]
+want = oracle.check(ctx, consistency.full(), *queries)
+got = router.check(background().with_timeout(30.0),
+                   consistency.full(), *queries)
+assert got == want, "parity mismatch before kill"
+
+# -- phase 3: seeded SIGKILL, zero lost/dup/stale -----------------------
+kills0 = m.counter("fleet.kill_detections")
+victim = procs[1]
+victim.send_signal(signal.SIGKILL)
+answered = 0
+for k in range(CHECKS):
+    got = router.check(background().with_timeout(30.0),
+                       consistency.full(), *queries)
+    assert got == want, f"stale/wrong answer at kill-window check {k}"
+    answered += 1
+assert answered == CHECKS  # zero lost; dup impossible (one reply/request)
+deadline = time.monotonic() + 30.0
+while time.monotonic() < deadline:
+    if ("s1" not in router.status()["ring"]
+            and m.counter("fleet.kill_detections") > kills0):
+        break
+    time.sleep(0.05)
+assert "s1" not in router.status()["ring"], "victim never evicted"
+assert m.counter("fleet.kill_detections") > kills0, "kill never detected"
+rec.flush()
+assert any(e["trigger"] == "fleet.failover" for e in rec.incident_index()), \
+    "no fleet.failover incident bundle"
+print(f"# kill survival: SIGKILL mid-traffic, {answered}/{CHECKS} answers"
+      f" correct (zero lost/dup/stale), eviction + fleet.failover incident")
+
+router.close()
+for p in procs:
+    if p.poll() is None:
+        p.kill()
+    p.wait(timeout=10.0)
+print(json.dumps({
+    "metric": "fleet_smoke", "value": 1, "unit": "ok", "vs_baseline": 1.0,
+    "replicas": N, "kill_window_checks": CHECKS,
+    "reroutes": int(m.counter("fleet.reroutes")),
+    "evictions": int(m.counter("fleet.evictions")),
+    "note": "self-joined replica processes, zookie RYW, SIGKILL survival",
+}))
+print(f"FLEET-SMOKE-OK replicas={N} checks={CHECKS} "
+      f"evictions={int(m.counter('fleet.evictions'))}")
+EOF
+rc=$?
+exit "$rc"

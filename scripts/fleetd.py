@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Fleet daemon: run one fleet process — the router (authority store +
+consistent-hash placement + zookie minting) or a replica (bootstraps
+from the router, tails the replication stream, serves checks).
+
+A minimal local fleet, three terminals:
+
+  # 1. the router (authority); prints ROUTER-READY with its port
+  python scripts/fleetd.py router --port 7411 --demo-world
+
+  # 2..n. replicas; each bootstraps, catches up, and serves
+  python scripts/fleetd.py replica --upstream 127.0.0.1:7411 --id r0
+  python scripts/fleetd.py replica --upstream 127.0.0.1:7411 --id r1
+
+Replicas self-announce to the router?  No — membership is the
+operator's (or supervisor's) call: POST a ``health`` probe yourself or
+use ``--join`` below, which asks the router to admit the replica once
+it reports ready.  ``scripts/fleet_smoke.sh`` and
+``benchmarks/bench10_fleet.py`` drive exactly this wiring.
+
+Router options: ``--demo-world`` writes a tiny schema + relationships
+so zookie round trips work out of the box; ``--incident-dir`` installs
+a flight recorder so ``fleet.failover`` incidents land as bundles.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_router(args) -> int:
+    from gochugaru_tpu.fleet import FleetRouter
+    from gochugaru_tpu.utils import trace
+    from gochugaru_tpu.utils.context import background
+
+    if args.incident_dir:
+        trace.install_recorder(
+            trace.FlightRecorder(incident_dir=args.incident_dir)
+        )
+    router = FleetRouter(host=args.host, port=args.port)
+    if args.demo_world:
+        ctx = background()
+        router.write_schema(ctx, """
+        definition user {}
+        definition doc {
+            relation owner: user
+            relation reader: user
+            permission read = reader + owner
+        }
+        """)
+        from gochugaru_tpu import rel
+
+        txn = rel.Txn()
+        for i in range(32):
+            txn.touch(rel.must_from_triple(
+                f"doc:d{i}", "owner", f"user:u{i % 8}"
+            ))
+        router.write(ctx, txn)
+    print(f"ROUTER-READY host={router.host} port={router.port}"
+          f" head={router.head_revision}", flush=True)
+    try:
+        while True:
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        router.close()
+    return 0
+
+
+def run_replica(args) -> int:
+    # the replica module's own CLI does the work (REPLICA-READY line,
+    # exit-on-death crash semantics); --join additionally sends the
+    # router a ``join`` op so this replica enters the ring without an
+    # operator calling add_replica by hand
+    from gochugaru_tpu.fleet import replica as replica_mod
+
+    argv = ["--upstream", args.upstream, "--host", args.host,
+            "--port", str(args.port)]
+    if args.id:
+        argv += ["--id", args.id]
+    if args.host_only:
+        argv.append("--host-only")
+    if args.latency_mode:
+        argv.append("--latency-mode")
+    if args.join:
+        argv.append("--join")
+    return replica_mod.main(argv)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description="gochugaru fleet daemon")
+    sub = ap.add_subparsers(dest="role", required=True)
+
+    rt = sub.add_parser("router", help="authority store + placement")
+    rt.add_argument("--host", default="127.0.0.1")
+    rt.add_argument("--port", type=int, default=0)
+    rt.add_argument("--demo-world", action="store_true",
+                    help="write a small schema+world so checks work"
+                         " out of the box")
+    rt.add_argument("--incident-dir",
+                    default=os.environ.get("GOCHUGARU_INCIDENT_DIR") or None)
+
+    rp = sub.add_parser("replica", help="bootstrapped serving replica")
+    rp.add_argument("--upstream", required=True, help="router HOST:PORT")
+    rp.add_argument("--host", default="127.0.0.1")
+    rp.add_argument("--port", type=int, default=0)
+    rp.add_argument("--id", default=None)
+    rp.add_argument("--host-only", action="store_true")
+    rp.add_argument("--latency-mode", action="store_true")
+    rp.add_argument("--join", action="store_true",
+                    help="probe the router once serving starts")
+
+    args = ap.parse_args()
+    if args.role == "router":
+        return run_router(args)
+    return run_replica(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -247,3 +247,184 @@ def test_chaos_soak():
     assert m.counter("retry.retries") > 0
     # sheds are allowed but must be the exception, not the rule
     assert sheds <= ROUNDS // 3, f"{sheds}/{ROUNDS} rounds shed"
+
+
+FLEET_ROUNDS = int(os.environ.get("GOCHUGARU_CHAOS_FLEET_ROUNDS", "10"))
+
+#: the four fleet fault sites, armed for the whole soak at seeded
+#: probabilities.  replica.kill is the interesting one: it turns ANY
+#: served op (including health probes) into a crash, so the soak's
+#: supervisor loop is constantly re-bootstrapping replicas.
+FLEET_SITES = (
+    ("router.dispatch", 0.15),
+    ("router.health", 0.05),
+    ("replica.apply", 0.20),
+    ("replica.kill", 0.01),
+)
+
+
+def test_fleet_chaos_soak():
+    """Fleet soak: router + 2 replicas under all four fleet fault sites,
+    with a deterministic mid-soak replica kill and supervised restarts.
+
+    Contract (the single-process soak's, one layer up):
+
+    - every returned verdict matches the host oracle at the router head;
+    - zookie read-your-writes holds every round, through faults;
+    - killed replicas are detected, evicted, and restarted replicas
+      catch up and rejoin — zero lost or duplicated answers;
+    - every surfaced failure is a classified ``AuthzError``; no hangs.
+    """
+    from dataclasses import replace as _replace
+
+    from gochugaru_tpu.client import with_verdict_cache
+    from gochugaru_tpu.fleet import FleetConfig, FleetRouter, Replica
+    from gochugaru_tpu.fleet import wire as fwire
+    from gochugaru_tpu.fleet import zookie
+
+    rng = random.Random(SEED ^ 0xF1EE7)
+    m = _metrics.default
+    faults.reset()  # the single-process soak leaves watch.stream armed
+
+    cfg = _replace(
+        FleetConfig(),
+        probe_interval_s=0.05,
+        probe_timeout_s=0.5,
+        freshness_wait_s=3.0,
+        freshness_poll_s=0.02,
+        heartbeat_s=0.05,
+    )
+    router = FleetRouter(config=cfg)
+    _fixed_world(router)
+    oracle = new_tpu_evaluator(
+        with_store(router.store), with_host_only_evaluation()
+    )
+
+    def spawn(rid):
+        return Replica(
+            ("127.0.0.1", router.port),
+            replica_id=rid,
+            config=cfg,
+            client_options=(with_verdict_cache(), with_host_only_evaluation()),
+        )
+
+    reps = {}
+    for i in range(2):
+        r = spawn(f"f{i}")
+        reps[i] = r
+        router.add_replica(r.host, r.port, wait_ready_s=10.0)
+
+    users = [f"user:fu{i}" for i in range(5)]
+    mismatches = []
+    unclassified = []
+    sheds = 0
+    restarts = 0
+    injected_before = m.counter("faults.injected")
+    deaths_before = m.counter("fleet.replica_deaths")
+
+    try:
+        import zlib
+
+        for site, p in FLEET_SITES:
+            faults.arm(
+                site, probability=p, seed=SEED ^ zlib.crc32(site.encode())
+            )
+
+        for rnd in range(FLEET_ROUNDS):
+            # ---- write through the authority, mint a zookie ------------
+            txn = rel.Txn()
+            fresh = rel.must_from_triple(
+                f"doc:fr{rnd}", "reader", rng.choice(users)
+            )
+            txn.touch(fresh)
+            zk = router.write(background(), txn)
+
+            # ---- deterministic mid-soak crash --------------------------
+            if rnd == FLEET_ROUNDS // 2:
+                victim = reps[0]
+                conn = fwire.Conn((victim.host, victim.port))
+                try:
+                    with pytest.raises(ConnectionError):
+                        conn.request({"op": "kill"})
+                finally:
+                    conn.close()
+
+            # ---- checks under faults: zookie RYW + full parity ---------
+            queries = [
+                rel.must_from_triple(
+                    rng.choice(
+                        [f"doc:base{rng.randrange(8)}", f"doc:fr{rnd}"]
+                    ),
+                    "read",
+                    rng.choice(users + ["user:own0", "user:rd1", "user:tm1"]),
+                )
+                for _ in range(rng.randint(2, 5))
+            ]
+            ryw = rel.must_from_triple(
+                fresh.resource_type + ":" + fresh.resource_id,
+                "read",
+                fresh.subject_type + ":" + fresh.subject_id,
+            )
+            ctx = background().with_timeout(15.0)
+            try:
+                got_ryw = router.check(
+                    ctx, consistency.min_latency(), ryw, zookie=zk
+                )
+                if got_ryw != [True]:
+                    mismatches.append((rnd, "zookie-ryw", got_ryw))
+                got = router.check(ctx, consistency.full(), *queries)
+                want = oracle.check(
+                    background(), consistency.full(), *queries
+                )
+                if got != want:
+                    mismatches.append((rnd, got, want))
+            except (UnavailableError, DeadlineExceededError):
+                sheds += 1
+            except BaseException as e:
+                if not isinstance(e, AuthzError):
+                    unclassified.append((rnd, repr(e)))
+
+            # ---- supervisor: restart anything the kill site took -------
+            for i, r in list(reps.items()):
+                if r._dead:
+                    r.close()
+                    nr = spawn(f"f{i}g{rnd}")
+                    try:
+                        router.add_replica(nr.host, nr.port, wait_ready_s=10.0)
+                        reps[i] = nr
+                        restarts += 1
+                    except AuthzError:
+                        nr.close()  # killed during admission; next round
+    finally:
+        for site, _ in FLEET_SITES:
+            faults.disarm(site)
+
+    # with faults quiet, a surviving fleet must converge and agree
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and not router.status()["ring"]:
+        time.sleep(0.05)
+    final_q = [
+        rel.must_from_triple(f"doc:fr{r}", "read", "user:fu0")
+        for r in range(FLEET_ROUNDS)
+    ]
+    got = router.check(
+        background().with_timeout(20.0), consistency.full(), *final_q
+    )
+    want = oracle.check(background(), consistency.full(), *final_q)
+
+    try:
+        assert not unclassified, f"unclassified exceptions: {unclassified}"
+        assert not mismatches, f"oracle mismatches: {mismatches[:3]}"
+        assert got == want
+        assert m.counter("faults.injected") > injected_before
+        # the deterministic kill was detected and survived
+        assert m.counter("fleet.replica_deaths") > deaths_before
+        assert restarts >= 1
+        assert router.status()["ring"], "fleet never recovered"
+        assert sheds <= max(1, FLEET_ROUNDS // 3), (
+            f"{sheds}/{FLEET_ROUNDS} rounds shed"
+        )
+    finally:
+        router.close()
+        for r in reps.values():
+            r.close()
