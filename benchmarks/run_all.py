@@ -242,6 +242,19 @@ def main() -> int:
         ["bash", "scripts/fleet_smoke.sh"],
         600,
     ))
+    configs.append((
+        "22 — self-tuning A/B: tuned config vs presets on a mixed"
+        " workload, predicted-vs-measured deltas, non-pow2 tier parity"
+        + (" (quick)" if q else ""),
+        [py, "benchmarks/bench11_tune.py"] + (["--quick"] if q else []),
+        1800,
+    ))
+    configs.append((
+        "23 — tune smoke (offline diff fixed point, online controller"
+        " bounded moves + revert)",
+        ["bash", "scripts/tune_smoke.sh"],
+        600,
+    ))
     if not q:
         # Leopard-scale CPU proxy (VERDICT r04 item 3): the same Watch
         # re-index loop at a 100M-edge base — BASELINE config 5's

@@ -355,6 +355,29 @@ class FlatMeta:
     pf_has_u: bool = False
 
 
+def placement_split(dsnap) -> Dict[str, int]:
+    """{"total", "sharded", "replicated"} resident device-table bytes:
+    of this snapshot's arrays, how many a routed partitioned serve
+    (``FlatMeta.part_serve``) would SPLIT along the model axis — the
+    primary/fold-point tables (ehx*, pfx*) and their width-stratum
+    views — versus replicate whole on every device.  The placement
+    advisor (tune/) reads this to decide whether routing buys enough
+    per-device HBM to be worth the mesh: a snapshot whose bytes are
+    dominated by membership-sized replicated tables gains nothing from
+    partitioning."""
+    total = 0
+    sharded = 0
+    for k, a in dsnap.arrays.items():
+        nb = int(getattr(a, "nbytes", 0))
+        total += nb
+        if k.startswith("ehx") or k.startswith("pfx"):
+            sharded += nb
+    return {
+        "total": total, "sharded": sharded,
+        "replicated": total - sharded,
+    }
+
+
 def _gate_cols(hascav: bool, hasexp: bool) -> list:
     return (["cav", "ctx"] if hascav else []) + (["exp"] if hasexp else [])
 

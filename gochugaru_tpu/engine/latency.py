@@ -18,10 +18,14 @@ This path removes every per-dispatch variable cost it can:
   structurally cannot retrace, so ``compile_count`` is an assertable
   invariant (tests/test_latency_path.py), not a hope.  Pins are shared
   engine-wide across delta revisions whose table shapes are unchanged.
-- **batch tiers**: batches pad to a SMALL fixed ladder of pow2 tiers
+- **batch tiers**: batches pad to a SMALL fixed ladder of tiers
   (EngineConfig.latency_tiers, default 256/1024/4096) instead of the
   batch's own pow2 — a workload whose batch size jitters between 900
-  and 1100 stays on ONE pinned kernel.
+  and 1100 stays on ONE pinned kernel.  The ladder is any sorted list
+  of sizes, pow2 or not: the offline tuner (gochugaru_tpu/tune) fits
+  tiers to the measured occupancy histogram, and pins are keyed by the
+  tier value so a tuned (192, 576, 4096) ladder keeps the zero-retrace
+  invariant.
 - **preallocated staging**: one host-side query-matrix buffer per tier,
   refilled in place (engine/flat.py fill_qm) — steady-state dispatch
   allocates no host arrays; the context-free qctx device singleton is

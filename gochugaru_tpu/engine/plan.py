@@ -219,8 +219,12 @@ class EngineConfig:
     #: small-batch padding tiers: a latency-mode batch pads to the
     #: smallest tier ≥ B and runs a pinned AOT-compiled kernel for that
     #: tier — a handful of tiers bounds the pinned-executable count
-    #: while keeping pad waste ≤ 4×; batches beyond the top tier use
-    #: the throughput path
+    #: while keeping pad waste bounded; batches beyond the top tier use
+    #: the throughput path.  Any sorted tuple of positive ints works —
+    #: tiers need NOT be powers of two; the offline tuner
+    #: (gochugaru_tpu/tune) emits workload-fit ladders like (192, 576,
+    #: 4096) and the no-retrace contract holds because pins are keyed
+    #: by the tier value itself, not its log2
     latency_tiers: Tuple[int, ...] = (256, 1024, 4096)
     #: donate the query-matrix device buffer to the pinned executable
     #: (XLA aliases it for outputs — zero per-dispatch device

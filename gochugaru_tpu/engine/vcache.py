@@ -499,6 +499,18 @@ class VerdictCache:
         return sh["r"].get(key)
 
     # -- lifecycle / introspection ---------------------------------------
+    def set_max_bytes(self, max_bytes: int) -> None:
+        """Resize the byte budget at runtime — the online tuner's cache
+        knob (tune/controller.py).  Shrinking evicts immediately under
+        the lock (LRU revision first, same path as insert pressure);
+        growing just raises the ceiling and later inserts fill it.
+        Concurrent readers are untouched either way — eviction drops
+        whole shard objects, never mutates one."""
+        with self._lock:
+            self.max_bytes = int(max_bytes)
+            self._evict_locked()
+            self._publish_locked()
+
     def drop_revision(self, revision: int) -> None:
         """Structural invalidation hook: when the client's dsnap LRU
         evicts a prepared revision, the matching verdict shard drops

@@ -175,6 +175,7 @@ class Replica:
                             )
                             resumes = 0
                             self._m.inc("fleet.applied_entries")
+                            self._advance_serving()
                     self._m.set_gauge(
                         f"fleet.catchup_lag.{self.id}", float(self.lag())
                     )
@@ -193,6 +194,33 @@ class Replica:
                 if conn is not None:
                     conn.close()
             self._stop.wait(min(0.002 * resumes, 0.1))
+
+    def _advance_serving(self) -> None:
+        """Make the just-applied head the generation MIN_LATENCY serves,
+        and retire verdict-cache shards for generations the store no
+        longer keeps.
+
+        ``apply_replicated`` advances the live table and the head
+        revision but materializes nothing, and ``snapshot_for`` under
+        MinLatency serves the freshest MATERIALIZED generation — so
+        without this step a replica keeps answering from its
+        bootstrap-era world (and that world's cached verdicts) no matter
+        how many deltas it applies.  Materializing here is the
+        watch-driven re-index discipline: a delta advance off the
+        previous generation, not a rebuild.  The shard drop mirrors the
+        client's snapshot-LRU eviction hook — a verdict-cache revision
+        whose store generation is gone can never be pin-validated again,
+        it is pure dead weight — and counts each retirement as
+        ``fleet.vcache_invalidations``."""
+        self._store.snapshot_for(consistency.full())
+        vc = self._client._vcache
+        if vc is None:
+            return
+        resident = set(self._store.resident_revisions())
+        for rev in vc.resident_revisions:
+            if rev not in resident:
+                vc.drop_revision(rev)
+                self._m.inc("fleet.vcache_invalidations")
 
     # -- state ------------------------------------------------------------
     @property

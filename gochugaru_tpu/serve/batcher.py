@@ -287,13 +287,13 @@ class MicroBatcher:
         self._dispatch_rels = dispatch_rels
         self._dispatch_cols = dispatch_cols
         self._m = registry or _metrics.default
-        #: cross-batch singleflight window (engine/vcache.py) — only
-        #: when dedup is on AND the pinned strategy tolerates serving a
-        #: duplicate from its in-flight twin (everything but Full)
-        self._sf = (
-            _vcache.Singleflight(self._m)
-            if (self.config.dedup and inflight_dedup) else None
-        )
+        #: cross-batch singleflight window (engine/vcache.py) — built
+        #: whenever the pinned strategy tolerates serving a duplicate
+        #: from its in-flight twin (everything but Full); whether it is
+        #: USED is read from ``self.config.dedup`` at each submit/
+        #: dispatch, so the online tuner can toggle dedup by swapping
+        #: the config without rebuilding the batcher
+        self._sf = _vcache.Singleflight(self._m) if inflight_dedup else None
         #: occupancy histogram buckets: the ladder itself plus half/
         #: quarter marks, so "flushed at 61 of 256" is visible
         self._fill_buckets = tuple(sorted(
@@ -301,6 +301,19 @@ class MicroBatcher:
             | {max(1, t // 2) for t in self.tiers}
             | {max(1, t // 4) for t in self.tiers}
         ))
+        #: per-tier occupancy buckets (``serve.occupancy.t{tier}``):
+        #: live-lane counts at fixed fractions of the tier, precomputed
+        #: here because a histogram's buckets freeze at first observe —
+        #: the tuner reads these to place a tighter (possibly non-pow2)
+        #: tier where the occupancy mass actually sits
+        self._occ_buckets = {
+            t: tuple(sorted({
+                max(1, round(t * f))
+                for f in (0.0625, 0.125, 0.1875, 0.25, 0.375, 0.5,
+                          0.625, 0.75, 0.875, 1.0)
+            }))
+            for t in self.tiers
+        }
         self._cond = threading.Condition()
         #: client_id → FIFO of _Submission (insertion-ordered dict: the
         #: round-robin rotation walks it)
@@ -376,7 +389,7 @@ class MicroBatcher:
             # the SAME cost model + counters as the caller-formed path
             if self._adm is not None:
                 self._adm.check_deadline(ctx, span=span)
-        sf = self._sf
+        sf = self._sf if self.config.dedup else None
         if sf is not None and sf.active:
             # cross-batch singleflight: a submission whose rows ALL
             # duplicate the currently-dispatching batch's checks parks
@@ -625,6 +638,12 @@ class MicroBatcher:
                     "serve.occupancy", total / tier,
                     (0.25, 0.5, 0.75, 0.9, 1.0),
                 )
+                # per-tier live-lane histogram — the tuner's primary
+                # input ("tier 1024 p90 occupancy 131" reads off this)
+                m.observe_hist(
+                    f"serve.occupancy.t{tier}", total,
+                    self._occ_buckets[tier],
+                )
         return _FormedBatch(picked, total, kind, target, reason, now, tier)
 
     # -- dispatch --------------------------------------------------------
@@ -656,7 +675,7 @@ class MicroBatcher:
             kind=batch.kind, submissions=len(batch.subs),
             occupancy=round(batch.total / batch.target, 4),
         )
-        sf = self._sf
+        sf = self._sf if self.config.dedup else None
         window_open = False
         verdicts = None
         try:
@@ -810,7 +829,13 @@ class MicroBatcher:
                     break
                 # hand off without blocking forever: if the dispatcher
                 # died, this thread — not close(), which can't reach an
-                # in-hand batch — must settle the batch's futures
+                # in-hand batch — must settle the batch's futures.  The
+                # handoff wait (former blocked behind a busy dispatcher)
+                # is attributed to the ``form`` wall bucket: it is a
+                # formation stall, and leaving it to the idle residual
+                # would make the tuner read dispatch backpressure as
+                # headroom
+                t_h0 = time.perf_counter()
                 while True:
                     try:
                         self._form_q.put(batch, timeout=0.25)
@@ -822,6 +847,7 @@ class MicroBatcher:
                                 "serve dispatcher thread died"
                             ))
                             break
+                _perf.report_wall("form", t_h0, time.perf_counter())
         except BaseException:  # never leave submitters hanging on a
             self._emergency_stop()  # dead former — close() rejects them
             raise
@@ -855,6 +881,20 @@ class MicroBatcher:
         threading.Thread(target=self.close, daemon=True).start()
 
     # -- lifecycle -------------------------------------------------------
+    def apply_config(self, config: ServeConfig) -> None:
+        """Swap the serve config atomically (the online tuner's apply
+        path).  ServeConfig is frozen and ``self.config`` is read fresh
+        at every decision point, so a single attribute store is the
+        whole transaction; the former is woken so a SHORTER hold-back
+        takes effect on the batch it is currently holding rather than
+        one hold later.  Dedup toggles the same way: the singleflight
+        window object persists, ``config.dedup`` gates its use."""
+        if config.batch_path_max < self._top:
+            raise ValueError("batch_path_max must cover the top tier")
+        self.config = config
+        with self._cond:
+            self._cond.notify_all()
+
     def close(self) -> None:
         """Drain: flush everything queued, stop both threads, reject
         any straggler futures (classified, so callers back off rather

@@ -1,0 +1,146 @@
+"""Telemetry snapshot: everything the tuner reads, in one JSON blob.
+
+A snapshot is a pure data capture — no proposals, no judgment — of the
+serving telemetry a measurement window produced, stamped with the
+config it was measured under.  Stamping the config into the snapshot is
+what makes the tuner's fixed-point property structural: ``propose`` is
+a pure function of the snapshot (plus constants), so applying its diff
+and re-proposing against the SAME snapshot can only converge.
+
+Sections (all JSON-serializable; absent sections simply disable the
+rules that read them):
+
+- ``config``   — the knob values the window ran under
+- ``occupancy``— per-tier live-lane histograms (``serve.occupancy.t*``)
+- ``flush``    — formed-batch flush-reason counts
+- ``serve``    — check/unique/shed/batch counters
+- ``queue_wait``— submit→form wait quantiles
+- ``cache``    — verdict-cache stats (engine/vcache.py ``stats()``)
+- ``pad``      — pinned-tier pad-waste ledger (utils/perf.py)
+- ``cost``     — per-tier expected dispatch cost (utils/admission.py)
+- ``bytes``    — gathered-bytes model + device-table placement split
+- ``wall``     — last closed wall-ledger window's bucket fractions
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..utils import metrics as _metrics
+from ..utils import perf as _perf
+
+#: snapshot format version (bumped on breaking shape changes)
+SNAPSHOT_VERSION = 1
+
+#: the flush reasons serve/batcher.py counts (drain excluded from rule
+#: denominators — it is lifecycle, not workload)
+FLUSH_REASONS = ("full", "maxhold", "deadline", "drain")
+
+
+def _occupancy_of(registry: _metrics.Metrics) -> Dict[str, Dict[str, Any]]:
+    """``serve.occupancy.t{tier}`` histograms → {tier: {buckets, counts,
+    count, sum}} — the per-tier live-lane distributions the ladder rule
+    reads (exemplars dropped: they are trace pointers, not data)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, (buckets, counts, count, total, _ex) in (
+        registry.hist_snapshot().items()
+    ):
+        if not name.startswith("serve.occupancy.t"):
+            continue
+        tier = name[len("serve.occupancy.t"):]
+        out[tier] = {
+            "buckets": [float(b) for b in buckets],
+            "counts": [int(c) for c in counts],
+            "count": int(count),
+            "sum": float(total),
+        }
+    return out
+
+
+def collect_snapshot(
+    registry: Optional[_metrics.Metrics] = None,
+    *,
+    engine_config=None,
+    serve_config=None,
+    vcache=None,
+    cost=None,
+    dsnap=None,
+    placement: str = "replicated",
+    packed_candidates: Optional[Dict[str, float]] = None,
+) -> Dict[str, Any]:
+    """Capture one tuner input from live telemetry.
+
+    ``engine_config``/``serve_config``/``vcache`` stamp the measured-
+    under config; any left None stamps that knob as unknown and the
+    rules needing it stay silent.  ``dsnap`` (a prepared
+    DeviceSnapshot) enables the bytes/placement section;
+    ``packed_candidates`` ({"packed": bytes/check, "unpacked": ...}
+    from an offline A/B prepare, scripts/tune.py) enables the pack-spec
+    rule — a live snapshot can only see the layout it runs, so the
+    counterfactual is collected offline or not at all."""
+    m = registry or _metrics.default
+    snap: Dict[str, Any] = {"version": SNAPSHOT_VERSION}
+
+    cfg: Dict[str, Any] = {"placement": placement}
+    if engine_config is not None:
+        cfg["latency_tiers"] = [int(t) for t in engine_config.latency_tiers]
+        cfg["flat_packed"] = engine_config.flat_packed
+        cfg["flat_packed_resolved"] = bool(engine_config.packed_on())
+    if serve_config is not None:
+        cfg["hold_max_s"] = float(serve_config.hold_max_s)
+        cfg["dedup"] = bool(serve_config.dedup)
+    if vcache is not None:
+        cfg["cache_max_bytes"] = int(vcache.max_bytes)
+    snap["config"] = cfg
+
+    snap["occupancy"] = _occupancy_of(m)
+    snap["flush"] = {
+        r: int(m.counter(f"serve.flush_{r}")) for r in FLUSH_REASONS
+    }
+    snap["serve"] = {
+        "checks": int(m.counter("serve.checks")),
+        "unique_checks": int(m.counter("serve.unique_checks")),
+        "submissions": int(m.counter("serve.submissions")),
+        "batches": int(m.counter("serve.batches")),
+        "sheds": int(m.counter("serve.sheds")),
+        "dedup_parked": int(m.counter("serve.dedup_parked")),
+    }
+    qw: Dict[str, Any] = {"count": m.timer_counts("serve.queue_wait_s")[0]}
+    for q, key in ((0.5, "p50_s"), (0.99, "p99_s")):
+        v = m.percentile("serve.queue_wait_s", q)
+        if v is not None:
+            qw[key] = round(float(v), 6)
+    snap["queue_wait"] = qw
+
+    if vcache is not None:
+        c = dict(vcache.stats())
+        c["evicted_revisions"] = int(m.counter("cache.evicted_revisions"))
+        snap["cache"] = c
+
+    snap["pad"] = _perf.pad_stats(m)
+    if cost is not None:
+        snap["cost"] = cost.state()
+
+    by: Dict[str, Any] = {}
+    model = _perf.last_model()
+    if dsnap is not None:
+        try:
+            model = _perf.gathered_bytes_model(dsnap)
+        except Exception:
+            pass
+        from ..engine.flat import placement_split
+
+        by.update(placement_split(dsnap))
+    if model is not None:
+        by["per_check"] = round(float(model.total), 2)
+    if packed_candidates:
+        by["candidates"] = {
+            k: round(float(v), 2) for k, v in packed_candidates.items()
+        }
+    if by:
+        snap["bytes"] = by
+
+    wall = _perf.last_wall()
+    if wall is not None:
+        snap["wall"] = dict(wall.get("fracs") or {})
+    return snap

@@ -60,9 +60,10 @@ class CostModel:
     number regardless of batch size.  A micro-batch former needs "what
     will a tier-1024 dispatch cost" to decide whether holding a request
     another 500 µs blows its deadline — so the model keeps one EWMA per
-    pow2 tier (seeded from the scalar estimate until the tier has its
-    own samples) on top of the overall scalar, and both consumers read
-    the SAME object: there is no second EWMA to drift.
+    ladder tier (keyed by the tier's integer size, so tuned non-pow2
+    ladders work unchanged; seeded from the scalar estimate until the
+    tier has its own samples) on top of the overall scalar, and both
+    consumers read the SAME object: there is no second EWMA to drift.
 
     ``decay()`` halves every estimate — the deadline shed's cold-start
     escape hatch (see ``AdmissionController.check_deadline``)."""

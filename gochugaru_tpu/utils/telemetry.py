@@ -22,7 +22,10 @@ Surface:
   ``application/openmetrics-text``) or a ``?openmetrics=1`` query.
 - ``render_traces(tracer)`` — the tracer ring as JSONL.
 - ``TelemetryServer`` — ``/metrics`` (exposition text), ``/traces``
-  (JSONL), ``/slo`` (burn-rate report, utils/slo.py), ``/perf`` (the
+  (JSONL), ``/slo`` (burn-rate report, utils/slo.py), ``/tune``
+  (self-tuning posture: live knob values, frozen knobs, ``tune.*``
+  trajectory counters when an OnlineController is attached), ``/perf``
+  (the
   performance-attribution ledger, utils/perf.py: cost_analysis
   entries, gathered-bytes model, pad waste, measured roofline,
   wall-time ledger — ``?compile=1``/``?bench=1`` opt into the
@@ -253,11 +256,13 @@ class TelemetryServer:
         tracer: Optional[_trace.Tracer] = None,
         slo=None,
         recorder: Optional[_trace.FlightRecorder] = None,
+        controller=None,
     ) -> None:
         self._registry = registry or _metrics.default
         self._tracer = tracer  # None → follow the global tracer live
         self._slo = slo
         self._recorder = recorder  # None → follow the global recorder live
+        self._controller = controller  # tune.OnlineController, optional
         self._t0 = time.monotonic()
         outer = self
 
@@ -390,6 +395,24 @@ class TelemetryServer:
                                 200, bundle,
                                 "application/x-ndjson; charset=utf-8",
                             )
+                    elif path == "/tune":
+                        # self-tuning posture: the controller's live
+                        # knob values + trajectory counters, read-only
+                        # (revert stays an in-process call on purpose —
+                        # a GET must never move a knob)
+                        ctl = outer._controller
+                        body = {
+                            "enabled": ctl is not None,
+                            "counters": outer._registry.counters_prefixed(
+                                "tune."
+                            ),
+                        }
+                        if ctl is not None:
+                            body["status"] = ctl.status()
+                        self._reply(
+                            200, json.dumps(body, default=repr),
+                            "application/json",
+                        )
                     elif path == "/healthz":
                         self._reply(
                             200,

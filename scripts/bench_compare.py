@@ -53,10 +53,14 @@ _LOWER_BETTER_UNITS = {"ms", "s", "seconds", "mb", "mib", "bytes", "gb"}
 #: sink failures — any growth is an audit-trail hole;
 #: ``dispatches_per_lookup``: device program launches per LookupResources
 #: drain from bench8 — the fused SpMM path's whole point is holding this
-#: at 1.0, so any growth is the K-hop fusion regressing to per-hop loops)
+#: at 1.0, so any growth is the K-hop fusion regressing to per-hop loops;
+#: ``pad_waste_frac``: bench11's padded-lane share under the tuned config
+#: — the tuner's tier ladder exists to shrink it, so growth means the
+#: ladder rules stopped fitting the workload)
 _LOWER_BETTER_SUFFIXES = (
     "_ms", "_s", "_latency", "_bytes", "_rss_mb", "pad_fraction",
     "explain_overhead_frac", "decisions_dropped", "dispatches_per_lookup",
+    "pad_waste_frac",
 )
 #: suffixes that are HIGHER-better regardless of unit — checked FIRST,
 #: so the perf columns can't be misread by a unit heuristic
@@ -73,9 +77,13 @@ _LOWER_BETTER_SUFFIXES = (
 #: is an "x" multiplier, not a latency; ``failover_p99_ms`` stays
 #: lower-better via the ``_ms`` suffix and is listed in
 #: ``_PROMOTED_FIELDS`` so rows carrying it as a column also guard it)
+#: (``tuned_vs_best_preset_goodput`` is bench11's geomean goodput ratio
+#: of the tuned config over the best preset per profile — an "x"
+#: multiplier like fleet scaling; below 1.0 the tuner stopped paying)
 _HIGHER_BETTER_SUFFIXES = (
     "achieved_gbps", "roofline_frac", "hit_rate", "dedup_frac",
     "cache_speedup", "mixed_users_rate", "fleet_goodput_scaling",
+    "tuned_vs_best_preset_goodput",
 )
 #: extra fields of a metric line promoted to their own comparison rows
 #: (the perf-attribution columns ride headline rows as extra fields —

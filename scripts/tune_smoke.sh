@@ -1,0 +1,133 @@
+#!/usr/bin/env bash
+# Self-tuning smoke: the closed loop from the perf ledger to
+# EngineConfig, CI-runnable.  Drives a short mixed load through a
+# serving handle under the default config, captures a telemetry
+# snapshot (gochugaru_tpu/tune/snapshot.py), and asserts the offline
+# tuner (tune/tuner.py) emits a non-empty diff with per-knob measured
+# evidence + predicted deltas, that the diff survives a JSON round
+# trip, and that applying it reaches a FIXED POINT (re-proposing
+# against the same snapshot with the tuned target re-proposes none of
+# the applied knobs).  Then arms the OnlineController on the live
+# handle: bounded one-rung moves under cooldown, tune.* observability
+# counters, and one-call revert back to the preset.  Prints
+# TUNE-SMOKE-OK on success, mirroring scripts/serve_smoke.sh.
+#
+# Usage:
+#   scripts/tune_smoke.sh
+#   TUNE_SMOKE_SECONDS=3 scripts/tune_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+: "${TUNE_SMOKE_SECONDS:=2}"
+: "${TUNE_SMOKE_TIMEOUT_S:=420}"
+
+export TUNE_SMOKE_SECONDS
+
+timeout -k 10 "${TUNE_SMOKE_TIMEOUT_S}" env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import os
+import time
+
+import numpy as np
+
+from gochugaru_tpu import consistency, rel
+from gochugaru_tpu.client import new_tpu_evaluator, with_latency_mode
+from gochugaru_tpu.engine.plan import EngineConfig
+from gochugaru_tpu.serve import ServeConfig
+from gochugaru_tpu.tune import (
+    OnlineController, TuneDiff, TuneTarget, apply_diff, collect_snapshot,
+    propose,
+)
+from gochugaru_tpu.utils import metrics
+from gochugaru_tpu.utils.context import background
+
+SECONDS = float(os.environ.get("TUNE_SMOKE_SECONDS", "2"))
+m = metrics.default
+rng = np.random.default_rng(21)
+ctx = background()
+c = new_tpu_evaluator(with_latency_mode())
+c.write_schema(ctx, """
+definition user {}
+definition repo { relation reader: user  permission read = reader }
+""")
+txn = rel.Txn()
+for i in range(300):
+    txn.touch(rel.must_from_triple(
+        f"repo:r{i}", "reader", f"user:u{int(rng.integers(90))}"))
+c.write(ctx, txn)
+cs = consistency.min_latency()
+full = c.store.snapshot_for(consistency.full())
+inter, slot = full.interner, full.compiled.slot_of_name
+POOL = 2048
+pool_res = np.array([inter.node("repo", f"r{int(i)}")
+                     for i in rng.integers(0, 300, POOL)], np.int32)
+pool_subj = np.array([inter.node("user", f"u{int(i)}")
+                      for i in rng.integers(0, 90, POOL)], np.int32)
+pool_perm = np.full(POOL, slot["read"], np.int32)
+
+ecfg = EngineConfig()
+h = c.with_serving(cs=cs, config=ServeConfig(), cache=True)
+for t in ecfg.latency_tiers:  # warm each tier pin before measuring
+    n = min(int(t), POOL - 1)
+    h.submit_columns(ctx, pool_res[:n], pool_perm[:n],
+                     pool_subj[:n]).result(timeout=120.0)
+
+def drive(seconds):
+    futs, t0, k = [], time.perf_counter(), 0
+    while time.perf_counter() - t0 < seconds:
+        s = int(rng.integers(0, POOL - 300))
+        n = 300 if k % 20 == 19 else 7
+        futs.append(h.submit_columns(
+            ctx, pool_res[s:s + n], pool_perm[s:s + n], pool_subj[s:s + n],
+            client_id=k % 4))
+        k += 1
+        time.sleep(1 / 150)
+    for f in futs:
+        f.result(timeout=60.0)
+
+drive(SECONDS)
+
+# -- offline: snapshot -> propose -> JSON round trip -> fixed point -----
+snap = collect_snapshot(m, engine_config=ecfg,
+                        serve_config=h.batcher.config, vcache=c._vcache)
+target = TuneTarget(engine=ecfg, serve=h.batcher.config,
+                    cache_bytes=int(c._vcache.max_bytes))
+diff = propose(snap, target)
+assert diff, "default config under clock-bound load must yield proposals"
+for k in diff.knobs:
+    assert k.evidence, f"knob {k.knob} has no measured evidence"
+rt = TuneDiff.from_json(diff.to_json())
+assert rt.to_json() == diff.to_json(), "diff JSON round trip drifted"
+tuned = apply_diff(target, diff)
+again = propose(snap, tuned)
+applied = {k.knob for k in diff.knobs}
+re_proposed = applied & {k.knob for k in again.knobs}
+assert not re_proposed, f"no fixed point: {re_proposed} re-proposed"
+print(f"# offline: {len(diff.knobs)} knob(s) proposed "
+      f"({', '.join(sorted(applied))}); JSON round trip + fixed point OK")
+
+# -- online: bounded moves, observability, revert -----------------------
+preset_hold = float(h.batcher.config.hold_max_s)
+ctl = OnlineController(h.batcher, vcache=c._vcache, registry=m,
+                       cooldown_steps=1)
+moves = 0
+for _ in range(4):
+    drive(max(0.6, SECONDS / 3))
+    moves += ctl.step()
+assert moves >= 1, "controller never moved under clock-bound load"
+assert float(h.batcher.config.hold_max_s) < preset_hold
+assert int(m.counter("tune.moves")) == moves
+assert m.gauge("tune.hold_max_s") == float(h.batcher.config.hold_max_s)
+ctl.revert()
+assert float(h.batcher.config.hold_max_s) == preset_hold
+assert int(m.counter("tune.reverts")) == 1
+print(f"# online: {moves} bounded move(s), gauges live, revert restored "
+      f"hold={preset_hold}s")
+
+h.close()
+print(json.dumps({
+    "metric": "tune_smoke_knobs", "value": len(diff.knobs),
+    "moves": moves, "knobs": sorted(applied),
+}))
+print("TUNE-SMOKE-OK")
+EOF

@@ -230,6 +230,48 @@ def test_streamed_write_reaches_replicas_exactly_once(fleet):
         )
 
 
+def test_replica_apply_advances_serving_and_invalidates_vcache():
+    """Staleness regression: applying watch deltas must ADVANCE what
+    MIN_LATENCY serves (apply_replicated alone never materializes, so a
+    replica would keep answering from its bootstrap-era generation and
+    that generation's cached verdicts forever), and verdict-cache shards
+    for store generations the LRU retired must drop, counted as
+    ``fleet.vcache_invalidations``."""
+    m = _metrics.default
+    router = FleetRouter(config=CFG)
+    _world(router)
+    r = _replica(router, "rv-fresh")
+    router.add_replica(r.host, r.port, wait_ready_s=5.0)
+    try:
+        ctx = background()
+        q = rel.must_from_triple("doc:fresh", "read", "user:fu")
+        # warm the replica's verdict cache on the stale (False) verdict
+        assert router.check(ctx, consistency.min_latency(), q) == [False]
+        inv0 = m.counter("fleet.vcache_invalidations")
+        # first write flips the verdict; the rest churn generations past
+        # the store's keep_generations LRU so shard retirement is visible
+        for n in range(6):
+            txn = rel.Txn()
+            txn.touch(rel.must_from_triple("doc:fresh", "reader", "user:fu"))
+            txn.touch(rel.must_from_triple(f"doc:churn{n}", "reader", "user:cu"))
+            router.write(ctx, txn)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and r.head != router.head_revision:
+            time.sleep(0.02)
+        assert r.head == router.head_revision
+        # a MIN_LATENCY check must serve the applied write — the fresh
+        # keyspace, not the bootstrap generation's cached False
+        assert router.check(ctx, consistency.min_latency(), q) == [True]
+        assert m.counter("fleet.vcache_invalidations") > inv0
+        # residency report stays coherent: every cached shard's revision
+        # is a generation the store still keeps
+        h = r.health()
+        assert set(h["cache"]["revisions"]) <= set(h["resident"])
+    finally:
+        router.close()
+        r.close()
+
+
 def test_zookie_read_your_writes(fleet):
     router, _ = fleet
     ctx = background()

@@ -513,13 +513,61 @@ def test_full_strategy_handle_never_parks():
 
 
 def test_dedup_off_config_disables_all_of_it():
-    c, _, _ = _world()
-    h = c.with_serving(cs=consistency.min_latency(),
-                       config=ServeConfig(dedup=False))
-    try:
-        assert h.batcher._sf is None
-    finally:
-        h.close()
+    """dedup=False keeps duplicate submissions off the parked-twin
+    path.  The Singleflight window stays BUILT (the online controller
+    toggles dedup by swapping the config — tune/controller.py), so the
+    assertion is behavioral: a twin arriving mid-dispatch queues for
+    its own dispatch instead of parking, and a live ``apply_config``
+    swap re-arms parking without rebuilding the batcher."""
+    release = threading.Event()
+    entered = threading.Event()
+
+    def dispatch_cols(q_res, q_perm, q_subj, latency, span):
+        entered.set()
+        assert release.wait(5.0)
+        return q_res > 0
+
+    m = metrics.Metrics()
+    b = MicroBatcher(
+        tiers=(256, 1024, 4096), start=False, registry=m,
+        dispatch_cols=dispatch_cols, config=ServeConfig(dedup=False),
+    )
+    cols = (np.array([1, 0, 2], np.int32), np.array([0, 0, 0], np.int32),
+            np.array([7, 8, 9], np.int32))
+    f1 = b.submit_columns("a", *cols)
+    batch = b.form_batch()
+    t = threading.Thread(target=b.dispatch_batch, args=(batch,))
+    t.start()
+    assert entered.wait(5.0)
+    # twin arrives mid-dispatch → queues, no park, no shared verdicts
+    f2 = b.submit_columns("b", *cols)
+    assert b.depth == 3
+    assert m.counter("serve.dedup_parked") == 0
+    release.set()
+    t.join(5.0)
+    assert f1.result(timeout=5.0).tolist() == [True, False, True]
+    b.dispatch_batch(b.form_batch())
+    assert f2.result(timeout=5.0).tolist() == [True, False, True]
+    assert m.counter("serve.batches") == 2
+
+    # live re-arm: the same batcher parks once the config says dedup
+    b.apply_config(ServeConfig(dedup=True))
+    entered.clear()
+    release.clear()
+    f3 = b.submit_columns("a", *cols)
+    batch = b.form_batch()
+    t = threading.Thread(target=b.dispatch_batch, args=(batch,))
+    t.start()
+    assert entered.wait(5.0)
+    f4 = b.submit_columns("b", *cols)
+    assert b.depth == 0  # parked on f3's in-flight batch
+    assert m.counter("serve.dedup_parked") == 3
+    release.set()
+    t.join(5.0)
+    assert f3.result(timeout=5.0).tolist() == [True, False, True]
+    assert f4.result(timeout=5.0).tolist() == [True, False, True]
+    assert m.counter("serve.batches") == 3
+    b.close()
 
 
 # ---------------------------------------------------------------------------
