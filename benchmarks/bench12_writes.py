@@ -1,0 +1,392 @@
+"""Group-commit write pipeline: coalesced writes vs one-at-a-time.
+
+Every committed revision pays fixed machinery regardless of how many
+tuples it carries — a log entry, a delta-chain link, and (on the serving
+path) a closure advance plus device reship.  The group-commit pipeline
+(store/group.py) amortizes that machinery across a GROUP: one collapsed
+delta, one log entry, one materialization per group, while every
+transaction still mints its own zookie.  This bench prices that on the
+CPU host proxy, closed-loop:
+
+1. **group vs single** — W transactions committed one-at-a-time (write +
+   per-revision snapshot materialization, the delta link every revision
+   pays on the serving path) against the same W transactions in groups
+   of G, with BITWISE oracle parity asserted on every post-group
+   snapshot (lexsorted packed edge columns).  Emits ``writes_per_s``
+   with the measured speedup; at G ≥ 64 the acceptance bar is ≥5×.
+2. **committer closed-loop** — concurrent submitters through
+   ``GroupCommitter`` (deadline-aware hold-back, formation overlapping
+   application); emits ``committer_writes_per_s`` and the achieved
+   ``group_size_p50`` from the store-side ``write.group_size``
+   histogram.
+3. **chain compaction** — a ≥2k-revision delta chain with the
+   background ``ChainCompactor`` on: overlay probe depth must stay
+   bounded (no writer ever pays the synchronous merge), emitted as
+   ``probe_depth_after_compaction``.
+4. **mixed soak** — read p99 through a host-only client while writer
+   threads stream group commits, vs the write-free baseline; the
+   acceptance bar is within 1.5×.  Emits ``read_p99_under_write_ms``.
+
+The paper's write-side anchor (PAPER.md §3.2): ~10k writes/s sustained
+while serving reads — ``vs_baseline`` for the write rates uses it as
+the denominator.
+"""
+
+import argparse
+import threading
+import time
+
+import numpy as np
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+from benchmarks.common import bench_main, emit, note
+
+WRITE_NORTH_STAR = 10_000  # writes/s sustained (PAPER.md §3.2)
+
+SCHEMA = """
+definition user {}
+definition document {
+    relation writer: user
+    relation reader: user
+
+    permission edit = writer
+    permission view = reader + edit
+}
+"""
+
+
+def _make_store():
+    from gochugaru_tpu.store.store import Store
+
+    s = Store()
+    s.write_schema(SCHEMA)
+    return s
+
+
+def _txn(doc: str, user: str):
+    from gochugaru_tpu import rel
+
+    t = rel.Txn()
+    t.touch(rel.must_from_triple(f"document:{doc}", "reader", f"user:{user}"))
+    return t
+
+
+def _txn_stream(n: int):
+    """n single-touch transactions over a unique-doc keyspace with a
+    sprinkle of repeat-doc touches (upserts across groups)."""
+    txns = []
+    for i in range(n):
+        doc = f"d{i % max(n // 2, 1)}"  # second half revisits docs
+        txns.append(_txn(doc, f"u{i % 97}"))
+    return txns
+
+
+def _canon(snap):
+    """Lexsorted packed edge columns — the bitwise-comparable canonical
+    form of a snapshot's world (touching e_* forces the LSM merge)."""
+    cols = (snap.e_res, snap.e_rel, snap.e_subj, snap.e_srel1,
+            snap.e_caveat, snap.e_exp)
+    order = np.lexsort(cols[::-1])
+    return tuple(c[order] for c in cols)
+
+
+def _assert_bitwise(a, b, where: str) -> None:
+    ca, cb = _canon(a), _canon(b)
+    for i, (x, y) in enumerate(zip(ca, cb)):
+        if x.shape != y.shape or not np.array_equal(x, y):
+            raise SystemExit(
+                f"BITWISE PARITY FAILED at {where}: column {i} differs "
+                f"({x.shape} vs {y.shape})"
+            )
+
+
+def section_group_vs_single(W: int, G: int, quick: bool) -> None:
+    from gochugaru_tpu import consistency
+
+    txns = _txn_stream(W)
+    single = _make_store()   # the one-at-a-time oracle AND baseline
+    grouped = _make_store()
+
+    t_single = 0.0
+    t_group = 0.0
+    n_groups = 0
+    for g0 in range(0, W, G):
+        chunk = txns[g0:g0 + G]
+        t0 = time.perf_counter()
+        outcomes = grouped.write_group(chunk)
+        gsnap = grouped.snapshot_for(consistency.full())
+        gsnap.e_rel.shape  # force the merge inside the timed region
+        t_group += time.perf_counter() - t0
+        n_groups += 1
+        if any(isinstance(o, BaseException) for o in outcomes):
+            raise SystemExit(f"group at {g0}: unexpected ejection")
+        # baseline: same chunk one revision at a time, each paying its
+        # own materialization — the per-revision machinery group commit
+        # amortizes
+        t0 = time.perf_counter()
+        for t in chunk:
+            single.write(t)
+            ssnap = single.snapshot_for(consistency.full())
+            ssnap.e_rel.shape
+        t_single += time.perf_counter() - t0
+        # every post-group snapshot must match the sequential oracle
+        # bitwise (revisions align: base+k == k sequential writes)
+        assert grouped.head_revision == single.head_revision
+        _assert_bitwise(gsnap, ssnap, f"group {n_groups} (rev {gsnap.revision})")
+
+    singles_per_s = W / max(t_single, 1e-9)
+    group_per_s = W / max(t_group, 1e-9)
+    speedup = group_per_s / max(singles_per_s, 1e-9)
+    note(
+        f"group vs single: W={W} G={G} | one-at-a-time "
+        f"{singles_per_s:,.0f} w/s | grouped {group_per_s:,.0f} w/s | "
+        f"speedup {speedup:.1f}x | parity bitwise on all {n_groups} groups"
+    )
+    emit(
+        "writes_per_s", group_per_s, "writes/s",
+        group_per_s / WRITE_NORTH_STAR,
+        batch=G, group_speedup=round(speedup, 2),
+        single_writes_per_s=round(singles_per_s, 1),
+        groups=n_groups, txns=W,
+    )
+    if G >= 64 and speedup < 5.0:
+        if quick:
+            note(f"quick mode: speedup {speedup:.1f}x below the 5x full-run bar")
+        else:
+            raise SystemExit(
+                f"ACCEPTANCE FAILED: {speedup:.1f}x < 5x at group size {G}"
+            )
+
+
+def _hist_delta(before, name: str):
+    """(uppers, count deltas) of one histogram vs a prior snapshot."""
+    from gochugaru_tpu.utils import metrics as _metrics
+
+    now = _metrics.default.hist_snapshot().get(name)
+    if now is None:
+        return None
+    uppers, counts, _, _, _ = now
+    old = before.get(name)
+    base = old[1] if old is not None else [0] * len(counts)
+    return uppers, [int(c) - int(b) for c, b in zip(counts, base)]
+
+
+def _hist_p50(uppers, counts) -> float:
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    acc = 0
+    for u, c in zip(list(uppers) + [float("inf")], counts):
+        acc += c
+        if acc * 2 >= total:
+            return float(u)
+    return float("inf")
+
+
+def section_committer(duration_s: float, writers: int) -> None:
+    from gochugaru_tpu.store.group import GroupCommitConfig, GroupCommitter
+    from gochugaru_tpu.utils import metrics as _metrics
+
+    store = _make_store()
+    hist_before = _metrics.default.hist_snapshot()
+    gc = GroupCommitter(
+        store, GroupCommitConfig(max_group=256, hold_max_s=0.001)
+    )
+    done = []
+    stop = time.monotonic() + duration_s
+
+    def worker(w):
+        n = 0
+        while time.monotonic() < stop:
+            gc.write(_txn(f"c{w}_{n % 512}", f"w{w}"))
+            n += 1
+        done.append(n)
+
+    threads = [
+        threading.Thread(target=worker, args=(w,)) for w in range(writers)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    gc.close()
+    total = sum(done)
+    rate = total / max(wall, 1e-9)
+    h = _hist_delta(hist_before, "write.group_size")
+    p50 = _hist_p50(*h) if h else 0.0
+    groups = sum(h[1]) if h else 0
+    note(
+        f"committer closed-loop: {writers} writers, {total} txns in "
+        f"{wall:.2f}s -> {rate:,.0f} w/s over {groups} groups "
+        f"(group_size_p50<={p50:g})"
+    )
+    emit(
+        "committer_writes_per_s", rate, "writes/s", rate / WRITE_NORTH_STAR,
+        batch=writers, txns=total, groups=groups,
+    )
+    emit(
+        "group_size_p50", p50, "txns/group",
+        p50 / max(writers, 1), writers=writers,
+    )
+    if groups >= total:
+        raise SystemExit("no coalescing happened: one group per txn")
+
+
+def section_chain(revisions: int, G: int) -> None:
+    from gochugaru_tpu import consistency
+    from gochugaru_tpu.store.group import ChainCompactor, GroupCommitConfig
+    from gochugaru_tpu.utils import metrics as _metrics
+
+    m = _metrics.default
+    store = _make_store()
+    store.lsm_compact_min = 1024  # rows: EngineConfig.lsm_compact_min proxy
+    cc = ChainCompactor(
+        store, GroupCommitConfig(compact_poll_s=0.0, compact_fraction=0.5)
+    )
+    merges_before = m.counter("store.bg_compactions")
+    store.snapshot_for(consistency.full())  # base generation
+    max_overlay = 0
+    n_groups = revisions // G
+    for g in range(n_groups):
+        store.write_group([_txn(f"ch{g}_{j}", f"u{j}") for j in range(G)])
+        store.snapshot_for(consistency.full())
+        got = store.peek_chain()
+        if got is not None:
+            max_overlay = max(max_overlay, got[1])
+        cc.poll_once()
+    cc.close()
+    got = store.peek_chain()
+    depth = int(got[1]) if got is not None else 0
+    merges = int(m.counter("store.bg_compactions") - merges_before)
+    hard_trip = max(store.lsm_compact_min, 1)
+    note(
+        f"chain: {n_groups * G} revisions in {n_groups} groups | "
+        f"bg compactions {merges} | max overlay {max_overlay} rows "
+        f"(hard trip {hard_trip}) | final depth {depth} rows"
+    )
+    emit(
+        "probe_depth_after_compaction", depth, "rows",
+        0.0, revisions=n_groups * G, bg_compactions=merges,
+        max_overlay_rows=max_overlay,
+    )
+    if merges < 1:
+        raise SystemExit("background compactor never ran over a 2k-rev chain")
+    if max_overlay > hard_trip:
+        raise SystemExit(
+            f"probe depth unbounded: overlay hit {max_overlay} rows, past "
+            f"the {hard_trip}-row synchronous trip the compactor must beat"
+        )
+
+
+def section_mixed_soak(reps: int, writers: int, quick: bool) -> None:
+    from gochugaru_tpu import consistency, rel
+    from gochugaru_tpu.client import (
+        new_tpu_evaluator,
+        with_group_commit,
+        with_host_only_evaluation,
+        with_store,
+    )
+    from gochugaru_tpu.store.group import GroupCommitConfig
+    from gochugaru_tpu.utils.context import background
+
+    store = _make_store()
+    seed = rel.Txn()
+    for i in range(512):
+        seed.touch(rel.must_from_triple(f"document:m{i}", "reader", f"user:r{i % 31}"))
+    store.write(seed)
+    client = new_tpu_evaluator(
+        with_store(store),
+        with_host_only_evaluation(),
+        with_group_commit(GroupCommitConfig(max_group=128, hold_max_s=0.001)),
+    )
+    ctx = background()
+    qs = [
+        rel.must_from_triple(f"document:m{i % 512}", "view", f"user:r{i % 31}")
+        for i in range(64)
+    ]
+
+    def read_p99(min_wall_s: float = 0.0) -> float:
+        ts = []
+        i = 0
+        t_end = time.perf_counter() + min_wall_s
+        while i < reps or time.perf_counter() < t_end:
+            q = qs[i % len(qs)]
+            t0 = time.perf_counter()
+            client.check(ctx, consistency.min_latency(), q)
+            ts.append((time.perf_counter() - t0) * 1000)
+            i += 1
+        return float(np.percentile(np.asarray(ts), 99))
+
+    client.check(ctx, consistency.full(), qs[0])  # warm + materialize
+    p99_quiet = read_p99()
+
+    stop = threading.Event()
+    wrote = []
+
+    def writer(w):
+        n = 0
+        while not stop.is_set():
+            client.write(ctx, _txn(f"soak{w}_{n % 256}", f"sw{w}"))
+            n += 1
+        wrote.append(n)
+
+    threads = [
+        threading.Thread(target=writer, args=(w,)) for w in range(writers)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        # hold the mixed window open long enough for the writers to
+        # stream a real load (a reps-only window on a fast host closes
+        # before the first groups even form)
+        p99_under_write = read_p99(min_wall_s=1.0 if quick else 3.0)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    ratio = p99_under_write / max(p99_quiet, 1e-9)
+    note(
+        f"mixed soak: read p99 {p99_quiet:.3f}ms quiet -> "
+        f"{p99_under_write:.3f}ms under {writers} group-commit writers "
+        f"({sum(wrote)} writes) = {ratio:.2f}x"
+    )
+    emit(
+        "read_p99_under_write_ms", p99_under_write, "ms",
+        2.0 / max(p99_under_write, 1e-9),
+        read_p99_quiet_ms=round(p99_quiet, 3),
+        soak_ratio=round(ratio, 2), write_txns=sum(wrote),
+    )
+    if ratio > 1.5:
+        if quick:
+            note(f"quick mode: soak ratio {ratio:.2f}x above the 1.5x full bar")
+        else:
+            raise SystemExit(
+                f"ACCEPTANCE FAILED: read p99 {ratio:.2f}x write-free "
+                "baseline (bar: 1.5x)"
+            )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--txns", type=int, default=None)
+    ap.add_argument("--group", type=int, default=64)
+    args = ap.parse_args()
+    q = args.quick
+    W = args.txns or (1024 if q else 8192)
+
+    note(f"group-commit write pipeline (CPU host proxy), quick={q}")
+    section_group_vs_single(W, args.group, q)
+    section_committer(duration_s=1.0 if q else 3.0, writers=32)
+    section_chain(revisions=2048, G=64)
+    section_mixed_soak(reps=400 if q else 2000, writers=4, quick=q)
+
+
+if __name__ == "__main__":
+    bench_main(main)

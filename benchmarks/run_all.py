@@ -255,6 +255,13 @@ def main() -> int:
         ["bash", "scripts/tune_smoke.sh"],
         600,
     ))
+    configs.append((
+        "24 — group-commit write pipeline: coalesced vs one-at-a-time"
+        " writes, bitwise oracle parity, chain compaction, mixed soak"
+        + (" (quick)" if q else ""),
+        [py, "benchmarks/bench12_writes.py"] + (["--quick"] if q else []),
+        900,
+    ))
     if not q:
         # Leopard-scale CPU proxy (VERDICT r04 item 3): the same Watch
         # re-index loop at a 100M-edge base — BASELINE config 5's

@@ -150,6 +150,7 @@ class _Options:
         self.slos = None  # None → utils/slo.default_slos(); () disables
         self.verdict_cache = None  # VerdictCache | max_bytes int | None
         self.decision_log = None  # (spec, kwargs) from with_decision_log
+        self.group_commit = None  # GroupCommitConfig | True | None
 
 
 Option = Callable[[_Options], None]
@@ -278,6 +279,27 @@ def with_decision_log(log=True, **kw) -> Option:
     return opt
 
 
+def with_group_commit(config=True) -> Option:
+    """Route this client's writes through the group-commit pipeline
+    (store/group.py): concurrent ``write`` calls coalesce into ONE
+    collapsed delta committed as one log entry — one closure advance,
+    one device reship, one replication frame per group — while each
+    transaction still gets its own zookie (base+1..base+k inside the
+    group).  Also starts the background delta-chain compactor, which
+    materializes long LSM chains off the request path so probe depth
+    stays bounded under sustained write load.
+
+    ``config`` may be ``True`` (defaults) or a ``GroupCommitConfig``
+    (store/group.py) to tune group size, hold-back, and the compactor's
+    poll cadence.  Without this option, ``write`` stays byte-for-byte
+    on the direct one-revision-per-transaction store path."""
+
+    def opt(o: _Options) -> None:
+        o.group_commit = config
+
+    return opt
+
+
 def with_admission_control(config: AdmissionConfig) -> Option:
     """Tune the dispatch admission controller (utils/admission.py): the
     bounded in-flight gate, the deadline-budget shed, and the latency-path
@@ -371,6 +393,33 @@ class Client:
         self._store = o.store if o.store is not None else Store()
         self._overlap_required = o.overlap_required
         self._engine_config = o.engine_config
+        if o.engine_config is not None:
+            # host-side LSM materialization floor rides the engine config
+            # (the tuner's lsm_compact_min knob) down to the store
+            self._store.lsm_compact_min = o.engine_config.lsm_compact_min
+        #: group-commit write pipeline + background chain compactor
+        #: (store/group.py), armed by with_group_commit(); None keeps
+        #: write() on the direct store path
+        self._committer = None
+        self._compactor = None
+        if o.group_commit is not None and o.group_commit is not False:
+            from .store.group import (
+                ChainCompactor,
+                GroupCommitConfig,
+                GroupCommitter,
+            )
+
+            gcfg = (
+                o.group_commit
+                if isinstance(o.group_commit, GroupCommitConfig)
+                else GroupCommitConfig()
+            )
+            self._committer = GroupCommitter(
+                self._store, gcfg, registry=_metrics.default
+            )
+            self._compactor = ChainCompactor(
+                self._store, gcfg, registry=_metrics.default
+            )
         self._use_device = o.use_device
         self._profile_dir = o.profile_dir
         self._latency_mode = o.latency_mode
@@ -627,7 +676,11 @@ class Client:
     # ------------------------------------------------------------------
     def write(self, ctx: Context, txn: Txn) -> str:
         """Atomically perform a transaction on relationships; returns the
-        revision it was written at."""
+        revision it was written at.  Under with_group_commit() the
+        transaction coalesces into the next commit group (same zookie
+        contract, one log entry per group); otherwise it commits alone."""
+        if self._committer is not None:
+            return self._committer.write(txn, ctx)
         return self._store.write(txn)
 
     # ------------------------------------------------------------------
@@ -2068,3 +2121,4 @@ NewSystemTLS = new_system_tls
 WithOverlapRequired = with_overlap_required
 WithLatencyMode = with_latency_mode
 WithAdmissionControl = with_admission_control
+WithGroupCommit = with_group_commit

@@ -2643,6 +2643,12 @@ def build_delta_arrays(
         out[off_key] = off
 
     def _extras() -> Dict:
+        # runs once per successful incremental advance; a revision span
+        # > 1 means this ONE device reship covered a whole write group
+        if int(snap.revision) - int(prev_dsnap.revision) > 1:
+            from ..utils import metrics as _metrics
+
+            _metrics.default.inc("flat.group_reships")
         if pk_drop:
             meta_up["packed"] = tuple(
                 t for t in meta.packed if t[0] not in pk_drop

@@ -75,6 +75,14 @@ class EngineConfig:
     #: max(this, E/8) trigger compaction: the next prepare rebuilds the
     #: base instead of growing the overlay (engine/flat.py delta level)
     flat_delta_min_compact: int = 65_536
+    #: host-side mirror of the same bound: overlay rows beyond
+    #: max(this, E/8) make store/delta.py materialize the LSM chain into
+    #: a fresh base instead of deferring the merge.  Lower keeps probe
+    #: depth (and find_in_view cost) small at the price of more frequent
+    #: O(E) merges; the background chain compactor (store/group.py)
+    #: works against half this trip so the merge lands off the write
+    #: path.  Tunable (tune/tuner.py) off chain-depth telemetry
+    lsm_compact_min: int = 65_536
     #: prewarm the transposed lookup index in a background thread at full
     #: prepare time (worlds ≥ LOOKUP_PREWARM_MIN_EDGES edges): cold
     #: lookup_resources joins a mostly-finished build instead of paying

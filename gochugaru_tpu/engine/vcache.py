@@ -53,7 +53,9 @@ from __future__ import annotations
 
 import threading
 from collections import OrderedDict
-from typing import Any, Dict, List, Mapping, NamedTuple, Optional, Sequence
+from typing import (
+    Any, Dict, Iterable, List, Mapping, NamedTuple, Optional, Sequence,
+)
 
 import numpy as np
 
@@ -516,11 +518,27 @@ class VerdictCache:
         evicts a prepared revision, the matching verdict shard drops
         with it (a no-longer-resident revision will not be read again
         by pinned readers — they get PreconditionFailed upstream)."""
+        self.drop_revisions((revision,))
+
+    def drop_revisions(self, revisions: Iterable[int]) -> None:
+        """Batched structural invalidation — ONE lock acquisition and one
+        gauge publish for a whole set of retired revisions.  This is the
+        group-commit shape: a committed group retires every evicted /
+        non-resident generation it superseded in one call (client dsnap
+        LRU, fleet/replica.py serving advance) instead of a
+        lock-acquire-per-write storm.  Counts one
+        ``cache.group_invalidations`` per call that dropped > 1 shard."""
         with self._lock:
-            sh = self._revs.pop(revision, None)
-            if sh is not None:
-                self._bytes -= self._shard_bytes(sh)
-                self._entries -= len(sh["c"]) + len(sh["r"])
+            dropped = 0
+            for revision in revisions:
+                sh = self._revs.pop(revision, None)
+                if sh is not None:
+                    self._bytes -= self._shard_bytes(sh)
+                    self._entries -= len(sh["c"]) + len(sh["r"])
+                    dropped += 1
+            if dropped:
+                if dropped > 1:
+                    self._m.inc("cache.group_invalidations")
                 self._publish_locked()
 
     def clear(self) -> None:

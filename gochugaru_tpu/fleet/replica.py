@@ -170,11 +170,16 @@ class Replica:
                                 for d in frame.get("updates", ())
                             ]
                             faults.fire("replica.apply")
+                            local = self._store.head_revision
                             self._store.apply_replicated(
                                 int(frame["rev"]), ups
                             )
                             resumes = 0
                             self._m.inc("fleet.applied_entries")
+                            if int(frame["rev"]) - local > 1:
+                                # a group-committed entry: one frame,
+                                # one advance, head jumps base→base+k
+                                self._m.inc("fleet.group_applies")
                             self._advance_serving()
                     self._m.set_gauge(
                         f"fleet.catchup_lag.{self.id}", float(self.lag())

@@ -35,7 +35,7 @@ import hashlib
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Dict, List, Optional, Set, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 from .. import consistency
 from ..rel.relationship import as_relationship
@@ -207,6 +207,24 @@ class FleetRouter:
         token = self._store.write(txn)
         self._m.inc("fleet.writes")
         return _zookie.mint(token, self._cfg.zookie_key)
+
+    def write_group(self, ctx: Context, txns: Sequence[Txn]) -> List[object]:
+        """Group-commit on the authority (store/group.py semantics): the
+        whole group lands as ONE log entry, so the watch stream carries
+        it to every replica as ONE frame and each replica applies it as
+        one advance under the same exactly-once cursor discipline as a
+        single write.  Returns per-transaction outcomes in order: a
+        minted zookie for survivors, the ejecting exception otherwise."""
+        outcomes = self._store.write_group(txns)
+        minted = 0
+        for i, out in enumerate(outcomes):
+            if not isinstance(out, BaseException):
+                outcomes[i] = _zookie.mint(out, self._cfg.zookie_key)
+                minted += 1
+        self._m.inc("fleet.writes", minted)
+        if minted:
+            self._m.inc("fleet.write_groups")
+        return outcomes
 
     # -- membership -------------------------------------------------------
     def add_replica(

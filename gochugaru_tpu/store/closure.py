@@ -751,6 +751,10 @@ def advance_closure(
         ovf_srel1=(full_ovf % S1).astype(np.int32),
     )
     metrics.default.inc("closure.delta_applies")
+    if int(revision) - int(st.revision) > 1:
+        # one advance covering a multi-revision span — the whole point
+        # of group commit: k writes, one closure delta
+        metrics.default.inc("closure.batch_applies")
     # write-path observability: a sampled request whose delta-prepare
     # reached this advance records it on the request's active span
     # (utils/trace.py thread-local; one branch when tracing is off)

@@ -245,7 +245,9 @@ def _lower_delta(
 #: into a fresh base instead of growing it.  Mirrors the device's
 #: EngineConfig.flat_delta_min_compact so host and device compact on the
 #: same revision (the device bails to a full prepare at the same bound,
-#: which touches every view and would materialize anyway).
+#: which touches every view and would materialize anyway).  Tunable per
+#: store via EngineConfig.lsm_compact_min (threaded through apply_delta's
+#: ``compact_min``); this module constant is only the default.
 LSM_COMPACT_MIN = 65_536
 
 
@@ -329,6 +331,25 @@ class LsmSnapshot(Snapshot):
             - self._lsm_gone.shape[0]
             + self._lsm_ov["rel"].shape[0]
         )
+
+    @property
+    def overlay_rows(self) -> int:
+        """Accumulated chain size (overlay adds + base tombstones): the
+        quantity the compaction bound compares against max(compact_min,
+        E/8), and what every probe pays an extra binary search over.
+        0 once materialized."""
+        if self.__dict__.get("_lsm_done"):
+            return 0
+        return int(self._lsm_ov["rel"].shape[0] + self._lsm_gone.shape[0])
+
+    @property
+    def chain_base_revision(self) -> int:
+        """Revision of the materialized base this chain grows from (the
+        chain length in revisions is ``revision - chain_base_revision``);
+        own revision once materialized."""
+        if self.__dict__.get("_lsm_done"):
+            return int(self.revision)
+        return int(self._lsm_base.revision)
 
     def _materialize(self, compact_ctx: bool = False) -> bool:
         if self.__dict__.get("_lsm_done"):
@@ -473,6 +494,7 @@ def apply_delta(
     *,
     interner: Optional[Interner] = None,
     defer: Optional[bool] = None,
+    compact_min: Optional[int] = None,
 ) -> Snapshot:
     """Next-revision Snapshot from the previous one plus a collapsed delta.
 
@@ -487,7 +509,11 @@ def apply_delta(
     merges eagerly; None (default) defers unless the previous snapshot
     carries a live lookup index (advance_lookup_index needs merged-row
     positions) or the accumulated overlay would cross the compaction
-    bound (then the merge is due anyway)."""
+    bound (then the merge is due anyway).
+
+    ``compact_min`` overrides the module-level LSM_COMPACT_MIN floor —
+    the store threads EngineConfig.lsm_compact_min through here so the
+    tuner can trade probe depth against materialization frequency."""
     interner = interner if interner is not None else prev.interner
     compiled = prev.compiled
     contexts = list(prev.contexts)
@@ -574,8 +600,9 @@ def apply_delta(
         out[pos_new] = new_cols[k].astype(ov0[k].dtype)
         ov[k] = out
 
+    cm = LSM_COMPACT_MIN if compact_min is None else int(compact_min)
     over_bound = ov["rel"].shape[0] + gone.shape[0] > max(
-        LSM_COMPACT_MIN, base.e_rel.shape[0] // 8
+        cm, base.e_rel.shape[0] // 8
     )
     # contexts-list compaction check on an O(delta)-maintained UPPER bound
     # of live context uses (base count at chain start + overlay ctx rows;

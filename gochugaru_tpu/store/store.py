@@ -48,6 +48,7 @@ from ..schema import CompiledSchema, compile_schema, parse_schema
 from ..native.sort import lexsort2, lexsort4
 from ..schema.compiler import SchemaValidationError
 from ..utils import faults
+from ..utils import metrics as _metrics
 from ..utils import trace as _trace
 from ..utils.errors import (
     AlreadyExistsError,
@@ -166,6 +167,12 @@ class _ChainedUpdates(Sequence):
             yield from p
 
 
+#: pow2 buckets for the writes-per-group histogram (write.group_size)
+_GROUP_SIZE_BUCKETS = (
+    1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0, 1024.0,
+)
+
+
 class Store:
     """In-process authorization datastore with MVCC snapshot generations."""
 
@@ -191,6 +198,10 @@ class Store:
         self._base_contexts: List[Mapping[str, Any]] = []
         self._base_ctx_index: Dict[str, int] = {}
         self._node_type_cache: Optional[np.ndarray] = None
+        # host LSM materialization floor override: None falls back to
+        # store/delta.py's LSM_COMPACT_MIN; the client threads
+        # EngineConfig.lsm_compact_min here so the tuner can move it
+        self.lsm_compact_min: Optional[int] = None
 
     # -- schema ----------------------------------------------------------
     def write_schema(self, text: str) -> str:
@@ -446,6 +457,148 @@ class Store:
             wsp.set_attr("applied", len(applied))
             return RevisionToken(self._head_rev)
 
+    def write_group(self, txns: Sequence[Txn]) -> List[object]:
+        """Atomically commit a GROUP of transactions as ONE log entry —
+        the commit half of the group-commit write pipeline
+        (store/group.py forms the groups, this applies them).
+
+        Semantics:
+
+        * preconditions and CREATE-conflict checks evaluate once against
+          the group's BASE revision, plus earlier surviving members of
+          the same group in arrival order (a CREATE colliding with an
+          earlier member's CREATE is a conflict, same as two sequential
+          writes would see);
+        * a transaction that fails validation, a precondition, or a
+          CREATE conflict is EJECTED before collapse — its slot gets the
+          exception instance, the rest of the group proceeds;
+        * survivors mint consecutive zookies base+1..base+k so
+          client-visible revision semantics match k sequential writes,
+          but the log carries ONE entry at base+k holding the
+          last-writer-wins collapse of every surviving update — closure
+          advance, device reship, and replication all pay one delta per
+          group.  Mid-group tokens resolve under FULL / AT_LEAST /
+          MIN_LATENCY (head >= token); pinning a SNAPSHOT read to one
+          raises RevisionUnavailableError, exactly like any other
+          unmaterialized generation.
+
+        Returns one outcome per input transaction, in order: a revision
+        token (str) for survivors, the exception for ejected ones.  A
+        fault fired at the ``closure.delta`` site (modelling the group's
+        single delta application failing after formation) aborts the
+        WHOLE group before the commit point: head stays at the base
+        revision, no zookie is minted, and a retry is idempotent."""
+        wsp = _trace.root_span("write_group", txns=len(txns))
+        with wsp, self._lock:
+            compiled = self._require_schema()
+            now_us = self._now_us()
+            base = self._head_rev
+            outcomes: List[object] = [None] * len(txns)
+            # group-wide shadow overlay: merged from each survivor in
+            # arrival order so later members see earlier ones; an
+            # ejected member's staged entries never land in it
+            shadow: Dict[_Key, Optional[Relationship]] = {}
+            survivors: List[int] = []
+            for i, txn in enumerate(txns):
+                try:
+                    for u in txn.updates:
+                        compiled.validate_relationship(u.relationship)
+                        self._validate_caveat_context(u.relationship)
+                    self._check_preconditions(txn.preconditions, now_us)
+                    local: Dict[_Key, Optional[Relationship]] = {}
+                    for u in txn.updates:
+                        key = u.relationship.key()
+                        if u.update_type == UpdateType.CREATE:
+                            if key in local or key in shadow:
+                                prior = local.get(key, shadow.get(key))
+                                exists = prior is not None and self._is_live(
+                                    prior, now_us
+                                )
+                            else:
+                                existing = self._live.get(key)
+                                exists = existing is not None and self._is_live(
+                                    existing, now_us
+                                )
+                                if not exists:
+                                    hit = self._base_find(u.relationship)
+                                    exists = hit is not None and self._base_row_live(
+                                        hit[0], hit[1], now_us
+                                    )
+                            if exists:
+                                raise AlreadyExistsError(
+                                    f"relationship already exists: {u.relationship}"
+                                )
+                            local[key] = u.relationship
+                        elif u.update_type == UpdateType.TOUCH:
+                            local[key] = u.relationship
+                        elif u.update_type == UpdateType.DELETE:
+                            local[key] = None
+                        else:
+                            raise ValueError(
+                                f"unknown update type {u.update_type}"
+                            )
+                except Exception as e:  # per-slot ejection, group proceeds
+                    outcomes[i] = e
+                    continue
+                shadow.update(local)
+                survivors.append(i)
+
+            if not survivors:
+                wsp.set_attr("revision", base)
+                wsp.set_attr("survivors", 0)
+                return outcomes
+
+            # last-writer-wins collapse across survivors in arrival
+            # order: the final update per tuple key determines the end
+            # state, so the single log entry replays identically to the
+            # k sequential transactions it stands for
+            collapsed: Dict[_Key, Update] = {}
+            for i in survivors:
+                for u in txns[i].updates:
+                    collapsed[u.relationship.key()] = u
+
+            # injection site shared with the closure advance: fired after
+            # formation but BEFORE the commit point, so an armed fault
+            # leaves the store at the group's base revision with no
+            # zookies minted (the atomicity contract the fault-injection
+            # tests pin down)
+            faults.fire("closure.delta")
+
+            # -- commit point: nothing above mutated state -------------
+            applied: List[Update] = []
+            for u in collapsed.values():
+                key = u.relationship.key()
+                if u.update_type in (UpdateType.CREATE, UpdateType.TOUCH):
+                    hit = self._base_find(u.relationship)
+                    if hit is not None:
+                        hit[0].live[hit[1]] = False  # superseded base row
+                    self._live[key] = u.relationship
+                    self._intern(u.relationship)
+                    applied.append(u)
+                else:  # DELETE
+                    if key in self._live:
+                        del self._live[key]
+                        applied.append(u)
+                    else:
+                        hit = self._base_find(u.relationship)
+                        if hit is not None:
+                            hit[0].live[hit[1]] = False
+                            applied.append(u)
+
+            k = len(survivors)
+            for j, i in enumerate(survivors, start=1):
+                outcomes[i] = RevisionToken(base + j)
+            self._head_rev = base + k
+            self._log.append(_LogEntry(self._head_rev, applied))
+            self._new_data.notify_all()
+            _metrics.default.observe_hist(
+                "write.group_size", float(k), _GROUP_SIZE_BUCKETS
+            )
+            wsp.set_attr("revision", self._head_rev)
+            wsp.set_attr("survivors", k)
+            wsp.set_attr("collapsed", len(applied))
+            return outcomes
+
     def apply_replicated(self, revision: int, updates: Sequence[Update]) -> str:
         """Apply an already-committed upstream log entry at EXACTLY the
         given revision — the replica tail path (fleet/replica.py).
@@ -510,6 +663,23 @@ class Store:
         are the other half)."""
         with self._lock:
             return sorted(self._snapshots)
+
+    def peek_chain(self) -> Optional[Tuple[Snapshot, int, int]]:
+        """(snapshot, overlay_rows, chain_len_revisions) for the newest
+        resident generation — the background chain compactor's poll
+        (store/group.py).  Deliberately does not touch the snapshot LRU
+        order; returns None when nothing is materialized yet.  The
+        returned snapshot reference is safe to materialize outside the
+        store lock (LsmSnapshot._materialize is idempotent under its own
+        lock)."""
+        with self._lock:
+            if not self._snapshots:
+                return None
+            rev = max(self._snapshots)
+            snap = self._snapshots[rev]
+        rows = int(getattr(snap, "overlay_rows", 0))
+        base_rev = int(getattr(snap, "chain_base_revision", rev))
+        return snap, rows, int(rev) - base_rev
 
     def _validate_caveat_context(self, r: Relationship) -> None:
         if not r.caveat_name or not r.caveat_context:
@@ -1178,7 +1348,10 @@ class Store:
         deletes = [r for is_add, r in collapsed.values() if not is_add]
         from .delta import apply_delta
 
-        return apply_delta(base, rev, adds, deletes, interner=self.interner)
+        return apply_delta(
+            base, rev, adds, deletes, interner=self.interner,
+            compact_min=self.lsm_compact_min,
+        )
 
     def snapshot_for(self, strategy: Strategy) -> Snapshot:
         """Select (materializing if needed) the snapshot generation a

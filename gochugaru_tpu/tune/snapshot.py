@@ -20,6 +20,9 @@ rules that read them):
 - ``cost``     — per-tier expected dispatch cost (utils/admission.py)
 - ``bytes``    — gathered-bytes model + device-table placement split
 - ``wall``     — last closed wall-ledger window's bucket fractions
+- ``chain``    — write-path delta-chain depth (store/group.py gauges:
+  overlay rows, chain length in revisions, background compactions,
+  batched closure advances) — the lsm_compact_min rule's evidence
 """
 
 from __future__ import annotations
@@ -86,6 +89,7 @@ def collect_snapshot(
         cfg["latency_tiers"] = [int(t) for t in engine_config.latency_tiers]
         cfg["flat_packed"] = engine_config.flat_packed
         cfg["flat_packed_resolved"] = bool(engine_config.packed_on())
+        cfg["lsm_compact_min"] = int(engine_config.lsm_compact_min)
     if serve_config is not None:
         cfg["hold_max_s"] = float(serve_config.hold_max_s)
         cfg["dedup"] = bool(serve_config.dedup)
@@ -143,4 +147,17 @@ def collect_snapshot(
     wall = _perf.last_wall()
     if wall is not None:
         snap["wall"] = dict(wall.get("fracs") or {})
+
+    # write-path chain depth: only present once the compactor (or a
+    # write) has published anything — an all-zero section would make
+    # the lsm_compact_min rule read "no chain" as evidence
+    chain = {
+        "overlay_rows": float(m.gauge("store.lsm_overlay_rows")),
+        "chain_len": float(m.gauge("store.lsm_chain_len")),
+        "bg_compactions": int(m.counter("store.bg_compactions")),
+        "batch_applies": int(m.counter("closure.batch_applies")),
+        "groups": int(m.counter("write.groups")),
+    }
+    if any(chain.values()):
+        snap["chain"] = chain
     return snap

@@ -72,6 +72,15 @@ DEDUP_OFF_FRAC = 0.005
 PACKED_MARGIN = 0.10
 #: default per-device HBM budget the placement rule compares against
 HBM_BUDGET_BYTES = 4 << 30
+#: chain-depth rule: clamp for the host LSM materialization floor
+#: (EngineConfig.lsm_compact_min) and the evidence watermarks it moves
+#: on — raise only after this many background merges in one window,
+#: lower only when a merge-free window left a chain this deep relative
+#: to the floor
+LSM_COMPACT_FLOOR = 4_096
+LSM_COMPACT_CEIL = 1 << 20
+MIN_BG_COMPACTIONS = 4
+CHAIN_DEEP_FRAC = 0.75
 #: routing must shard at least this share of the bytes to be worth a
 #: mesh (membership-dominated snapshots replicate everywhere anyway)
 PLACEMENT_MIN_SHARD_FRAC = 0.25
@@ -472,6 +481,50 @@ def _rule_packed(snap):
     return desired, evidence, {"bytes_per_check_frac": round(rel, 4)}
 
 
+def _rule_lsm_compact(snap):
+    """Move the host LSM materialization floor off chain-depth
+    telemetry (store/group.py ChainCompactor gauges): merge churn means
+    the floor is too low (each merge rewrites the O(E) base), a deep
+    merge-free resident chain means it is too high (every probe pays
+    the chain's extra binary search).  Moves are ×2 / ÷2, clamped —
+    the cache rule's quantization discipline."""
+    cfg = snap.get("config") or {}
+    cm = cfg.get("lsm_compact_min")
+    ch = snap.get("chain") or {}
+    if cm is None or not ch:
+        return None
+    cm = int(cm)
+    rows = float(ch.get("overlay_rows", 0.0))
+    chain_len = float(ch.get("chain_len", 0.0))
+    merges = int(ch.get("bg_compactions", 0))
+    if merges >= MIN_BG_COMPACTIONS and cm < LSM_COMPACT_CEIL:
+        desired = min(cm * 2, LSM_COMPACT_CEIL)
+        evidence = (
+            f"{merges} background chain merges in the window at floor"
+            f" {cm} — each merge rewrites the whole base: doubling the"
+            f" floor to {desired} halves merge frequency while the"
+            " compactor's early trip keeps probe depth bounded"
+        )
+        return desired, evidence, {"bg_compactions": -(merges // 2)}
+    if (
+        merges == 0
+        and rows >= CHAIN_DEEP_FRAC * cm
+        and cm > LSM_COMPACT_FLOOR
+    ):
+        desired = max(cm // 2, LSM_COMPACT_FLOOR)
+        evidence = (
+            f"resident chain at {rows:.0f} overlay rows"
+            f" ({chain_len:.0f} revisions, {rows / cm:.0%} of the {cm}"
+            " floor) with no background merge all window — every probe"
+            " pays the chain's extra binary search; halve the floor to"
+            f" {desired} so compaction lands earlier"
+        )
+        return desired, evidence, {
+            "probe_overlay_rows": round(float(desired) - rows, 1)
+        }
+    return None
+
+
 def _rule_placement(snap, hbm_budget_bytes: int):
     by = snap.get("bytes") or {}
     total = by.get("total")
@@ -541,6 +594,8 @@ def _current_of(snap: Mapping[str, Any], target: Optional[TuneTarget],
         return target.cache_bytes
     if knob == "placement":
         return target.placement
+    if knob == "lsm_compact_min":
+        return int(target.engine.lsm_compact_min)
     raise KeyError(knob)
 
 
@@ -556,6 +611,7 @@ def propose(
     rules = (
         ("latency_tiers", "engine", lambda: _rule_tiers(snapshot)),
         ("flat_packed", "engine", lambda: _rule_packed(snapshot)),
+        ("lsm_compact_min", "engine", lambda: _rule_lsm_compact(snapshot)),
         ("hold_max_s", "serve", lambda: _rule_hold(snapshot)),
         ("dedup", "serve", lambda: _rule_dedup(snapshot)),
         ("cache_max_bytes", "cache", lambda: _rule_cache(snapshot)),
@@ -591,6 +647,8 @@ def apply_diff(target: TuneTarget, diff: TuneDiff) -> TuneTarget:
             )
         elif k.knob == "flat_packed":
             engine = replace(engine, flat_packed=bool(k.proposed))
+        elif k.knob == "lsm_compact_min":
+            engine = replace(engine, lsm_compact_min=int(k.proposed))
         elif k.knob == "hold_max_s":
             serve = replace(serve, hold_max_s=float(k.proposed))
         elif k.knob == "dedup":

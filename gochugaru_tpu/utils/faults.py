@@ -42,6 +42,12 @@ Injection sites threaded through the tree (grep ``faults.fire``):
                              (engine/flat.py sharded builder,
                              engine/partition.py partition_feed)
     closure.delta            incremental closure advance (store/closure.py)
+                             AND the group-commit pre-commit point
+                             (store/store.py write_group: fires after
+                             group formation/collapse, before any state
+                             mutates — an armed fault aborts the whole
+                             group at its base revision with no zookies
+                             minted, and a retry is idempotent)
     device.dispatch          batched check dispatch (engine/device.py)
     lookup.dispatch          frontier-SpMV lookup hop dispatch
                              (engine/spmv.py; the client's lookup

@@ -56,11 +56,15 @@ _LOWER_BETTER_UNITS = {"ms", "s", "seconds", "mb", "mib", "bytes", "gb"}
 #: at 1.0, so any growth is the K-hop fusion regressing to per-hop loops;
 #: ``pad_waste_frac``: bench11's padded-lane share under the tuned config
 #: — the tuner's tier ladder exists to shrink it, so growth means the
-#: ladder rules stopped fitting the workload)
+#: ladder rules stopped fitting the workload;
+#: ``probe_depth_after_compaction``: bench12's residual delta-chain
+#: overlay rows with the background compactor on — growth means the
+#: compactor stopped keeping probe depth bounded and writers are headed
+#: back toward the synchronous O(E) merge)
 _LOWER_BETTER_SUFFIXES = (
     "_ms", "_s", "_latency", "_bytes", "_rss_mb", "pad_fraction",
     "explain_overhead_frac", "decisions_dropped", "dispatches_per_lookup",
-    "pad_waste_frac",
+    "pad_waste_frac", "probe_depth_after_compaction",
 )
 #: suffixes that are HIGHER-better regardless of unit — checked FIRST,
 #: so the perf columns can't be misread by a unit heuristic
@@ -80,10 +84,16 @@ _LOWER_BETTER_SUFFIXES = (
 #: (``tuned_vs_best_preset_goodput`` is bench11's geomean goodput ratio
 #: of the tuned config over the best preset per profile — an "x"
 #: multiplier like fleet scaling; below 1.0 the tuner stopped paying)
+#: (``writes_per_s`` covers bench12's ``writes_per_s`` and
+#: ``committer_writes_per_s`` — write throughput must be read
+#: higher-better even though the raw "_s" suffix would otherwise flag
+#: it as a latency; ``group_size_p50`` is bench12's achieved
+#: writes-per-group median — shrinking groups mean the committer
+#: stopped coalescing and every revision pays its machinery alone)
 _HIGHER_BETTER_SUFFIXES = (
     "achieved_gbps", "roofline_frac", "hit_rate", "dedup_frac",
     "cache_speedup", "mixed_users_rate", "fleet_goodput_scaling",
-    "tuned_vs_best_preset_goodput",
+    "tuned_vs_best_preset_goodput", "writes_per_s", "group_size_p50",
 )
 #: extra fields of a metric line promoted to their own comparison rows
 #: (the perf-attribution columns ride headline rows as extra fields —

@@ -509,6 +509,129 @@ def test_not_ready_replica_drained_without_failover_alarm():
         r0.close()
 
 
+# -- group commit over the wire ---------------------------------------------
+
+
+def test_group_commit_replicates_as_one_entry():
+    """A router.write_group is ONE log entry: every transaction's zookie
+    resolves, but the replica tail sees exactly one applied frame whose
+    revision jumps base→base+k (counted as fleet.group_applies)."""
+    m = _metrics.default
+    router = FleetRouter(config=CFG)
+    _world(router)
+    r0 = _replica(router, "grouped")
+    router.add_replica(r0.host, r0.port, wait_ready_s=5.0)
+    try:
+        ctx = background()
+        applied_before = m.counter("fleet.applied_entries")
+        groups_before = m.counter("fleet.write_groups")
+        gapplies_before = m.counter("fleet.group_applies")
+        base = router.head_revision
+        txns = []
+        for n in range(8):
+            txn = rel.Txn()
+            txn.touch(rel.must_from_triple(f"doc:gc{n}", "reader", "user:gw"))
+            txns.append(txn)
+        zks = router.write_group(ctx, txns)
+        assert not any(isinstance(z, BaseException) for z in zks)
+        # dense zookies base+1..base+8, head at base+8
+        assert [zookie.parse(z) for z in zks] == [base + 1 + i for i in range(8)]
+        assert router.head_revision == base + 8
+        assert m.counter("fleet.write_groups") == groups_before + 1
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and r0.head != router.head_revision:
+            time.sleep(0.02)
+        assert r0.head == router.head_revision
+        # the whole group crossed the wire as ONE applied entry
+        assert m.counter("fleet.applied_entries") == applied_before + 1
+        assert m.counter("fleet.group_applies") == gapplies_before + 1
+        # read-your-writes through the group's last zookie
+        got = router.check(
+            ctx, consistency.min_latency(),
+            rel.must_from_triple("doc:gc7", "read", "user:gw"),
+            zookie=zks[-1],
+        )
+        assert got == [True]
+        # a per-slot ejection stays per-slot across the wire
+        dup = rel.Txn()
+        dup.create(rel.must_from_triple("doc:gc0", "reader", "user:gw"))
+        ok = rel.Txn()
+        ok.touch(rel.must_from_triple("doc:gc8", "reader", "user:gw"))
+        out = router.write_group(ctx, [dup, ok])
+        assert isinstance(out[0], BaseException)
+        assert zookie.parse(out[1]) == base + 9
+    finally:
+        router.close()
+        r0.close()
+
+
+def test_group_commit_replica_kill_replays_without_double_apply():
+    """Replica killed mid-group-stream: groups committed while it is
+    dead replay to a restarted replica from its bootstrap cursor, with
+    full content parity — the dup guard makes redelivery exactly-once
+    even when each redelivered entry spans a whole group."""
+    m = _metrics.default
+    router = FleetRouter(config=CFG)
+    _world(router)
+    r0 = _replica(router, "gk0")
+    router.add_replica(r0.host, r0.port, wait_ready_s=5.0)
+    r0b = None
+    try:
+        ctx = background()
+
+        def _group(tag, k=6):
+            txns = []
+            for n in range(k):
+                txn = rel.Txn()
+                txn.touch(
+                    rel.must_from_triple(f"doc:{tag}{n}", "reader", "user:gk")
+                )
+                txns.append(txn)
+            return txns
+
+        zks = router.write_group(ctx, _group("gka"))
+        assert not any(isinstance(z, BaseException) for z in zks)
+
+        # kill the replica the way the chaos soak does: over the wire
+        conn = fwire.Conn((r0.host, r0.port))
+        with pytest.raises(ConnectionError):
+            conn.request({"op": "kill"})
+        conn.close()
+
+        # two more groups land while no replica is alive to stream them
+        for tag in ("gkb", "gkc"):
+            zks = router.write_group(ctx, _group(tag))
+            assert not any(isinstance(z, BaseException) for z in zks)
+
+        # a restarted replica bootstraps past some groups and tails the
+        # rest; any redelivered prefix must be a no-op (no double-apply)
+        r0b = _replica(router, "gk0b")
+        router.add_replica(r0b.host, r0b.port, wait_ready_s=5.0)
+        deadline = time.monotonic() + 8.0
+        while time.monotonic() < deadline:
+            if r0b.head == router.head_revision:
+                break
+            time.sleep(0.02)
+        assert r0b.head == router.head_revision
+        assert (
+            sorted(map(str, r0b._store.live_relationships()))
+            == sorted(map(str, router.store.live_relationships()))
+        )
+        # group zookies minted before the kill resolve on the rejoined
+        # replica — revision numbering survived the replay
+        got = router.check(
+            ctx, consistency.min_latency(),
+            rel.must_from_triple("doc:gkc5", "read", "user:gk"),
+            zookie=zks[-1],
+        )
+        assert got == [True]
+    finally:
+        router.close()
+        r0.close()
+        if r0b is not None:
+            r0b.close()
+
+
 # -- satellites -------------------------------------------------------------
 
 

@@ -1,0 +1,461 @@
+"""Group-commit write pipeline (store/group.py + Store.write_group):
+collapse semantics, per-transaction ejection, zookie minting, the
+closure.delta fault-atomicity contract, the committer's coalescing
+threads, the background chain compactor, and the client wiring."""
+
+import threading
+import time
+
+import pytest
+
+from gochugaru_tpu import consistency, rel
+from gochugaru_tpu.client import (
+    new_tpu_evaluator,
+    with_engine_config,
+    with_group_commit,
+    with_host_only_evaluation,
+    with_store,
+)
+from gochugaru_tpu.engine.plan import EngineConfig
+from gochugaru_tpu.store.group import (
+    ChainCompactor,
+    GroupCommitConfig,
+    GroupCommitter,
+)
+from gochugaru_tpu.store.store import Store, parse_revision
+from gochugaru_tpu.utils import faults
+from gochugaru_tpu.utils import metrics as _metrics
+from gochugaru_tpu.utils.context import background
+from gochugaru_tpu.utils.errors import (
+    AlreadyExistsError,
+    PreconditionFailedError,
+    RevisionUnavailableError,
+    UnavailableError,
+)
+
+EXAMPLE = """
+definition user {}
+definition document {
+    relation writer: user
+    relation reader: user
+
+    permission edit = writer
+    permission view = reader + edit
+}
+"""
+
+
+@pytest.fixture(autouse=True)
+def _hygiene():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def make_store():
+    s = Store()
+    s.write_schema(EXAMPLE)
+    return s
+
+
+def _touch(doc, relation="reader", user="user:jim"):
+    t = rel.Txn()
+    t.touch(rel.must_from_triple(f"document:{doc}", relation, user))
+    return t
+
+
+# -- Store.write_group semantics --------------------------------------------
+
+
+def test_group_mints_consecutive_zookies_one_log_entry():
+    s = make_store()
+    base = s.head_revision
+    log_len = len(s._log)
+    outcomes = s.write_group([_touch(f"g{i}") for i in range(5)])
+    revs = [parse_revision(o) for o in outcomes]
+    assert revs == [base + 1 + i for i in range(5)]
+    assert s.head_revision == base + 5
+    # the whole group is ONE log entry — that is the point
+    assert len(s._log) == log_len + 1
+    assert len(s) == 5
+
+
+def test_group_matches_sequential_oracle():
+    """Last-writer-wins collapse replays identically to the k sequential
+    transactions it stands for — including in-group supersede and
+    delete-then-recreate orderings."""
+    mk = [
+        _touch("a", user="user:one"),
+        _touch("a", user="user:two"),  # same tuple, later writer wins
+        _touch("b"),
+    ]
+    d = rel.Txn()
+    d.delete(rel.must_from_triple("document:b", "reader", "user:jim"))
+    d.touch(rel.must_from_triple("document:c", "reader", "user:jim"))
+    mk.append(d)
+
+    grouped = make_store()
+    grouped.write_group(mk)
+    oracle = make_store()
+    for t in mk:
+        oracle.write(t)
+    assert (
+        sorted(map(str, grouped.live_relationships()))
+        == sorted(map(str, oracle.live_relationships()))
+    )
+    assert grouped.head_revision == oracle.head_revision
+
+
+def test_group_ejects_create_conflict_against_earlier_member():
+    s = make_store()
+    a = rel.Txn()
+    a.create(rel.must_from_triple("document:x", "reader", "user:jim"))
+    b = rel.Txn()
+    b.create(rel.must_from_triple("document:x", "reader", "user:jim"))
+    c = _touch("y")
+    outcomes = s.write_group([a, b, c])
+    assert isinstance(outcomes[1], AlreadyExistsError)
+    # survivors still mint consecutively: base+1 and base+2
+    assert parse_revision(outcomes[2]) == parse_revision(outcomes[0]) + 1
+    assert s.head_revision == parse_revision(outcomes[2])
+    assert len(s) == 2
+
+
+def test_group_ejects_failed_precondition_only():
+    s = make_store()
+    guard = rel.must_from_triple("document:z", "writer", "user:amy").filter()
+    bad = rel.Txn()
+    bad.must_match(guard)  # nothing matches at base → ejected
+    bad.touch(rel.must_from_triple("document:z", "reader", "user:jim"))
+    good = _touch("ok")
+    outcomes = s.write_group([bad, good])
+    assert isinstance(outcomes[0], PreconditionFailedError)
+    assert parse_revision(outcomes[1]) == s.head_revision
+    assert len(s) == 1
+
+
+def test_group_preconditions_evaluate_at_base():
+    """Preconditions see the group's BASE revision, not earlier members:
+    a must_not_match guard that an earlier member's write would violate
+    still passes, same as if both arrived before either committed."""
+    s = make_store()
+    creator = _touch("pre", user="user:amy")
+    guard = rel.must_from_triple("document:pre", "reader", "user:amy").filter()
+    negated = rel.Txn()
+    negated.must_not_match(guard)
+    negated.touch(rel.must_from_triple("document:other", "reader", "user:jim"))
+    outcomes = s.write_group([creator, negated])
+    assert not any(isinstance(o, BaseException) for o in outcomes)
+    assert len(s) == 2
+
+
+def test_group_fault_atomicity_and_idempotent_retry():
+    """Satellite contract: a closure.delta fault fired mid-group aborts
+    the WHOLE group at its base revision — no zookie minted, no state
+    mutated — and a verbatim retry commits cleanly."""
+    s = make_store()
+    seeded = _touch("seed")
+    s.write(seeded)
+    base = s.head_revision
+    log_len = len(s._log)
+    txns = [_touch(f"f{i}") for i in range(4)]
+    with faults.armed("closure.delta", times=1):
+        with pytest.raises(UnavailableError):
+            s.write_group(txns)
+        # atomic abort: head at base, no log entry, no rows
+        assert s.head_revision == base
+        assert len(s._log) == log_len
+        assert len(s) == 1
+        # retry inside the armed window is idempotent (times=1 spent)
+        outcomes = s.write_group(txns)
+    assert [parse_revision(o) for o in outcomes] == [base + 1 + i for i in range(4)]
+    assert s.head_revision == base + 4
+    assert len(s._log) == log_len + 1
+    assert len(s) == 5
+
+
+def test_mid_group_revision_reads():
+    """Mid-group tokens are real zookies: FULL/AT_LEAST resolve through
+    them, while pinning a SNAPSHOT read to an interior revision raises
+    RevisionUnavailableError like any unmaterialized generation."""
+    s = make_store()
+    outcomes = s.write_group([_touch(f"m{i}") for i in range(3)])
+    mid = outcomes[1]
+    s.snapshot_for(consistency.at_least(str(mid)))  # head covers it
+    with pytest.raises(RevisionUnavailableError):
+        s.snapshot_for(consistency.snapshot(str(mid)))
+    # the group's final revision IS materialized on demand
+    snap = s.snapshot_for(consistency.snapshot(str(outcomes[-1])))
+    assert snap.revision == s.head_revision
+
+
+def test_empty_and_all_ejected_groups_leave_head_alone():
+    s = make_store()
+    base = s.head_revision
+    assert s.write_group([]) == []
+    dup = rel.Txn()
+    dup.create(rel.must_from_triple("document:d", "reader", "user:jim"))
+    s.write(dup)
+    again = rel.Txn()
+    again.create(rel.must_from_triple("document:d", "reader", "user:jim"))
+    outcomes = s.write_group([again])
+    assert isinstance(outcomes[0], AlreadyExistsError)
+    assert s.head_revision == base + 1  # only the seed write advanced it
+
+
+# -- GroupCommitter ----------------------------------------------------------
+
+
+def test_committer_coalesces_and_resolves_every_future():
+    m = _metrics.default
+    s = make_store()
+    groups_before = m.counter("write.groups")
+    txns_before = m.counter("write.txns")
+    gc = GroupCommitter(s, GroupCommitConfig(max_group=8, hold_max_s=0.01))
+    try:
+        futs = [gc.submit(_touch(f"c{i}")) for i in range(20)]
+        revs = [parse_revision(f.result(timeout=5.0)) for f in futs]
+    finally:
+        gc.close()
+    # every submission minted, zookies dense from the store base
+    assert sorted(revs) == list(range(min(revs), min(revs) + 20))
+    assert s.head_revision == max(revs)
+    assert len(s) == 20
+    # coalescing happened: fewer groups than transactions
+    groups = m.counter("write.groups") - groups_before
+    assert m.counter("write.txns") - txns_before == 20
+    assert 1 <= groups < 20
+
+
+def test_committer_ejection_surfaces_on_the_right_future():
+    s = make_store()
+    gc = GroupCommitter(s, GroupCommitConfig(max_group=4, hold_max_s=0.02))
+    try:
+        a = rel.Txn()
+        a.create(rel.must_from_triple("document:e", "reader", "user:jim"))
+        b = rel.Txn()
+        b.create(rel.must_from_triple("document:e", "reader", "user:jim"))
+        fa = gc.submit(a)
+        fb = gc.submit(b)
+        assert parse_revision(fa.result(timeout=5.0)) == s.head_revision
+        with pytest.raises(AlreadyExistsError):
+            fb.result(timeout=5.0)
+    finally:
+        gc.close()
+
+
+def test_committer_group_fault_rejects_all_then_retry_succeeds():
+    s = make_store()
+    gc = GroupCommitter(s, GroupCommitConfig(max_group=4, hold_max_s=0.005))
+    try:
+        base = s.head_revision
+        with faults.armed("closure.delta", times=1):
+            futs = [gc.submit(_touch(f"r{i}")) for i in range(3)]
+            errs = 0
+            for f in futs:
+                try:
+                    f.result(timeout=5.0)
+                except UnavailableError:
+                    errs += 1
+            # the fault killed exactly one formed group; any txn that
+            # missed that group committed in a later clean one
+            assert errs >= 1
+        assert s.head_revision <= base + 3
+        # retry path: resubmit everything, all mint
+        futs = [gc.submit(_touch(f"r{i}")) for i in range(3)]
+        for f in futs:
+            parse_revision(f.result(timeout=5.0))
+        assert len(s) == 3
+    finally:
+        gc.close()
+
+
+def test_committer_close_drains_then_rejects_new_submissions():
+    s = make_store()
+    gc = GroupCommitter(s, GroupCommitConfig(max_group=64, hold_max_s=0.05))
+    futs = [gc.submit(_touch(f"d{i}")) for i in range(5)]
+    gc.close()
+    for f in futs:  # drain flushed the partial group before stopping
+        parse_revision(f.result(timeout=5.0))
+    with pytest.raises(UnavailableError):
+        gc.submit(_touch("late"))
+
+
+def test_committer_perf_section_registered():
+    from gochugaru_tpu.utils import perf as _perf
+
+    s = make_store()
+    gc = GroupCommitter(s, GroupCommitConfig(hold_max_s=0.005))
+    try:
+        gc.submit(_touch("p")).result(timeout=5.0)
+        report = _perf.render_report()
+        wp = report.get("write_path")
+        assert wp is not None
+        assert wp["groups"] >= 1
+        assert set(wp["flush"]) == {"full", "deadline", "maxhold", "drain"}
+        assert "apply_cost" in wp and "chain" in wp
+    finally:
+        gc.close()
+
+
+# -- ChainCompactor ----------------------------------------------------------
+
+
+def test_chain_compactor_bounds_probe_depth():
+    """With a small materialization threshold, the background compactor
+    merges the delta chain before the synchronous trip would, and the
+    overlay restarts from zero — probe depth stays bounded."""
+    m = _metrics.default
+    s = make_store()
+    s.lsm_compact_min = 64  # what EngineConfig.lsm_compact_min threads in
+    cc = ChainCompactor(
+        s, GroupCommitConfig(compact_poll_s=0.0, compact_fraction=0.5)
+    )
+    seed = rel.Txn()
+    for i in range(40):
+        seed.touch(rel.must_from_triple(f"document:s{i}", "reader", "user:u"))
+    s.write(seed)
+    s.snapshot_for(consistency.full())  # base generation
+
+    merges_before = m.counter("store.bg_compactions")
+    compacted = False
+    for n in range(12):
+        s.write_group(
+            [_touch(f"w{n}_{j}", user=f"user:v{j}") for j in range(8)]
+        )
+        s.snapshot_for(consistency.full())  # extends the delta chain
+        if cc.poll_once():
+            compacted = True
+            got = s.peek_chain()
+            assert got is not None and got[1] == 0  # overlay merged away
+    cc.close()
+    assert compacted
+    assert m.counter("store.bg_compactions") > merges_before
+
+
+def test_closure_batch_applies_counter():
+    """A closure advance spanning a multi-revision group counts one
+    closure.batch_applies — the telemetry that proves k writes paid ONE
+    advance (the revision span is the group: base+1..base+k, one delta)."""
+    import numpy as np
+
+    from gochugaru_tpu.schema import compile_schema, parse_schema
+    from gochugaru_tpu.store.closure import (
+        advance_closure,
+        build_closure,
+        build_closure_state,
+    )
+    from gochugaru_tpu.store.interner import Interner
+    from gochugaru_tpu.store.snapshot import build_snapshot
+
+    m = _metrics.default
+    schema = """
+definition user {}
+definition group { relation member: user | group#member }
+definition doc {
+    relation reader: user | group#member
+    permission view = reader
+}
+"""
+    cs = compile_schema(parse_schema(schema))
+    interner = Interner()
+    from gochugaru_tpu.rel.relationship import Relationship
+
+    def _r(res, rl, subj, srel=""):
+        rt, rid = res.split(":")
+        st, sid = subj.split(":")
+        return Relationship(
+            resource_type=rt, resource_id=rid, resource_relation=rl,
+            subject_type=st, subject_id=sid, subject_relation=srel,
+            caveat_name="", caveat_context={}, expiration=None,
+        )
+
+    rels = [
+        _r("group:g0", "member", "user:u0"),
+        _r("doc:d", "reader", "group:g0", "member"),
+    ]
+    snap = build_snapshot(1, cs, interner, rels, epoch_us=1_700_000_000_000_000)
+    st = build_closure_state(snap, build_closure(snap))
+    S1 = snap.num_slots + 1
+    member = cs.slot_of_name["member"]
+    u1 = interner.lookup("user", "u1")
+    g0 = interner.lookup("group", "g0")
+    before_batch = m.counter("closure.batch_applies")
+    before_delta = m.counter("closure.delta_applies")
+    # ONE advance spanning revisions 1→7: a group of 6 writes collapsed
+    got = advance_closure(
+        st, 7,
+        seed_add=(np.array([u1 * S1]), np.array([g0 * S1 + member + 1]),
+                  np.array([0], np.int32), np.array([0], np.int32)),
+    )
+    assert got is not None
+    assert m.counter("closure.delta_applies") == before_delta + 1
+    assert m.counter("closure.batch_applies") == before_batch + 1
+    # a single-revision advance does NOT count as a batch
+    u2 = interner.lookup("user", "u2")
+    got = advance_closure(
+        got.state, 8,
+        seed_add=(np.array([u2 * S1]), np.array([g0 * S1 + member + 1]),
+                  np.array([0], np.int32), np.array([0], np.int32)),
+    )
+    assert got is not None
+    assert m.counter("closure.batch_applies") == before_batch + 1
+
+
+# -- client wiring -----------------------------------------------------------
+
+
+def test_client_group_commit_option_routes_writes():
+    c = new_tpu_evaluator(
+        with_store(make_store()),
+        with_host_only_evaluation(),
+        with_group_commit(GroupCommitConfig(max_group=8, hold_max_s=0.005)),
+    )
+    assert c._committer is not None and c._compactor is not None
+    ctx = background()
+    base = c._store.head_revision
+    zks = [c.write(ctx, _touch(f"cw{i}")) for i in range(4)]
+    assert [parse_revision(z) for z in zks] == [base + 1 + i for i in range(4)]
+    q = rel.must_from_triple("document:cw0", "view", "user:jim")
+    assert c.check(ctx, consistency.full(), q) == [True]
+
+
+def test_client_threads_lsm_compact_min_into_store():
+    cfg = EngineConfig(lsm_compact_min=12_345)
+    c = new_tpu_evaluator(
+        with_store(make_store()),
+        with_host_only_evaluation(),
+        with_engine_config(cfg),
+    )
+    assert c._store.lsm_compact_min == 12_345
+
+
+def test_concurrent_writers_through_one_committer():
+    """16 threads × 8 writes each: every zookie unique and dense, store
+    content matches, and the group-size histogram saw multi-txn groups."""
+    s = make_store()
+    gc = GroupCommitter(s, GroupCommitConfig(max_group=32, hold_max_s=0.002))
+    revs = []
+    lock = threading.Lock()
+    errs = []
+
+    def worker(w):
+        try:
+            for j in range(8):
+                zk = gc.write(_touch(f"t{w}_{j}", user=f"user:w{w}"))
+                with lock:
+                    revs.append(parse_revision(zk))
+        except Exception as e:  # pragma: no cover - failure detail
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    gc.close()
+    assert not errs
+    assert len(revs) == 128
+    assert sorted(revs) == list(range(min(revs), min(revs) + 128))
+    assert s.head_revision == max(revs)
+    assert len(s) == 128
