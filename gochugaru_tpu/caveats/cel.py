@@ -14,9 +14,10 @@ vectorizes onto TPU):
   arithmetic (ts − ts = dur, ts ± dur = ts, dur ± dur = dur); context
   parameters DECLARED as ``timestamp``/``duration`` coerce from RFC 3339
   / CEL duration strings (or datetimes / numeric seconds) at evaluation
-  time.  The device VM declines these constructs (``_HostOnly``), so
-  caveats using them evaluate on the host path — per ROADMAP, host
-  first; a typed device lowering can follow
+  time.  Params DECLARED timestamp/duration and folded time literals
+  also lower onto the device as exact-µs i32 limb pairs
+  (caveats/device.py); only the dynamic constructor form
+  (``timestamp(x)`` over a non-literal) stays host-only
 
 Evaluation is three-valued: a missing context parameter makes the result
 UNKNOWN rather than an error — SpiceDB's CONDITIONAL permissionship — and

@@ -13,8 +13,25 @@ Design:
 - **Static typing.**  CEL is dynamically typed, but caveat declarations
   carry parameter types (``caveat c(a int, b string)``), so the whole tree
   types statically: int/uint → i32, bool → tri-state i32, double → f32,
-  string → interned i32 id.  Anything outside that (timestamps, lists,
-  maps, ``any``, member access) marks the caveat host-only.
+  string → interned i32 id, timestamp/duration → a two-limb i32 pair of
+  epoch/signed microseconds (see below).  Anything outside that (lists,
+  maps, ``any``, member access, dynamic ``timestamp(x)`` construction)
+  marks the caveat host-only.
+
+- **Time as i32 limb pairs.**  The host evaluates the CEL time algebra
+  in exact integer microseconds (cel.py Timestamp/Duration); the year
+  9999 is ≈2^57.8 µs, far outside i32, and this build keeps jax x64
+  disabled.  So a time value rides in TWO i32 lanes:
+  ``us = hi·2^30 + lo`` with ``lo ∈ [0, 2^30)`` canonical.  Add/sub
+  work limb-wise with one arithmetic-shift carry normalization
+  (``lo >> 30`` floors for negatives, so the pair stays canonical);
+  ordered compares are lexicographic on (hi, lo), exact because lo is
+  non-negative.  Every operation is integer-exact — no f64 round-trip —
+  so device results are bitwise the host's.  The same interval analysis
+  that bounds int arithmetic bounds the time algebra: every
+  intermediate must stay under 2^58 µs (canonical ``|hi| ≤ 2^28``, so a
+  limb-wise add can never overflow i32), with a per-caveat bound ladder
+  and encode-time eviction to the host flag beyond it.
 
 - **Tri-state Kleene logic.**  Results are 0=FALSE, 1=UNKNOWN, 2=TRUE in
   i32; ``or``=max, ``and``=min, ``not``=2-x — the same encoding the host
@@ -35,18 +52,38 @@ Design:
 
 from __future__ import annotations
 
+import datetime as _dt
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..schema.compiler import CompiledSchema
-from .cel import CelCompileError, CelProgram, compile_cel
+from .cel import (
+    CelCompileError,
+    CelProgram,
+    Duration,
+    Timestamp,
+    _TimeValue,
+    compile_cel,
+    parse_duration,
+    parse_timestamp,
+)
 
 F, U, T = 0, 1, 2
 I32_MAX = 2**31 - 1
 #: ints exactly representable in f32
 F32_EXACT_INT = 2**24
+
+#: time limb split: us = hi * 2^30 + lo with lo ∈ [0, 2^30) canonical.
+#: 30 bits keeps a limb-wise add of two canonical los < 2^31 (no i32
+#: wrap) while hi spans ±2^28 at the 2^58-µs intermediate ceiling.
+TIME_RADIX_BITS = 30
+TIME_LO_MASK = (1 << TIME_RADIX_BITS) - 1
+#: max |µs| any intermediate time value may reach on device: canonical
+#: |hi| ≤ 2^28, so one un-normalized add stays far inside i32
+TIME_MAX_US = 1 << 58
+_TIMED_KINDS = ("timestamp", "duration")
 
 
 class _HostOnly(Exception):
@@ -58,6 +95,7 @@ class _HostOnly(Exception):
 #   int   → (i32 value, bool known)
 #   double→ (f32 value, bool known)
 #   string→ (i32 id, bool known)
+#   timestamp/duration → ((i32 hi, i32 lo), bool known) µs limb pair
 _VALUE_KINDS = ("int", "double", "string")
 
 
@@ -88,6 +126,8 @@ class CaveatDevicePlan:
     host_only: np.ndarray  # bool[C+1]
     #: per caveat id: max |int| context value evaluable on device
     int_bound: np.ndarray  # int64[C+1]
+    #: per caveat id: max |µs| context time value evaluable on device
+    time_bound: np.ndarray  # int64[C+1]
     #: caveat id → traced (vi, vf, present) → tri; operates on [..., P]
     programs: Dict[int, Callable]
     #: string literal pool (extended by snapshot contexts)
@@ -101,7 +141,8 @@ class CaveatDevicePlan:
 
 
 _DEVICE_PARAM_TYPES = {"int": "int", "uint": "int", "double": "double",
-                       "bool": "bool", "string": "string"}
+                       "bool": "bool", "string": "string",
+                       "timestamp": "timestamp", "duration": "duration"}
 
 
 def _base_type(ptype: str) -> str:
@@ -172,9 +213,73 @@ def _arith_safe(ast, types: Dict[str, str], bound: int) -> bool:
     return not state["ovf"]
 
 
+def _time_extent(node, types: Dict[str, str], bound: int,
+                 state: Dict[str, bool]) -> int:
+    """Max |µs| of a time-typed node with every timed context value
+    bounded by ``bound`` µs in magnitude; 0 for non-time nodes.  Sets
+    ``state['tovf']`` when any time arithmetic node can exceed the 2^58
+    intermediate ceiling, and ``state['tarith']`` when the tree does any
+    time arithmetic at all (no arithmetic ⇒ compares only ⇒ no bound
+    needed beyond the limb representation itself)."""
+    op = node[0]
+    if op == "lit":
+        v = node[1]
+        return abs(v.us) if isinstance(v, _TimeValue) else 0
+    if op == "var":
+        return bound if types.get(node[1]) in _TIMED_KINDS else 0
+    if op == "neg":
+        return _time_extent(node[1], types, bound, state)
+    if op == "arith":
+        a = _time_extent(node[2], types, bound, state)
+        b = _time_extent(node[3], types, bound, state)
+        if a == 0 and b == 0:
+            return 0
+        state["tarith"] = True
+        m = a + b  # only ± reach the device lowering for timed operands
+        if m >= TIME_MAX_US:
+            state["tovf"] = True
+        return m
+    if op == "cond":
+        _time_extent(node[1], types, bound, state)
+        return max(
+            _time_extent(node[2], types, bound, state),
+            _time_extent(node[3], types, bound, state),
+        )
+    if op == "not":
+        _time_extent(node[1], types, bound, state)
+        return 0
+    if op in ("or", "and", "in"):
+        _time_extent(node[1], types, bound, state)
+        _time_extent(node[2], types, bound, state)
+        return 0
+    if op == "cmp":
+        _time_extent(node[2], types, bound, state)
+        _time_extent(node[3], types, bound, state)
+        return 0
+    if op == "list":
+        for it in node[1]:
+            _time_extent(it, types, bound, state)
+        return 0
+    return 0
+
+
+def _time_safe(ast, types: Dict[str, str], bound: int) -> bool:
+    state: Dict[str, bool] = {"tovf": False}
+    _time_extent(ast, types, bound, state)
+    return not state["tovf"]
+
+
 # ---------------------------------------------------------------------------
 # AST → JAX lowering
 # ---------------------------------------------------------------------------
+
+
+def _time_norm(hi, lo, jnp):
+    """Re-canonicalize a µs limb pair after a limb-wise ±: the shift is
+    arithmetic, so the carry floors and lo lands back in [0, 2^30) for
+    negative sums too."""
+    carry = lo >> TIME_RADIX_BITS
+    return hi + carry, lo & jnp.int32(TIME_LO_MASK)
 
 
 def _lower_program(
@@ -213,6 +318,15 @@ def _lower_program(
             v = node[1]
             if isinstance(v, bool):
                 return "bool", lambda vi, vf, pr, t=(T if v else F): jnp.int32(t)
+            if isinstance(v, _TimeValue):
+                # timestamp("...")/duration("...") literals folded at parse
+                # time; split into canonical µs limbs here
+                if abs(v.us) >= TIME_MAX_US:
+                    raise _HostOnly("time literal out of device range")
+                hi, lo = v.us >> TIME_RADIX_BITS, v.us & TIME_LO_MASK
+                kind = "timestamp" if isinstance(v, Timestamp) else "duration"
+                return kind, lambda vi, vf, pr, h=hi, l=lo: (
+                    (jnp.int32(h), jnp.int32(l)), jnp.bool_(True))
             if isinstance(v, int):
                 if abs(v) >= I32_MAX:
                     raise _HostOnly("int literal out of i32 range")
@@ -240,6 +354,10 @@ def _lower_program(
                 return "bool", emit_b
             if kind == "double":
                 return "double", lambda vi, vf, pr, s=s: (vf[..., s], pr[..., s])
+            if kind in _TIMED_KINDS:
+                # two consecutive i32 slots: hi at s, lo at s + 1
+                return kind, lambda vi, vf, pr, s=s: (
+                    (vi[..., s], vi[..., s + 1]), pr[..., s])
             return kind, lambda vi, vf, pr, s=s: (vi[..., s], pr[..., s])
         if op == "not":
             k, e = lower(node[1])
@@ -254,6 +372,12 @@ def _lower_program(
             if k == "double":
                 return "double", lambda vi, vf, pr: (
                     lambda v: (-v[0], v[1]))(e(vi, vf, pr))
+            if k == "duration":
+                def emit_nd(vi, vf, pr):
+                    (hi, lo), kn = e(vi, vf, pr)
+                    return _time_norm(-hi, -lo, jnp), kn
+                return "duration", emit_nd
+            # -timestamp is a host TypeError too
             raise _HostOnly("unary - on non-numeric")
         if op in ("or", "and"):
             ka, ea = lower(node[1])
@@ -282,7 +406,11 @@ def _lower_program(
                 c = ec(vi, vf, pr)
                 tv, tk = et(vi, vf, pr)
                 fv, fk = ef(vi, vf, pr)
-                val = jnp.where(c == T, tv, fv)
+                if isinstance(tv, tuple):  # timed: select per limb
+                    val = (jnp.where(c == T, tv[0], fv[0]),
+                           jnp.where(c == T, tv[1], fv[1]))
+                else:
+                    val = jnp.where(c == T, tv, fv)
                 known = (c != U) & jnp.where(c == T, tk, fk)
                 return val, known
             return kt, emit_cv
@@ -305,6 +433,31 @@ def _lower_program(
                 return "bool", emit_bb
             if ka == "bool" or kb == "bool":
                 raise _HostOnly("comparison mixes bool and value")
+            if ka in _TIMED_KINDS or kb in _TIMED_KINDS:
+                if ka != kb:
+                    # cross-kind == is a constant False on the host and
+                    # ordered compares are a host TypeError; neither is
+                    # worth a device lowering
+                    raise _HostOnly("comparison mixes time and non-time")
+
+                def emit_tc(vi, vf, pr, o=o):
+                    (ah, al), akn = ea(vi, vf, pr)
+                    (bh, bl), bkn = eb(vi, vf, pr)
+                    # canonical lo ≥ 0, so (hi, lo) orders lexicographically
+                    if o == "==":
+                        raw = (ah == bh) & (al == bl)
+                    elif o == "!=":
+                        raw = (ah != bh) | (al != bl)
+                    elif o in ("<", "<="):
+                        tie = (al < bl) if o == "<" else (al <= bl)
+                        raw = (ah < bh) | ((ah == bh) & tie)
+                    else:
+                        tie = (al > bl) if o == ">" else (al >= bl)
+                        raw = (ah > bh) | ((ah == bh) & tie)
+                    return jnp.where(
+                        akn & bkn, jnp.where(raw, T, F), U
+                    ).astype(jnp.int32)
+                return "bool", emit_tc
             if ka == "string" or kb == "string":
                 if ka != kb:
                     raise _HostOnly("comparison mixes string and numeric")
@@ -343,6 +496,30 @@ def _lower_program(
             o = node[1]
             ka, ea = lower(node[2])
             kb, eb = lower(node[3])
+            if ka in _TIMED_KINDS or kb in _TIMED_KINDS:
+                # the CEL time algebra: ts − ts = dur, ts ± dur = ts,
+                # dur ± dur = dur.  Everything else (ts + ts, *, /, %,
+                # time mixed with numerics) is a host TypeError.
+                if o == "+" and (ka, kb) in (
+                    ("timestamp", "duration"), ("duration", "timestamp")
+                ):
+                    res = "timestamp"
+                elif o == "-" and (ka, kb) == ("timestamp", "timestamp"):
+                    res = "duration"
+                elif o == "-" and (ka, kb) == ("timestamp", "duration"):
+                    res = "timestamp"
+                elif o in ("+", "-") and (ka, kb) == ("duration", "duration"):
+                    res = "duration"
+                else:
+                    raise _HostOnly("time arithmetic outside the CEL algebra")
+
+                def emit_ta(vi, vf, pr, sub=(o == "-")):
+                    (ah, al), akn = ea(vi, vf, pr)
+                    (bh, bl), bkn = eb(vi, vf, pr)
+                    if sub:
+                        bh, bl = -bh, -bl
+                    return _time_norm(ah + bh, al + bl, jnp), akn & bkn
+                return res, emit_ta
             if ka != "int" or kb != "int":
                 # device arithmetic is int-only; float arithmetic would
                 # round differently from the host's f64
@@ -372,7 +549,7 @@ def _lower_program(
             return "int", emit_ar
         if op == "in":
             ka, ea = lower(node[1])
-            if ka not in _VALUE_KINDS:
+            if ka not in _VALUE_KINDS + _TIMED_KINDS:
                 raise _HostOnly("'in' on non-value")
             if node[2][0] != "list":
                 raise _HostOnly("'in' target not a list literal")
@@ -389,9 +566,12 @@ def _lower_program(
                 kn = akn
                 for _, ee in elems:
                     ev, ekn = ee(vi, vf, pr)
-                    if ka == "double":
-                        ev = jnp.asarray(ev).astype(jnp.float32)
-                    hit = hit | (av == ev)
+                    if isinstance(av, tuple):  # timed: equal limb pairs
+                        hit = hit | ((av[0] == ev[0]) & (av[1] == ev[1]))
+                    else:
+                        if ka == "double":
+                            ev = jnp.asarray(ev).astype(jnp.float32)
+                        hit = hit | (av == ev)
                     kn = kn & ekn
                 return jnp.where(kn, jnp.where(hit, T, F), U).astype(jnp.int32)
             return "bool", emit_in
@@ -413,6 +593,10 @@ def _lower_program(
 # ---------------------------------------------------------------------------
 
 _INT_BOUNDS = (2**30, 2**20, 2**16, 2**12, 2**8, 2**4)
+#: time context-value bound ladder (µs): 2^57 keeps `ts ± dur` chains of
+#: two inside the 2^58 intermediate ceiling while covering year 9999
+#: contexts (≈2^57.8) via the no-arithmetic fast path above the ladder
+_TIME_BOUNDS = (2**57, 2**52, 2**46, 2**40)
 
 
 def build_caveat_plan(compiled: CompiledSchema) -> CaveatDevicePlan:
@@ -436,10 +620,16 @@ def build_caveat_plan(compiled: CompiledSchema) -> CaveatDevicePlan:
             slot = len(slot_type)
             slot_of[(name, pname)] = slot
             slot_type.append(dt)
+            if dt in _TIMED_KINDS:
+                # companion lo limb rides in the next slot; it is never
+                # listed in slots_of_param — the encoder fills both limbs
+                # when it visits the primary slot
+                slot_type.append("time_lo")
             slots_of_param.setdefault(pname, []).append((cid, slot))
 
     host_only = np.zeros(C + 1, bool)
     int_bound = np.full(C + 1, I32_MAX - 1, np.int64)
+    time_bound = np.full(C + 1, TIME_MAX_US - 1, np.int64)
     programs: Dict[int, Callable] = {}
     base_strings: Dict[str, int] = {}
 
@@ -472,6 +662,21 @@ def build_caveat_plan(compiled: CompiledSchema) -> CaveatDevicePlan:
         if not _ast_has_arith(prog.ast) and not promoted:
             chosen = I32_MAX - 1
         int_bound[cid] = chosen
+
+        # same ladder for time values: pick the largest µs bound under
+        # which no ± chain can exceed the 2^58 intermediate ceiling.
+        # Compares alone can't overflow, so keep the full range then.
+        tstate: Dict[str, bool] = {"tovf": False}
+        _time_extent(prog.ast, types, _TIME_BOUNDS[0], tstate)
+        if tstate.get("tarith"):
+            tchosen = next(
+                (b for b in _TIME_BOUNDS if _time_safe(prog.ast, types, b)),
+                None,
+            )
+            if tchosen is None:
+                host_only[cid] = True
+                continue
+            time_bound[cid] = tchosen
         programs[cid] = fn
 
     return CaveatDevicePlan(
@@ -482,6 +687,7 @@ def build_caveat_plan(compiled: CompiledSchema) -> CaveatDevicePlan:
         slots_of_param=slots_of_param,
         host_only=host_only,
         int_bound=int_bound,
+        time_bound=time_bound,
         programs=programs,
         base_strings=base_strings,
         caveat_params=caveat_params,
@@ -502,6 +708,40 @@ def _ast_has_arith(ast) -> bool:
 # ---------------------------------------------------------------------------
 # context encoding
 # ---------------------------------------------------------------------------
+
+
+def _time_us(base: str, v: Any) -> Optional[int]:
+    """Mirror of CelProgram._coerced for one value: µs for anything the
+    host would coerce into the declared timestamp/duration type, None
+    for anything it would reject (the caller sets the host flag, and the
+    host path raises exactly as before this lowering existed)."""
+    if isinstance(v, bool):
+        return None
+    if base == "timestamp":
+        if isinstance(v, Timestamp):
+            return v.us
+        if isinstance(v, _dt.datetime):
+            return round(v.timestamp() * 1_000_000)
+        if isinstance(v, str):
+            try:
+                return parse_timestamp(v).us
+            except CelCompileError:
+                return None
+        if isinstance(v, (int, float)):
+            return round(v * 1_000_000)
+        return None
+    if isinstance(v, Duration):
+        return v.us
+    if isinstance(v, _dt.timedelta):
+        return round(v.total_seconds() * 1_000_000)
+    if isinstance(v, str):
+        try:
+            return parse_duration(v).us
+        except CelCompileError:
+            return None
+    if isinstance(v, (int, float)):
+        return round(v * 1_000_000)
+    return None
 
 
 def encode_contexts(
@@ -564,6 +804,14 @@ def encode_contexts(
                         host[i, cid] = True
                         continue
                     vf[i, slot] = f
+                elif st in _TIMED_KINDS:
+                    us = _time_us(st, value)
+                    if us is None or abs(us) > plan.time_bound[cid]:
+                        host[i, cid] = True
+                        continue
+                    vi[i, slot] = us >> TIME_RADIX_BITS
+                    vi[i, slot + 1] = us & TIME_LO_MASK
+                    present[i, slot + 1] = True
                 elif st == "bool":
                     if not isinstance(value, bool):
                         host[i, cid] = True

@@ -57,6 +57,7 @@ from ..utils import trace as _trace
 from ..utils.context import background as _background
 from ..utils.errors import classify_dispatch_exception
 from ..utils.retry import retry_retriable_errors
+from . import pallas as _pallas
 from .plan import DevicePlan, EngineConfig, build_plan
 
 #: edge-count floor for the prepare-time lookup-index prewarm thread:
@@ -889,6 +890,11 @@ class DeviceEngine:
         # per-table) for this snapshot — the roofline numerator rides
         # /metrics and incident bundles from the moment of prepare
         _perf.publish_model(dsnap)
+        if _pallas.resolve(self.config):
+            # Pallas backend armed: publish what its kernels keep
+            # VMEM-resident and the modeled one-pass bytes delta
+            _pallas.publish_vmem(arrays)
+            _perf.publish_pallas_model(dsnap)
         return dsnap
 
     @staticmethod
@@ -1447,6 +1453,11 @@ class DeviceEngine:
             z = np.zeros(0, bool)
             return z, z, z
         faults.fire("device.dispatch")
+        if _pallas.resolve(self.config):
+            # pallas-path failures classify through the SAME retry
+            # envelope as any dispatch: the chaos soak arms this site to
+            # prove the fused-kernel path reroutes like the XLA one
+            faults.fire("pallas.dispatch")
         import time as _time
 
         t_lower = _time.perf_counter()
@@ -1652,6 +1663,8 @@ class DeviceEngine:
         subsequent dispatch on remote-attached platforms.
         """
         faults.fire("device.dispatch")
+        if _pallas.resolve(self.config):
+            faults.fire("pallas.dispatch")
         snap = dsnap.snapshot
         B = q_res.shape[0]
         BP = _ceil_pow2(B, max(bucket_min, self.config.batch_bucket_min))

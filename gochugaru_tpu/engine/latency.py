@@ -62,6 +62,7 @@ from ..utils import faults
 from ..utils import metrics as _metrics
 from ..utils import perf as _perf
 from ..utils import trace as _trace
+from . import pallas as _pallas
 from .flat import QM_ROWS, fill_qm
 
 
@@ -337,6 +338,11 @@ class LatencyPath:
         # injection site AFTER the availability checks: a batch this path
         # would decline falls back without ever reaching the fault
         faults.fire("latency.dispatch")
+        if _pallas.resolve(self.engine.config):
+            # the pinned kernels run the fused Pallas probes when the
+            # knob resolves on — a pallas-path fault here classifies and
+            # reroutes exactly like a latency-path one (breaker re-form)
+            faults.fire("pallas.dispatch")
 
         # ---- stage 1: host lowering (pack into the staging buffer) -----
         # the staging buffer is shared per tier: hold the path lock from

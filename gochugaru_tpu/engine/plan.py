@@ -271,6 +271,19 @@ class EngineConfig:
     #: candidate-buffer capacity of one fused dispatch; answers larger
     #: than this overflow to the looped (streaming) path
     spmm_candidates: int = 8_192
+    # -- Pallas fused probe backend (engine/pallas.py) -------------------
+    #: serve the bucket probes (check direct/T/closure/userset sites and
+    #: the frontier run probes) through the hand-fused Pallas kernel:
+    #: hash → offset → double-buffered bucket DMA → packed decode → gate
+    #: → reduce in ONE HBM pass per table, offsets/ladders VMEM-resident.
+    #: None = auto: on for TPU when jax.experimental.pallas is available,
+    #: off elsewhere.  False is the parity oracle — the XLA gather chain,
+    #: byte-for-byte (the spmm=False / flat_packed=False-style lever).
+    #: True forces the kernels even off-TPU (tests: Pallas INTERPRET
+    #: mode under JAX_PLATFORMS=cpu — correctness, not speed); a jaxlib
+    #: without Pallas degrades True/auto to the XLA path with a single
+    #: counted warning, never an ImportError
+    pallas: Optional[bool] = None
 
     @staticmethod
     def for_schema(compiled: CompiledSchema, **overrides) -> "EngineConfig":

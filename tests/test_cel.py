@@ -153,10 +153,12 @@ def test_duration_literal_strictness():
            {"at": "timestamp"}, {"at": False})
 
 
-def test_timestamp_caveat_declines_device_lowering():
-    """Caveats computing with timestamps stay host-only: the device VM
-    must decline them (ROADMAP: host first), so a schema carrying one
-    still serves — the caveat resolves through the host oracle."""
+def test_timestamp_caveat_lowers_on_device():
+    """Caveats computing with timestamps lower to the typed i64-µs
+    device VM (round 25 closed the carried ROADMAP item — this test
+    used to pin the host-first decline); only dynamic constructors
+    over non-literal arguments still resolve through the host
+    oracle (tests/test_device_caveats.py)."""
     from gochugaru_tpu.caveats.device import build_caveat_plan
     from gochugaru_tpu.schema import compile_schema, parse_schema
 
@@ -171,4 +173,5 @@ def test_timestamp_caveat_declines_device_lowering():
     }
     """))
     plan = build_caveat_plan(cs)
-    assert not plan.has_device_programs
+    assert plan.has_device_programs
+    assert not plan.host_only[cs.caveat_ids["not_expired"]]

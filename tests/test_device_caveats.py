@@ -463,3 +463,238 @@ def test_modulo_truncates_toward_zero_host_and_device_agree():
     ]
     d, p, _ = run_and_compare(engine, dsnap, oracle, checks)
     assert list(d) == [False, True, True, False]
+
+
+# ---------------------------------------------------------------------------
+# timestamp / duration device lowering (exact-µs i32 limb pairs)
+# ---------------------------------------------------------------------------
+
+SCHEMA_TIME = """
+caveat before_expiry(access_at timestamp, expires_at timestamp) {
+  access_at < expires_at
+}
+caveat in_window(at timestamp, start timestamp, grace duration) {
+  at >= start && at < start + grace
+}
+caveat long_enough(d duration, lim duration) {
+  d - lim >= duration("0s") || d == duration("90m")
+}
+caveat fancy(at timestamp, g duration) {
+  (at > timestamp("2024-06-01T00:00:00Z") ? at - g : at + g)
+    <= timestamp("2030-01-01T00:00:00Z")
+}
+definition user {}
+definition doc {
+    relation viewer: user with before_expiry | user with in_window | user with long_enough | user with fancy
+    permission view = viewer
+}
+"""
+
+
+def test_time_caveats_lower_on_device_not_host_only():
+    """The Timestamp/Duration algebra (compare, ts±dur, ts−ts, dur±dur,
+    folded constructor literals) lowers onto the device as exact-µs i32
+    limb pairs — none of these caveats may fall back to _HostOnly."""
+    from gochugaru_tpu.caveats.device import TIME_MAX_US
+
+    cs = compile_schema(parse_schema(SCHEMA_TIME))
+    plan = build_caveat_plan(cs)
+    for name, cid in cs.caveat_ids.items():
+        assert not plan.host_only[cid], f"{name} leaked to host-only"
+        assert 0 < plan.time_bound[cid] < TIME_MAX_US, name
+    # each timed param owns TWO slots (hi + lo companion)
+    timed = sum(t in ("timestamp", "duration") for t in plan.slot_type)
+    lo = sum(t == "time_lo" for t in plan.slot_type)
+    assert timed == 9 and lo == 9, (timed, lo)
+
+
+def test_dynamic_timestamp_constructor_stays_host_only():
+    """Only literal constructor forms fold; ``timestamp(x)`` over a
+    string param is the documented host-only remainder."""
+    cs = compile_schema(parse_schema("""
+    caveat dyn(x string) { timestamp(x) < timestamp("2030-01-01T00:00:00Z") }
+    definition user {}
+    definition doc {
+        relation viewer: user with dyn
+        permission view = viewer
+    }
+    """))
+    plan = build_caveat_plan(cs)
+    assert plan.host_only[cs.caveat_ids["dyn"]]
+
+
+def test_time_engine_differential_mixed_coercions():
+    """Stored + query contexts in every accepted spelling (Timestamp,
+    ISO-8601 string, numeric seconds, Duration, '90m' strings) must give
+    device answers equal to the host oracle, with now_us pinned."""
+    from gochugaru_tpu.caveats.cel import Duration, Timestamp
+
+    day = 86_400_000_000
+    rels = [
+        rel.must_from_triple("doc:a", "viewer", "user:u1").with_caveat(
+            "before_expiry", {"expires_at": Timestamp(NOW + day)}
+        ),
+        rel.must_from_triple("doc:b", "viewer", "user:u1").with_caveat(
+            "in_window",
+            {"start": "2023-11-14T00:00:00Z", "grace": "48h"},
+        ),
+        rel.must_from_triple("doc:c", "viewer", "user:u1").with_caveat(
+            "long_enough", {"lim": Duration(30 * 60 * 1_000_000)}
+        ),
+        rel.must_from_triple("doc:d", "viewer", "user:u1").with_caveat(
+            "fancy", {"g": "1h30m"}
+        ),
+    ]
+    _, engine, dsnap, oracle = world(SCHEMA_TIME, rels)
+    checks = [
+        rel.must_from_triple("doc:a", "view", "user:u1").with_caveat(
+            "", {"access_at": NOW / 1e6}  # numeric seconds
+        ),
+        rel.must_from_triple("doc:a", "view", "user:u1").with_caveat(
+            "", {"access_at": Timestamp(NOW + 2 * day)}  # past expiry
+        ),
+        rel.must_from_triple("doc:b", "view", "user:u1").with_caveat(
+            "", {"at": "2023-11-15T12:00:00Z"}  # inside the 48h window
+        ),
+        rel.must_from_triple("doc:b", "view", "user:u1").with_caveat(
+            "", {"at": "2023-11-17T00:00:00Z"}  # past it
+        ),
+        rel.must_from_triple("doc:c", "view", "user:u1").with_caveat(
+            "", {"d": "45m"}
+        ),
+        rel.must_from_triple("doc:c", "view", "user:u1").with_caveat(
+            "", {"d": Duration(90 * 60 * 1_000_000)}  # == escape hatch
+        ),
+        rel.must_from_triple("doc:c", "view", "user:u1").with_caveat(
+            "", {"d": "10m"}
+        ),
+        rel.must_from_triple("doc:d", "view", "user:u1").with_caveat(
+            "", {"at": Timestamp(NOW)}
+        ),
+        rel.must_from_triple("doc:d", "view", "user:u1").with_caveat("", {}),
+    ]
+    d, p, _ = run_and_compare(engine, dsnap, oracle, checks)
+    assert list(d) == [True, False, True, False, True, True, False, True,
+                       False]
+    # the missing-context row is conditional, not denied
+    assert bool(p[8]) and not bool(d[8])
+
+
+def test_time_out_of_bound_or_uncoercible_falls_back_not_wrong():
+    """A µs magnitude past the caveat's proven bound — or a value the
+    coercion table rejects — must surface as possible&~definite (host
+    fallback), never as a wrong definite."""
+    from gochugaru_tpu.caveats.cel import Timestamp
+
+    rels = [
+        rel.must_from_triple("doc:a", "viewer", "user:u1").with_caveat(
+            "before_expiry", {"expires_at": Timestamp(NOW)}
+        ),
+    ]
+    from gochugaru_tpu.caveats.cel import Timestamp
+
+    _, engine, dsnap, oracle = world(SCHEMA_TIME, rels)
+    checks = [
+        rel.must_from_triple("doc:a", "view", "user:u1").with_caveat(
+            "", {"access_at": Timestamp(1 << 60)}  # beyond TIME_MAX_US
+        ),
+        rel.must_from_triple("doc:a", "view", "user:u1").with_caveat(
+            "", {"access_at": "not-a-timestamp"}
+        ),
+    ]
+    d, p, _ = engine.check_batch(dsnap, checks, now_us=NOW)
+    for i in range(2):
+        assert not bool(d[i]) and bool(p[i]), i
+
+
+def test_time_randomized_differential():
+    """Fuzz the tri-state evaluator over all four timed caveats with
+    mixed coercion spellings, missing params, and junk values: every
+    device-definite row must equal the host result; rows with a full
+    well-typed context must BE device-definite (no gratuitous U)."""
+    import datetime as dt
+
+    import jax.numpy as jnp
+
+    from gochugaru_tpu.caveats import device as cdev
+    from gochugaru_tpu.caveats.cel import UNKNOWN, Duration, Timestamp
+
+    cs = compile_schema(parse_schema(SCHEMA_TIME))
+    plan = build_caveat_plan(cs)
+    progs = {
+        name: compile_cel(name, decl.params, decl.expression)
+        for name, decl in cs.schema.caveats.items()
+    }
+    tri_fn = cdev.make_tri_fn(plan)
+    rng = random.Random(0)
+
+    def rand_val(ptype, clean):
+        if rng.random() < 0.1:
+            return None
+        if not clean and rng.random() < 0.15:
+            return rng.choice(["junk", 3.5e18, True])
+        if ptype == "timestamp":
+            us = NOW + rng.randint(-10**13, 10**13)
+            style = rng.random()
+            if style < 0.4:
+                return Timestamp(us)
+            if style < 0.7:
+                return dt.datetime.fromtimestamp(
+                    us / 1e6, dt.timezone.utc
+                ).isoformat()
+            return us / 1e6
+        us = rng.randint(-10**10, 10**10)
+        style = rng.random()
+        if style < 0.4:
+            return Duration(us)
+        if style < 0.7:
+            return (f"{us}us") if us >= 0 else f"-{-us}us"
+        return us / 1e6
+
+    rows, expect = [], []
+    for trial in range(160):
+        name = rng.choice(sorted(progs))
+        prog = progs[name]
+        clean = trial % 4 == 0
+        ctx = {}
+        for pname, ptype in prog.params.items():
+            v = rand_val(ptype, clean)
+            while clean and v is None:
+                v = rand_val(ptype, True)
+            if v is not None:
+                ctx[pname] = v
+        rows.append(ctx)
+        expect.append((cs.caveat_ids[name], prog, ctx, clean))
+
+    strings = dict(plan.base_strings)
+    table = encode_contexts(plan, rows, strings)
+    P = table.vi.shape[1]
+    tables = {
+        "ectx_vi": np.asarray(table.vi),
+        "ectx_vf": np.asarray(table.vf),
+        "ectx_pr": np.asarray(table.present),
+        "ectx_host": np.asarray(table.host),
+        "qctx_vi": np.zeros((1, P), np.int32),
+        "qctx_vf": np.zeros((1, P), np.float32),
+        "qctx_pr": np.zeros((1, P), bool),
+        "qctx_host": np.zeros((1, plan.num_caveats + 1), bool),
+    }
+    cav = jnp.asarray(np.array([c for c, _, _, _ in expect], np.int32))
+    eidx = jnp.asarray(np.arange(len(expect), dtype=np.int32))
+    qidx = jnp.asarray(np.full(len(expect), -1, np.int32))
+    out = np.asarray(tri_fn(cav, eidx, qidx, tables))
+
+    n_definite = 0
+    for k, (cid, prog, ctx, clean) in enumerate(expect):
+        dev = int(out[k])
+        if dev == int(U):
+            assert not clean, (
+                f"full well-typed context must be device-definite: "
+                f"{prog.name} {ctx}"
+            )
+            continue
+        n_definite += 1
+        host = prog.evaluate(ctx)
+        want = U if host is UNKNOWN else (T if host else F)
+        assert dev == int(want), (prog.name, ctx, dev, want)
+    assert n_definite >= 80  # the fuzz must actually exercise the device

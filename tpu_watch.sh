@@ -148,6 +148,91 @@ WEOF
       timeout 180 python -m gochugaru_tpu.utils.perf --refresh \
         > "tpu_attempts/trace_${TS}/roofline.json" 2>> tpu_attempts/log.txt
       log "roofline (post-SpMM) rc=$? → tpu_attempts/trace_${TS}/roofline.json"
+      # priority 4.0: pallas-vs-xla A/B (engine/pallas.py fused probe).
+      # Interpret-mode CI only proves parity — THIS is where the
+      # one-pass bytes model meets silicon: same worlds (config-2 RBAC
+      # + config-3 docs at 10% scale), same column batches, interleaved
+      # pallas-on/pallas-off bulk reps, one JSON row per world carrying
+      # both rates + both modeled bytes/check + the VMEM residency, so
+      # the first window scores the kernel without operator thought.
+      timeout 700 python - > "tpu_attempts/pallas_${TS}.out" \
+          2> "tpu_attempts/pallas_${TS}.err" <<'PEOF'
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "tests")
+sys.argv = ["bench3_docs", "--scale", "0.1"]
+from test_latency_path import build_rbac_world
+
+from benchmarks import bench3_docs
+from gochugaru_tpu.engine.device import DeviceEngine
+from gochugaru_tpu.engine.plan import EngineConfig
+from gochugaru_tpu.utils import perf as _perf
+from gochugaru_tpu.utils.metrics import default as _m
+
+import jax
+
+
+def bulk_rate(engine, dsnap, q_res, q_perm, q_subj, reps):
+    d, p, o = engine.check_columns(dsnap, q_res, q_perm, q_subj)
+    np.asarray(d)  # compile + sync
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        d, p, o = engine.check_columns(dsnap, q_res, q_perm, q_subj)
+    np.asarray(d)
+    return reps * q_res.shape[0] / (time.perf_counter() - t0), (d, p, o)
+
+
+def ab(world_name, cs, snap, q_res, q_perm, q_subj, reps=8):
+    rows = {}
+    for knob in (False, True):
+        eng = DeviceEngine(cs, EngineConfig.for_schema(cs, pallas=knob))
+        ds = eng.prepare(snap)
+        rate, out = bulk_rate(eng, ds, q_res, q_perm, q_subj, reps)
+        model = _perf.pallas_bytes_model(ds)
+        rows[knob] = (rate, out, model)
+    (r0, o0, m0), (r1, o1, m1) = rows[False], rows[True]
+    for a, b in zip(o0, o1):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            f"{world_name}: pallas answers diverged on silicon"
+    xla_b = sum(r["xla"] for r in m1.values())
+    fused_b = sum(r["pallas"] for r in m1.values())
+    print(json.dumps({
+        "metric": f"pallas_ab_{world_name}", "value": round(r1, 1),
+        "unit": "checks/sec", "platform": jax.default_backend(),
+        "batch": int(q_res.shape[0]), "reps": reps,
+        "rate_xla": round(r0, 1), "rate_pallas": round(r1, 1),
+        "speedup": round(r1 / max(r0, 1e-9), 3),
+        "bytes_accessed_per_check": round(fused_b, 1),
+        "bytes_accessed_per_check_xla": round(xla_b, 1),
+        "vmem_resident_bytes": _m.gauge("perf.vmem_resident_bytes"),
+        "note": "bitwise-asserted A/B, same world + batches",
+    }), flush=True)
+
+
+rng = np.random.default_rng(5)
+cs, snap, users, repos, slot = build_rbac_world()
+B = 100_000
+ab("rbac_config2", cs, snap,
+   rng.choice(repos, B).astype(np.int32),
+   rng.choice(np.array([slot["read"], slot["admin"]], np.int32), B),
+   rng.choice(users, B).astype(np.int32))
+
+cs3, snap3, users3, docs3, slot3 = bench3_docs.build_world()
+ab("docs_config3", cs3, snap3,
+   rng.choice(docs3, B).astype(np.int32),
+   np.full(B, slot3["view"], np.int32),
+   rng.choice(users3, B).astype(np.int32))
+PEOF
+      log "pallas-vs-xla A/B rc=$? → tpu_attempts/pallas_${TS}.out"
+      # roofline note beside the capture AFTER the pallas A/B, so the
+      # fused kernels the window just launched are in the cost ledger
+      timeout 180 python -m gochugaru_tpu.utils.perf --refresh \
+        > "tpu_attempts/trace_${TS}/roofline.json" 2>> tpu_attempts/log.txt
+      log "roofline (post-pallas) rc=$? → tpu_attempts/trace_${TS}/roofline.json"
       # priority 4: the wider ladder while the window lasts
       timeout 420 python benchmarks/bench1_founders.py \
         > "tpu_attempts/b1_${TS}.out" 2> "tpu_attempts/b1_${TS}.err"
